@@ -1,0 +1,23 @@
+from proteinbert_tpu.configs.config import (
+    CheckpointConfig,
+    DataConfig,
+    MeshConfig,
+    ModelConfig,
+    OptimizerConfig,
+    PretrainConfig,
+    TrainConfig,
+    get_preset,
+    PRESETS,
+)
+
+__all__ = [
+    "CheckpointConfig",
+    "DataConfig",
+    "MeshConfig",
+    "ModelConfig",
+    "OptimizerConfig",
+    "PretrainConfig",
+    "TrainConfig",
+    "get_preset",
+    "PRESETS",
+]

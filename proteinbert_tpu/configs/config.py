@@ -1,0 +1,213 @@
+"""Config system for the TPU build.
+
+The reference has no config system at all — every hyperparameter is a
+constant in the smoke driver (reference dummy_tests.py:16-19,102-141) or a
+kwarg default (reference utils.py:220-231, modules.py:243-245). Here the
+whole framework is driven by one frozen dataclass tree so configs hash, are
+jit-static-friendly, and carry the tiny/base/long/large presets from
+BASELINE.json.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    """Architecture of the dual-track ProteinBERT model.
+
+    Defaults mirror the reference smoke config (reference dummy_tests.py:
+    110-118: seq_len 256, local 128, global 512, key 64, 4 heads, 6 blocks)
+    but the model here is shape-parametric in seq_len (the reference's
+    LayerNorm hard-codes L at construction, modules.py:148-151 — fixed).
+    """
+
+    vocab_size: int = 26                # 22 AA chars + 4 specials (data/vocab.py)
+    num_annotations: int = 8943         # GO terms with >=100 records (SURVEY C3)
+    local_dim: int = 128                # local (per-residue) channel dim C
+    global_dim: int = 512               # global (per-protein) dim G
+    key_dim: int = 64                   # attention key dim per head
+    num_heads: int = 4                  # global-attention heads
+    num_blocks: int = 6                 # dual-track blocks
+    narrow_kernel: int = 9              # narrow Conv1d kernel (modules.py:126)
+    wide_kernel: int = 9                # wide Conv1d kernel (modules.py:137)
+    wide_dilation: int = 5              # wide Conv1d dilation (modules.py:141)
+    dtype: str = "bfloat16"             # activation dtype (MXU-native)
+    param_dtype: str = "float32"        # parameter dtype
+    remat: bool = False                 # jax.checkpoint each block
+    scan_blocks: bool = True            # lax.scan over stacked block params
+    use_pallas: bool = False            # Pallas fused local-track kernel
+
+    @property
+    def value_dim(self) -> int:
+        # reference modules.py:119: value_dim = global_dim // num_heads
+        assert self.global_dim % self.num_heads == 0
+        return self.global_dim // self.num_heads
+
+
+@dataclasses.dataclass(frozen=True)
+class DataConfig:
+    """Online pipeline: tokenization + denoising corruption.
+
+    Probabilities follow the reference corruption pipeline (reference
+    data_processing.py:86-142), with the hide-all-annotations branch kept as
+    an explicit knob (SURVEY ledger #5).
+    """
+
+    seq_len: int = 256                      # fixed padded length fed to the model
+    token_randomize_prob: float = 0.05      # data_processing.py:90
+    annotation_corrupt_prob: float = 0.5    # P(keep-and-noise); else hide all
+                                            # (data_processing.py:127-128)
+    annotation_drop_prob: float = 0.25      # drop positives (data_processing.py:116)
+    annotation_add_prob: float = 1e-4       # add false positives (:117)
+    batch_size: int = 32
+    shuffle_buffer: int = 10_000
+    num_epochs: Optional[int] = None        # None = loop forever (iteration-based)
+
+
+@dataclasses.dataclass(frozen=True)
+class OptimizerConfig:
+    """Adam + warmup schedule (reference dummy_tests.py:127-130, utils.py:257-264).
+
+    The reference chains LambdaLR warmup into ReduceLROnPlateau via
+    SequentialLR, which crashes after warmup (SURVEY ledger #7). Here both a
+    correct warmup+plateau and warmup+cosine are provided.
+    """
+
+    learning_rate: float = 2e-4             # dummy_tests.py:128
+    warmup_steps: int = 10_000              # utils.py:233 warmup_duration
+    schedule: str = "warmup_plateau"        # "warmup_plateau" | "warmup_cosine" | "constant"
+    total_steps: int = 100_000              # cosine horizon
+    plateau_patience: int = 10              # plateau: evals without improvement
+    plateau_factor: float = 0.1             # plateau: LR multiplier on trigger
+    grad_clip_norm: float = 1.0             # reference clips grads (utils.py:136)
+    b1: float = 0.9
+    b2: float = 0.999
+    weight_decay: float = 0.0
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Device mesh axes — entirely new vs the reference (SURVEY C18: absent).
+
+    Axes: data (DP), fsdp (param/optimizer sharding over data axis), model
+    (TP over global/annotation dims), seq (sequence parallelism for the
+    local conv track with halo exchange).
+    """
+
+    data: int = 1
+    fsdp: int = 1
+    model: int = 1
+    seq: int = 1
+
+    @property
+    def axis_names(self) -> Tuple[str, ...]:
+        return ("data", "fsdp", "model", "seq")
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return (self.data, self.fsdp, self.model, self.seq)
+
+    @property
+    def num_devices(self) -> int:
+        n = 1
+        for s in self.shape:
+            n *= s
+        return n
+
+
+@dataclasses.dataclass(frozen=True)
+class CheckpointConfig:
+    """Checkpoint cadence (reference utils.py:227 nb_iterations_checkpoint=1000)."""
+
+    directory: str = "checkpoints"
+    every_steps: int = 1000
+    max_to_keep: int = 3
+    async_save: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    """Iteration-based pretraining loop config (reference utils.py:220-231)."""
+
+    max_steps: int = 250                    # dummy_tests.py:141 smoke default
+    log_every: int = 10
+    eval_every: int = 0                     # 0 = no eval
+    seed: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class PretrainConfig:
+    model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
+    data: DataConfig = dataclasses.field(default_factory=DataConfig)
+    optimizer: OptimizerConfig = dataclasses.field(default_factory=OptimizerConfig)
+    mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+    checkpoint: CheckpointConfig = dataclasses.field(default_factory=CheckpointConfig)
+    train: TrainConfig = dataclasses.field(default_factory=TrainConfig)
+
+    def replace(self, **kw) -> "PretrainConfig":
+        return dataclasses.replace(self, **kw)
+
+
+def _tiny() -> PretrainConfig:
+    # BASELINE.json configs[0]: 2 blocks, d=128, seq_len=128 — CPU smoke.
+    return PretrainConfig(
+        model=ModelConfig(local_dim=32, global_dim=128, key_dim=32, num_heads=4,
+                          num_blocks=2, num_annotations=512, dtype="float32"),
+        data=DataConfig(seq_len=128, batch_size=8),
+        optimizer=OptimizerConfig(warmup_steps=50, total_steps=250),
+        train=TrainConfig(max_steps=250),
+    )
+
+
+def _base() -> PretrainConfig:
+    # BASELINE.json configs[1]: 6 blocks, d=512, seq_len=512 — v5e-16 DP.
+    return PretrainConfig(
+        model=ModelConfig(local_dim=512, global_dim=512, key_dim=64, num_heads=8,
+                          num_blocks=6),
+        data=DataConfig(seq_len=512, batch_size=128),
+        optimizer=OptimizerConfig(warmup_steps=10_000, total_steps=1_000_000),
+        train=TrainConfig(max_steps=1_000_000),
+        mesh=MeshConfig(data=16),
+    )
+
+
+def _long() -> PretrainConfig:
+    # BASELINE.json configs[2]: seq_len=2048 long-context, sequence-parallel.
+    return PretrainConfig(
+        model=ModelConfig(local_dim=512, global_dim=512, key_dim=64, num_heads=8,
+                          num_blocks=6, remat=True),
+        data=DataConfig(seq_len=2048, batch_size=64),
+        optimizer=OptimizerConfig(warmup_steps=10_000, total_steps=1_000_000),
+        train=TrainConfig(max_steps=1_000_000),
+        mesh=MeshConfig(data=4, seq=4),
+    )
+
+
+def _large() -> PretrainConfig:
+    # BASELINE.json configs[4]: 12 blocks, d=1024, full 8943-dim GO head.
+    return PretrainConfig(
+        model=ModelConfig(local_dim=1024, global_dim=1024, key_dim=64,
+                          num_heads=16, num_blocks=12, remat=True),
+        data=DataConfig(seq_len=1024, batch_size=256),
+        optimizer=OptimizerConfig(warmup_steps=10_000, total_steps=2_000_000),
+        train=TrainConfig(max_steps=2_000_000),
+        mesh=MeshConfig(data=64, model=4),
+    )
+
+
+PRESETS = {
+    "tiny": _tiny,
+    "base": _base,
+    "long": _long,
+    "large": _large,
+}
+
+
+def get_preset(name: str) -> PretrainConfig:
+    try:
+        return PRESETS[name]()
+    except KeyError:
+        raise ValueError(f"unknown preset {name!r}; have {sorted(PRESETS)}") from None

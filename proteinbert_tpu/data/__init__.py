@@ -1,0 +1,37 @@
+from proteinbert_tpu.data.vocab import (
+    ALPHABET,
+    PAD_ID,
+    SOS_ID,
+    EOS_ID,
+    UNK_ID,
+    VOCAB_SIZE,
+    N_SPECIAL,
+    Vocab,
+    get_vocab,
+)
+from proteinbert_tpu.data.transforms import (
+    tokenize,
+    tokenize_batch,
+    random_crop,
+)
+from proteinbert_tpu.data.corruption import (
+    randomize_tokens,
+    corrupt_annotations,
+    corrupt_batch,
+    pretrain_weights,
+)
+from proteinbert_tpu.data.dataset import (
+    InMemoryPretrainingDataset,
+    HDF5PretrainingDataset,
+    make_pretrain_iterator,
+)
+
+__all__ = [
+    "ALPHABET", "PAD_ID", "SOS_ID", "EOS_ID", "UNK_ID", "VOCAB_SIZE",
+    "N_SPECIAL", "Vocab", "get_vocab",
+    "tokenize", "tokenize_batch", "random_crop",
+    "randomize_tokens", "corrupt_annotations", "corrupt_batch",
+    "pretrain_weights",
+    "InMemoryPretrainingDataset", "HDF5PretrainingDataset",
+    "make_pretrain_iterator",
+]

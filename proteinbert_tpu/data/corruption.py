@@ -1,0 +1,114 @@
+"""On-device denoising corruption ops (reference C6c/C6d, re-designed for TPU).
+
+The reference applies stochastic corruption per sample on the host inside
+DataLoader workers (`SimpleTokenRandomizer` reference data_processing.py:
+86-105, `AnnotationMasking` reference data_processing.py:108-142). On TPU the
+host core is the bottleneck, so here corruption is a pure jittable function
+of a JAX PRNG key that runs fused into the train step on device — the host
+feeds *clean* tokens/annotations, the device derives (X, Y, weights).
+
+Semantics (paper-corrected per SURVEY ledger):
+- Token randomization: each non-special position is replaced w.p. `p` by a
+  token drawn uniformly from the 22 real AA tokens (ids 4..25). Special
+  positions (<pad>/<sos>/<eos>) are never touched (reference
+  data_processing.py:95-104).
+- Annotation corruption: per protein, w.p. `corrupt_prob` the annotation
+  vector is kept but noised (positives dropped w.p. `drop_prob`, negatives
+  flipped on w.p. `add_prob`); otherwise the entire vector is hidden
+  (all zeros) — the reference's p=0.5 hide-all branch kept as an explicit,
+  configurable denoising design (reference data_processing.py:127-128,
+  SURVEY ledger #5).
+- Loss weights: per-token weight = non-pad mask of the *clean* sequence;
+  per-annotation weight = 1 iff the protein has any positive annotation,
+  broadcast over the annotation dim (reference data_processing.py:175-176).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from proteinbert_tpu.data.vocab import N_SPECIAL, PAD_ID, VOCAB_SIZE
+
+
+def randomize_tokens(key: jax.Array, tokens: jax.Array, prob: float) -> jax.Array:
+    """Randomly replace non-special tokens with random AA tokens.
+
+    Args:
+      key: PRNG key.
+      tokens: (..., L) int32 clean token ids.
+      prob: replacement probability (reference default 0.05,
+        data_processing.py:90).
+    Returns:
+      (..., L) int32 corrupted tokens.
+    """
+    k_mask, k_draw = jax.random.split(key)
+    replace = jax.random.bernoulli(k_mask, prob, tokens.shape)
+    replace = jnp.logical_and(replace, tokens >= N_SPECIAL)
+    random_aa = jax.random.randint(
+        k_draw, tokens.shape, N_SPECIAL, VOCAB_SIZE, dtype=tokens.dtype
+    )
+    return jnp.where(replace, random_aa, tokens)
+
+
+def corrupt_annotations(
+    key: jax.Array,
+    annotations: jax.Array,
+    corrupt_prob: float,
+    drop_prob: float,
+    add_prob: float,
+) -> jax.Array:
+    """Noise-or-hide the (B, A) float annotation matrix (see module docstring)."""
+    k_keep, k_drop, k_add = jax.random.split(key, 3)
+    batch_shape = annotations.shape[:-1]
+    keep = jax.random.bernoulli(k_keep, corrupt_prob, batch_shape)[..., None]
+    dropped = jnp.where(
+        jax.random.bernoulli(k_drop, drop_prob, annotations.shape),
+        jnp.zeros_like(annotations),
+        annotations,
+    )
+    added = jnp.where(
+        jax.random.bernoulli(k_add, add_prob, annotations.shape),
+        jnp.ones_like(annotations),
+        dropped,
+    )
+    return jnp.where(keep, added, jnp.zeros_like(annotations))
+
+
+def pretrain_weights(
+    tokens: jax.Array, annotations: jax.Array
+) -> Dict[str, jax.Array]:
+    """Loss weights from the CLEAN batch (reference data_processing.py:175-176)."""
+    seq_w = (tokens != PAD_ID).astype(jnp.float32)
+    has_any = (annotations.sum(axis=-1, keepdims=True) > 0).astype(jnp.float32)
+    ann_w = jnp.broadcast_to(has_any, annotations.shape)
+    return {"local": seq_w, "global": ann_w}
+
+
+def corrupt_batch(
+    key: jax.Array,
+    tokens: jax.Array,
+    annotations: jax.Array,
+    token_randomize_prob: float = 0.05,
+    annotation_corrupt_prob: float = 0.5,
+    annotation_drop_prob: float = 0.25,
+    annotation_add_prob: float = 1e-4,
+) -> Tuple[Dict[str, jax.Array], Dict[str, jax.Array], Dict[str, jax.Array]]:
+    """Derive the full (X, Y, weights) pretraining triple on device.
+
+    Mirrors the reference Dataset __getitem__ contract (reference
+    data_processing.py:159-180): X = corrupted inputs, Y = clean targets,
+    weights = loss masks; each a {"local", "global"} dict.
+    """
+    k_tok, k_ann = jax.random.split(key)
+    x_local = randomize_tokens(k_tok, tokens, token_randomize_prob)
+    x_global = corrupt_annotations(
+        k_ann, annotations, annotation_corrupt_prob,
+        annotation_drop_prob, annotation_add_prob,
+    )
+    X = {"local": x_local, "global": x_global}
+    Y = {"local": tokens, "global": annotations}
+    W = pretrain_weights(tokens, annotations)
+    return X, Y, W

@@ -1,0 +1,61 @@
+"""Host-side tokenization transforms (reference C6a/C6b).
+
+The reference runs tokenize → random-crop → randomize → pad per sample in
+DataLoader workers (reference data_processing.py:159-180). On TPU the host
+is often a single weak core per chip, so this module does only the cheap,
+string-shaped work — crop / tokenize / pad to a static length — vectorized
+in numpy. The stochastic corruption (token randomization, annotation
+masking) runs ON DEVICE inside the jitted train step (see
+data/corruption.py), which the reference cannot do.
+
+Semantics notes vs the reference:
+- The reference crops the *tokenized* sequence (reference
+  data_processing.py:64-83), so <sos>/<eos> can be cropped away. Here we
+  crop the raw residues to seq_len-2 and then always add <sos>/<eos> —
+  paper-faithful framing, and it gives the model a deterministic sentinel
+  at both ends.
+- Padding always uses <pad>=0. (The reference's per-sample ToTensor default
+  would have padded with an out-of-vocab id had it ever padded — SURVEY
+  ledger #10.)
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from proteinbert_tpu.data.vocab import EOS_ID, PAD_ID, SOS_ID, get_vocab
+
+
+def random_crop(seq: str, max_residues: int, rng: np.random.Generator) -> str:
+    """Uniform random window of `max_residues` (reference data_processing.py:64-83)."""
+    if len(seq) <= max_residues:
+        return seq
+    start = int(rng.integers(0, len(seq) - max_residues + 1))
+    return seq[start : start + max_residues]
+
+
+def tokenize(seq: str, seq_len: int, rng: np.random.Generator | None = None) -> np.ndarray:
+    """Crop → encode → add <sos>/<eos> → pad to `seq_len`. Returns (seq_len,) int32."""
+    vocab = get_vocab()
+    if rng is not None:
+        seq = random_crop(seq, seq_len - 2, rng)
+    else:
+        seq = seq[: seq_len - 2]
+    ids = vocab.encode(seq)
+    out = np.full(seq_len, PAD_ID, dtype=np.int32)
+    out[0] = SOS_ID
+    out[1 : 1 + len(ids)] = ids
+    out[1 + len(ids)] = EOS_ID
+    return out
+
+
+def tokenize_batch(
+    seqs: Sequence[str], seq_len: int, rng: np.random.Generator | None = None
+) -> np.ndarray:
+    """Tokenize a list of sequences to a dense (B, seq_len) int32 batch."""
+    out = np.full((len(seqs), seq_len), PAD_ID, dtype=np.int32)
+    for i, s in enumerate(seqs):
+        out[i] = tokenize(s, seq_len, rng)
+    return out

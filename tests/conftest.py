@@ -1,0 +1,49 @@
+"""Test harness: force JAX onto 8 virtual CPU devices BEFORE jax initializes.
+
+This is the TPU-native answer to "test multi-device without a cluster"
+(SURVEY.md §4): every sharding/collective test in this suite runs against a
+fake 8-device CPU mesh; the driver separately dry-run-compiles the
+multi-chip path via __graft_entry__.dryrun_multichip.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402  (import after env setup is the point)
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+@pytest.fixture
+def key():
+    return jax.random.PRNGKey(0)
+
+
+def make_random_proteins(n, rng, num_annotations=512, max_len=250, density=0.005):
+    """Synthetic UniRef-like fixture (reference dummy_tests.py:23-38 parity):
+    random AA strings of length 0..max_len and sparse 0/1 annotation rows."""
+    from proteinbert_tpu.data.vocab import ALPHABET
+
+    seqs = []
+    for _ in range(n):
+        L = int(rng.integers(0, max_len + 1))
+        seqs.append("".join(rng.choice(list(ALPHABET), size=L)))
+    ann = (rng.random((n, num_annotations)) < density).astype(np.float32)
+    return seqs, ann
+
+
+@pytest.fixture
+def random_proteins(rng):
+    return make_random_proteins(64, rng)

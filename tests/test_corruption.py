@@ -1,0 +1,78 @@
+"""Property tests for the on-device corruption ops (C6c/C6d semantics)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from proteinbert_tpu.data.corruption import (
+    corrupt_annotations, corrupt_batch, pretrain_weights, randomize_tokens,
+)
+from proteinbert_tpu.data.transforms import tokenize_batch
+from proteinbert_tpu.data.vocab import N_SPECIAL, PAD_ID, VOCAB_SIZE
+
+
+def _tokens(rng, b=16, l=64):
+    from tests.conftest import make_random_proteins
+
+    seqs, _ = make_random_proteins(b, rng, max_len=l - 2)
+    return jnp.asarray(tokenize_batch(seqs, l))
+
+
+def test_randomize_never_touches_specials(key, rng):
+    toks = _tokens(rng)
+    out = randomize_tokens(key, toks, prob=1.0)
+    specials = toks < N_SPECIAL
+    assert (np.asarray(out)[np.asarray(specials)] == np.asarray(toks)[np.asarray(specials)]).all()
+    # replaced positions get real AA tokens only
+    assert (np.asarray(out) >= N_SPECIAL)[~np.asarray(specials)].all()
+    assert (np.asarray(out) < VOCAB_SIZE).all()
+
+
+def test_randomize_rate_close_to_p(key, rng):
+    toks = _tokens(rng, b=64, l=128)
+    out = randomize_tokens(key, toks, prob=0.05)
+    nonspecial = np.asarray(toks >= N_SPECIAL)
+    changed = np.asarray(out != toks)[nonspecial]
+    # replacement draws can coincide with the original token (21/22 visible rate)
+    rate = changed.mean()
+    assert 0.02 < rate < 0.08
+
+
+def test_annotation_hide_all_branch(key):
+    ann = jnp.ones((512, 32), jnp.float32)
+    out = np.asarray(corrupt_annotations(key, ann, corrupt_prob=0.5,
+                                         drop_prob=0.0, add_prob=0.0))
+    hidden = (out.sum(axis=1) == 0).mean()
+    assert 0.4 < hidden < 0.6  # reference data_processing.py:127-128 p=0.5
+    kept = out[out.sum(axis=1) > 0]
+    assert (kept == 1).all()
+
+
+def test_annotation_drop_and_add(key):
+    ann = jnp.zeros((64, 1000), jnp.float32).at[:, :500].set(1.0)
+    out = np.asarray(corrupt_annotations(key, ann, corrupt_prob=1.0,
+                                         drop_prob=0.25, add_prob=0.1))
+    drop_rate = 1.0 - out[:, :500].mean()
+    add_rate = out[:, 500:].mean()
+    assert 0.2 < drop_rate < 0.3
+    assert 0.05 < add_rate < 0.15
+
+
+def test_weights_contract(rng):
+    toks = _tokens(rng)
+    ann = jnp.zeros((toks.shape[0], 8), jnp.float32).at[0, 3].set(1.0)
+    w = pretrain_weights(toks, ann)
+    assert (np.asarray(w["local"]) == np.asarray(toks != PAD_ID)).all()
+    assert w["global"].shape == ann.shape
+    assert np.asarray(w["global"])[0].all() and not np.asarray(w["global"])[1:].any()
+
+
+def test_corrupt_batch_is_jittable_and_targets_clean(key, rng):
+    toks = _tokens(rng)
+    ann = jnp.ones((toks.shape[0], 16), jnp.float32)
+    fn = jax.jit(corrupt_batch)
+    X, Y, W = fn(key, toks, ann)
+    assert (np.asarray(Y["local"]) == np.asarray(toks)).all()
+    assert (np.asarray(Y["global"]) == np.asarray(ann)).all()
+    assert X["local"].shape == toks.shape and X["global"].shape == ann.shape
+    assert set(W) == {"local", "global"}

@@ -1,0 +1,89 @@
+"""Tests for datasets and the per-host sharded iterator (C7/C8)."""
+
+import numpy as np
+import pytest
+
+from proteinbert_tpu.data.dataset import (
+    InMemoryPretrainingDataset, make_pretrain_iterator,
+)
+
+
+def _ds(n=40, a=16, seq_len=32, rng=None):
+    rng = rng or np.random.default_rng(0)
+    from tests.conftest import make_random_proteins
+
+    seqs, ann = make_random_proteins(n, rng, num_annotations=a, max_len=40)
+    return InMemoryPretrainingDataset(seqs, ann, seq_len)
+
+
+def test_inmemory_shapes_and_getitem():
+    ds = _ds()
+    assert len(ds) == 40
+    row = ds[3]
+    assert row["tokens"].shape == (32,) and row["annotations"].shape == (16,)
+    batch = ds.get_batch(np.array([1, 5, 9]))
+    assert batch["tokens"].shape == (3, 32)
+    assert (batch["tokens"][1] == ds[5]["tokens"]).all()
+
+
+def test_iterator_batches_and_epochs():
+    ds = _ds(n=40)
+    batches = list(make_pretrain_iterator(ds, 8, num_epochs=2))
+    assert len(batches) == 10  # 5 per epoch x 2
+    assert batches[0]["tokens"].shape == (8, 32)
+    assert batches[0]["annotations"].dtype == np.float32
+
+
+def test_iterator_raises_on_undersized_shard():
+    ds = _ds(n=10)
+    with pytest.raises(ValueError, match="cannot fill"):
+        next(make_pretrain_iterator(ds, 32))
+    with pytest.raises(ValueError, match="cannot fill"):
+        next(make_pretrain_iterator(ds, 8, process_count=4))
+
+
+def test_equal_batches_per_host():
+    # n=15, 2 hosts: both hosts must see exactly 7 rows -> 1 batch of 4... 7//4=1
+    ds = _ds(n=15)
+    counts = []
+    for p in range(2):
+        it = make_pretrain_iterator(ds, 4, seed=3, num_epochs=1,
+                                    process_index=p, process_count=2)
+        counts.append(sum(1 for _ in it))
+    assert counts[0] == counts[1] > 0
+
+
+def test_hosts_disjoint():
+    ds = _ds(n=64)
+    b0 = next(make_pretrain_iterator(ds, 16, seed=1, process_index=0, process_count=2))
+    b1 = next(make_pretrain_iterator(ds, 16, seed=1, process_index=1, process_count=2))
+    s0 = {t.tobytes() for t in b0["tokens"]}
+    s1 = {t.tobytes() for t in b1["tokens"]}
+    assert not (s0 & s1)
+
+
+def test_shuffle_covers_all_rows():
+    ds = _ds(n=32)
+    it = make_pretrain_iterator(ds, 8, num_epochs=1)
+    seen = set()
+    for b in it:
+        for t in b["tokens"]:
+            seen.add(t.tobytes())
+    all_rows = {t.tobytes() for t in ds.tokens}
+    assert seen == all_rows
+
+
+class _BlockDS(InMemoryPretrainingDataset):
+    shuffle_block = 8
+
+
+def test_block_shuffle_order_is_block_local():
+    rng = np.random.default_rng(0)
+    from proteinbert_tpu.data.dataset import _epoch_order
+
+    order = _epoch_order(32, rng, shuffle=True, block=8)
+    assert sorted(order.tolist()) == list(range(32))
+    # each consecutive 8-run stays within one block
+    for i in range(0, 32, 8):
+        run = order[i : i + 8]
+        assert len({int(v) // 8 for v in run}) == 1

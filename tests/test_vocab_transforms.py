@@ -1,0 +1,57 @@
+"""Unit tests for vocab (C5) and host-side transforms (C6a/b)."""
+
+import numpy as np
+import pytest
+
+from proteinbert_tpu.data.vocab import (
+    ALPHABET, EOS_ID, N_SPECIAL, PAD_ID, SOS_ID, UNK_ID, VOCAB_SIZE, get_vocab,
+)
+from proteinbert_tpu.data.transforms import random_crop, tokenize, tokenize_batch
+
+
+def test_vocab_ids_match_reference_layout():
+    # reference data_processing.py:337-348: <pad>=0,<sos>=1,<eos>=2,<unk>=3, AAs 4..25
+    v = get_vocab()
+    assert len(v) == VOCAB_SIZE == 26
+    assert v.stoi["<pad>"] == PAD_ID == 0
+    assert v.stoi["<sos>"] == SOS_ID == 1
+    assert v.stoi["<eos>"] == EOS_ID == 2
+    assert v.stoi["<unk>"] == UNK_ID == 3
+    for i, ch in enumerate(ALPHABET):
+        assert v.stoi[ch] == N_SPECIAL + i
+
+
+def test_encode_roundtrip_and_unk():
+    v = get_vocab()
+    ids = v.encode("ACDY")
+    assert ids.dtype == np.int32
+    assert v.decode(ids) == "ACDY"
+    assert v.encode("AZB")[1] == UNK_ID  # Z, B are not in the 22-char alphabet
+    assert (v.encode("ACD") >= N_SPECIAL).all()
+
+
+def test_tokenize_layout():
+    t = tokenize("ACD", seq_len=8)
+    assert t.tolist() == [SOS_ID] + [v for v in get_vocab().encode("ACD")] + [EOS_ID, 0, 0, 0]
+
+
+def test_tokenize_truncates_long():
+    t = tokenize("A" * 100, seq_len=16)
+    assert t.shape == (16,)
+    assert t[0] == SOS_ID and t[-1] == EOS_ID
+    assert (t != PAD_ID).all()
+
+
+def test_random_crop_window(rng):
+    s = "ACDEFGHIKL"
+    out = random_crop(s, 4, rng)
+    assert len(out) == 4 and out in s
+    assert random_crop(s, 100, rng) == s
+
+
+def test_tokenize_batch_shapes(rng):
+    seqs = ["", "A", "ACDEFGHIKLMNPQRSTVWY" * 20]
+    b = tokenize_batch(seqs, 32, rng)
+    assert b.shape == (3, 32)
+    assert (b[:, 0] == SOS_ID).all()
+    assert b[0, 1] == EOS_ID  # empty sequence: sos,eos,pad...

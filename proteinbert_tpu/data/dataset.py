@@ -43,8 +43,11 @@ class InMemoryPretrainingDataset:
       seqs: list of AA strings.
       annotations: (N, A) 0/1 array (dense or castable).
       seq_len: static padded length.
-      crop_rng: if given, long sequences are random-cropped at
-        materialization time; else deterministically head-truncated.
+      crop_rng: if given, sequences longer than seq_len-2 are re-cropped
+        to a fresh random window on EVERY access (matching the
+        reference's per-access crop, reference data_processing.py:64-83,
+        and this repo's HDF5 path); else they are head-truncated once and
+        all rows are served from the dense pre-tokenized cache.
     """
 
     def __init__(
@@ -58,18 +61,38 @@ class InMemoryPretrainingDataset:
         if len(seqs) != len(annotations):
             raise ValueError(f"{len(seqs)} seqs vs {len(annotations)} annotation rows")
         self.seq_len = seq_len
-        self.tokens = tokenize_batch(seqs, seq_len, crop_rng)
+        self.crop_rng = crop_rng
+        self.tokens = tokenize_batch(seqs, seq_len)
+        if crop_rng is not None:
+            # Only long rows need per-access re-tokenization; short rows
+            # always come from the dense cache.
+            self._seqs = list(seqs)
+            self._long = np.array([len(s) > seq_len - 2 for s in seqs])
+        else:
+            self._seqs = None
+            self._long = None
         self.annotations = annotations.astype(np.float32)
 
     def __len__(self) -> int:
         return len(self.tokens)
 
     def __getitem__(self, i) -> Dict[str, np.ndarray]:
-        return {"tokens": self.tokens[i], "annotations": self.annotations[i]}
+        if self._long is not None and self._long[i]:
+            tok = tokenize_batch([self._seqs[i]], self.seq_len, self.crop_rng)[0]
+        else:
+            tok = self.tokens[i]
+        return {"tokens": tok, "annotations": self.annotations[i]}
 
     def get_batch(self, idx: np.ndarray) -> Dict[str, np.ndarray]:
-        """Vectorized gather — two array ops, no per-row Python work."""
-        return {"tokens": self.tokens[idx], "annotations": self.annotations[idx]}
+        """Vectorized gather; long rows re-cropped per access if crop_rng."""
+        tokens = self.tokens[idx]
+        if self._long is not None:
+            for pos, i in enumerate(idx):
+                if self._long[i]:
+                    tokens[pos] = tokenize_batch(
+                        [self._seqs[int(i)]], self.seq_len, self.crop_rng
+                    )[0]
+        return {"tokens": tokens, "annotations": self.annotations[idx]}
 
 
 class HDF5PretrainingDataset:
@@ -207,7 +230,10 @@ def make_pretrain_iterator(
     epoch = 0
     while num_epochs is None or epoch < num_epochs:
         order = _epoch_order(n, rng, shuffle, block)[: per_host * process_count]
-        shard = order[process_index::process_count]
+        # Contiguous split (not strided): keeps the block-local runs of
+        # _epoch_order intact per host, so each HDF5 block is read and
+        # decoded by exactly one host instead of all of them.
+        shard = order[process_index * per_host : (process_index + 1) * per_host]
         for lo in range(0, per_host - batch_size + 1, batch_size):
             idx = shard[lo : lo + batch_size]
             if get_batch is not None:

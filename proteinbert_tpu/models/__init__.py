@@ -1,0 +1,3 @@
+from proteinbert_tpu.models import proteinbert
+
+__all__ = ["proteinbert"]

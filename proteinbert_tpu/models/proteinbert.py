@@ -1,0 +1,179 @@
+"""ProteinBERT dual-track model (reference C11/C12, TPU-native).
+
+Functional pytree implementation of the dual-track (local sequence /
+global annotation) ProteinBERT trunk (Brandes et al. 2022; reference
+ProteinBERT/modules.py:95-304), with the paper-correct semantics the
+reference gets wrong (SURVEY ledger #1-#4):
+
+- every parameter is a pytree leaf (optimizer sees the attention heads);
+- attention softmax is over the sequence axis, padding masked out;
+- LayerNorm is per-position over features only → the model is
+  shape-parametric in L (one set of weights serves any sequence length);
+- output heads emit LOGITS; probabilities never enter the loss (the
+  reference applies Softmax/Sigmoid in the model and then feeds
+  CrossEntropyLoss, reference modules.py:277-293 + utils.py:293).
+
+TPU mapping:
+- activations run in bfloat16 (cfg.dtype), parameters in float32;
+- the N identical blocks are stacked on a leading axis and driven by
+  `lax.scan` (cfg.scan_blocks) → one compiled block body instead of N
+  unrolled copies, cutting compile time and enabling `jax.checkpoint`
+  rematerialisation per scan step (cfg.remat) for long-context configs;
+- layout is feature-last (B, L, C) throughout so the L axis can carry a
+  `seq` mesh axis (sequence parallelism) and convs lower to MXU implicit
+  GEMMs (see ops/layers.py).
+
+Block dataflow (reference modules.py:201-231, shapes in SURVEY §3.4):
+  local:  x = LN(x + narrow_conv(x)·gelu + wide_conv(x)·gelu
+                 + broadcast(gelu(dense(g))))
+          x = LN(x + gelu(dense(x)))
+  global: g = LN(g + gelu(dense(g)) + attention(x, g))
+          g = LN(g + gelu(dense(g)))
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from proteinbert_tpu.configs import ModelConfig
+from proteinbert_tpu.data.vocab import PAD_ID
+from proteinbert_tpu.ops.attention import (
+    global_attention_apply,
+    global_attention_init,
+)
+from proteinbert_tpu.ops.layers import (
+    conv1d_apply,
+    conv1d_init,
+    dense_apply,
+    dense_init,
+    embedding_apply,
+    embedding_init,
+    layer_norm_apply,
+    layer_norm_init,
+)
+
+Params = Dict[str, Any]
+
+
+def block_init(key: jax.Array, cfg: ModelConfig) -> Params:
+    """One dual-track block's parameters (reference modules.py:95-199)."""
+    C, G = cfg.local_dim, cfg.global_dim
+    ks = jax.random.split(key, 8)
+    return {
+        "narrow_conv": conv1d_init(ks[0], cfg.narrow_kernel, C, C),
+        "wide_conv": conv1d_init(ks[1], cfg.wide_kernel, C, C),
+        "global_to_local": dense_init(ks[2], G, C),
+        "local_ln1": layer_norm_init(C),
+        "local_dense": dense_init(ks[3], C, C),
+        "local_ln2": layer_norm_init(C),
+        "global_dense1": dense_init(ks[4], G, G),
+        "attention": global_attention_init(ks[5], C, G, cfg.key_dim, cfg.num_heads),
+        "global_ln1": layer_norm_init(G),
+        "global_dense2": dense_init(ks[6], G, G),
+        "global_ln2": layer_norm_init(G),
+    }
+
+
+def block_apply(
+    params: Params,
+    local: jax.Array,
+    global_: jax.Array,
+    pad_mask: Optional[jax.Array],
+    cfg: ModelConfig,
+) -> Tuple[jax.Array, jax.Array]:
+    """Apply one block. local (B,L,C), global (B,G), pad_mask (B,L) bool."""
+    # Local track (reference modules.py:201-217).
+    narrow = jax.nn.gelu(conv1d_apply(params["narrow_conv"], local))
+    wide = jax.nn.gelu(
+        conv1d_apply(params["wide_conv"], local, dilation=cfg.wide_dilation)
+    )
+    broadcast = jax.nn.gelu(dense_apply(params["global_to_local"], global_))
+    local = layer_norm_apply(
+        params["local_ln1"], local + narrow + wide + broadcast[:, None, :]
+    )
+    local = layer_norm_apply(
+        params["local_ln2"],
+        local + jax.nn.gelu(dense_apply(params["local_dense"], local)),
+    )
+
+    # Global track (reference modules.py:219-229).
+    dense1 = jax.nn.gelu(dense_apply(params["global_dense1"], global_))
+    attn = global_attention_apply(params["attention"], local, global_, pad_mask)
+    global_ = layer_norm_apply(params["global_ln1"], global_ + dense1 + attn)
+    global_ = layer_norm_apply(
+        params["global_ln2"],
+        global_ + jax.nn.gelu(dense_apply(params["global_dense2"], global_)),
+    )
+    return local, global_
+
+
+def init(key: jax.Array, cfg: ModelConfig) -> Params:
+    """Full-model parameter pytree (reference modules.py:234-293)."""
+    k_embed, k_gin, k_blocks, k_lh, k_gh = jax.random.split(key, 5)
+    block_keys = jax.random.split(k_blocks, cfg.num_blocks)
+    blocks = [block_init(k, cfg) for k in block_keys]
+    if cfg.scan_blocks:
+        blocks = jax.tree.map(lambda *xs: jnp.stack(xs), *blocks)
+    return {
+        "embedding": embedding_init(k_embed, cfg.vocab_size, cfg.local_dim),
+        "global_in": dense_init(k_gin, cfg.num_annotations, cfg.global_dim),
+        "blocks": blocks,
+        "local_head": dense_init(k_lh, cfg.local_dim, cfg.vocab_size),
+        "global_head": dense_init(k_gh, cfg.global_dim, cfg.num_annotations),
+    }
+
+
+def apply(
+    params: Params,
+    tokens: jax.Array,
+    annotations: jax.Array,
+    cfg: ModelConfig,
+    pad_mask: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Forward pass.
+
+    Args:
+      tokens: (B, L) int token ids (the corrupted "local" input).
+      annotations: (B, A) float annotation vector (the corrupted "global"
+        input; reference input contract at modules.py:295-304).
+      pad_mask: (B, L) bool, True at real positions; derived from tokens
+        if omitted.
+    Returns:
+      (local_logits (B, L, V), global_logits (B, A)) — LOGITS, in float32.
+    """
+    dtype = jnp.dtype(cfg.dtype)
+    if pad_mask is None:
+        pad_mask = tokens != PAD_ID
+
+    local = embedding_apply(params["embedding"], tokens, dtype)
+    global_ = jax.nn.gelu(
+        dense_apply(params["global_in"], annotations.astype(dtype))
+    )
+
+    body = partial(block_apply, cfg=cfg)
+    if cfg.remat:
+        body = jax.checkpoint(body)
+
+    if cfg.scan_blocks:
+        def scan_body(carry, blk):
+            l, g = carry
+            l, g = body(blk, l, g, pad_mask)
+            return (l, g), None
+
+        (local, global_), _ = lax.scan(scan_body, (local, global_), params["blocks"])
+    else:
+        for blk in params["blocks"]:
+            local, global_ = body(blk, local, global_, pad_mask)
+
+    local_logits = dense_apply(params["local_head"], local).astype(jnp.float32)
+    global_logits = dense_apply(params["global_head"], global_).astype(jnp.float32)
+    return local_logits, global_logits
+
+
+def param_count(params: Params) -> int:
+    return sum(p.size for p in jax.tree.leaves(params))

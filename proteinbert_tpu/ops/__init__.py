@@ -1,0 +1,17 @@
+from proteinbert_tpu.ops.layers import (
+    dense_init, dense_apply,
+    layer_norm_init, layer_norm_apply,
+    conv1d_init, conv1d_apply,
+    embedding_init, embedding_apply,
+)
+from proteinbert_tpu.ops.attention import (
+    global_attention_init, global_attention_apply,
+)
+
+__all__ = [
+    "dense_init", "dense_apply",
+    "layer_norm_init", "layer_norm_apply",
+    "conv1d_init", "conv1d_apply",
+    "embedding_init", "embedding_apply",
+    "global_attention_init", "global_attention_apply",
+]

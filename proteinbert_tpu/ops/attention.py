@@ -1,0 +1,97 @@
+"""Local→global broadcast attention (reference C9/C10, paper-corrected).
+
+The global track attends over the local (per-residue) track with a single
+query set derived from the global vector — O(H·k·L), not O(L²). This is
+the architecture's native answer to long sequences (SURVEY C19).
+
+Paper-faithful redesign of the reference implementation, which has three
+bugs this module deliberately does not reproduce:
+- heads lived in a plain Python list, so their parameters were untrained
+  and unserialized (reference modules.py:73-81; here they are pytree
+  leaves, stacked on a head axis and computed as one batched einsum
+  instead of a Python loop over heads, reference modules.py:87-92);
+- softmax ran over the tiled-query axis instead of the sequence axis
+  (reference modules.py:34,58; here softmax is over L);
+- the reference tiles the global vector `key_dim` times to manufacture a
+  (B, k, G) query block (reference modules.py:51) — an artifact of the
+  first two bugs; here each head has ONE query, as in the paper.
+
+Shapes (B=batch, L=seq, C=local_dim, G=global_dim, H=heads, k=key_dim,
+v=value_dim=G/H):
+  q = tanh(global · Wq)        (B,G)·(H,G,k)   -> (B,H,k)
+  K = tanh(local · Wk)         (B,L,C)·(H,C,k) -> (B,H,L,k)
+  V = gelu(local · Wv)         (B,L,C)·(H,C,v) -> (B,H,L,v)
+  scores = q·K / sqrt(k)                       -> (B,H,L)   [pad-masked]
+  out = softmax_L(scores)·V                    -> (B,H,v)   -> (B,G)
+
+The tanh/gelu activations on Q/K/V follow the reference heads (reference
+modules.py:49-56), which mirror the original Keras ProteinBERT. Projections
+are bias-free like the reference's raw `randn` parameter matrices
+(reference modules.py:27-32). Softmax is computed in float32.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from proteinbert_tpu.ops.layers import Params
+
+_proj_init = jax.nn.initializers.lecun_normal(in_axis=1, out_axis=2)
+
+
+def global_attention_init(
+    key: jax.Array, local_dim: int, global_dim: int, key_dim: int, num_heads: int
+) -> Params:
+    assert global_dim % num_heads == 0, (
+        f"global_dim {global_dim} % num_heads {num_heads} != 0"
+    )  # reference modules.py:108
+    value_dim = global_dim // num_heads  # reference modules.py:119
+    kq, kk, kv = jax.random.split(key, 3)
+    return {
+        "wq": _proj_init(kq, (num_heads, global_dim, key_dim), jnp.float32),
+        "wk": _proj_init(kk, (num_heads, local_dim, key_dim), jnp.float32),
+        "wv": _proj_init(kv, (num_heads, local_dim, value_dim), jnp.float32),
+    }
+
+
+def global_attention_apply(
+    params: Params,
+    local: jax.Array,
+    global_: jax.Array,
+    pad_mask: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Attend from the global vector over local positions.
+
+    Args:
+      local: (B, L, C) local track.
+      global_: (B, G) global track.
+      pad_mask: optional (B, L) bool, True at REAL positions. Padding is
+        excluded from the softmax (the reference attends over padding,
+        reference modules.py:58 — corrected here).
+    Returns:
+      (B, G) attention output in the activation dtype of `local`.
+    """
+    dtype = local.dtype
+    wq = params["wq"].astype(dtype)
+    wk = params["wk"].astype(dtype)
+    wv = params["wv"].astype(dtype)
+    key_dim = wq.shape[-1]
+
+    q = jnp.tanh(jnp.einsum("bg,hgk->bhk", global_, wq))
+    k = jnp.tanh(jnp.einsum("blc,hck->bhlk", local, wk))
+    v = jax.nn.gelu(jnp.einsum("blc,hcv->bhlv", local, wv))
+
+    scores = jnp.einsum("bhk,bhlk->bhl", q, k) / jnp.sqrt(
+        jnp.asarray(key_dim, dtype)
+    )
+    scores = scores.astype(jnp.float32)
+    if pad_mask is not None:
+        scores = jnp.where(pad_mask[:, None, :], scores, jnp.float32(-1e30))
+    weights = jax.nn.softmax(scores, axis=-1).astype(dtype)
+
+    out = jnp.einsum("bhl,bhlv->bhv", weights, v)
+    b, h, vd = out.shape
+    return out.reshape(b, h * vd)

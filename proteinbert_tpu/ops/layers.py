@@ -1,0 +1,106 @@
+"""Core functional layers: dense, LayerNorm, dilated Conv1d, embedding.
+
+Design: every layer is a pair of pure functions — `*_init(key, ...) ->
+params` (a plain dict pytree, always fp32 leaves) and `*_apply(params, x)`
+(computes in the activation dtype of `x`, which the model sets to bfloat16
+on TPU so matmuls/convs hit the MXU natively). This replaces the
+reference's `nn.Module` layers (reference ProteinBERT/modules.py) with
+jit/scan/shard-friendly pytrees; in particular every parameter is a pytree
+leaf, fixing the reference bug where attention-head parameters lived in a
+plain Python list and were invisible to the optimizer (reference
+modules.py:73-81, SURVEY ledger #1).
+
+Numerics:
+- LayerNorm statistics are computed in float32 regardless of activation
+  dtype, and normalize over the FEATURE axis only. The reference
+  normalizes jointly over (seq_len, channels), which hard-codes the
+  sequence length into the weight shapes (reference modules.py:148-151,
+  SURVEY ledger #4); per-feature LN is paper-correct and required for
+  length-bucketing and sequence sharding.
+- Conv1d uses feature-last (B, L, C) layout — the natural layout for XLA
+  TPU spatial convolution (and for sequence-sharding the L axis). The
+  reference keeps channels-first (B, C, L) torch layout (reference
+  modules.py:205-211).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Params = Dict[str, jax.Array]
+
+_dense_init = jax.nn.initializers.lecun_normal()
+_conv_init = jax.nn.initializers.lecun_normal(in_axis=(0, 1), out_axis=2)
+_embed_init = jax.nn.initializers.normal(stddev=1.0)
+
+
+def dense_init(key: jax.Array, in_dim: int, out_dim: int, use_bias: bool = True) -> Params:
+    p = {"kernel": _dense_init(key, (in_dim, out_dim), jnp.float32)}
+    if use_bias:
+        p["bias"] = jnp.zeros((out_dim,), jnp.float32)
+    return p
+
+
+def dense_apply(params: Params, x: jax.Array) -> jax.Array:
+    """y = x @ W (+ b), contracting the last axis of x."""
+    y = x @ params["kernel"].astype(x.dtype)
+    if "bias" in params:
+        y = y + params["bias"].astype(x.dtype)
+    return y
+
+
+def layer_norm_init(dim: int) -> Params:
+    return {"scale": jnp.ones((dim,), jnp.float32),
+            "bias": jnp.zeros((dim,), jnp.float32)}
+
+
+def layer_norm_apply(params: Params, x: jax.Array, eps: float = 1e-5) -> jax.Array:
+    """Per-position LN over the last (feature) axis; fp32 statistics."""
+    x32 = x.astype(jnp.float32)
+    mean = x32.mean(axis=-1, keepdims=True)
+    var = x32.var(axis=-1, keepdims=True)
+    y = (x32 - mean) * lax.rsqrt(var + eps)
+    y = y * params["scale"] + params["bias"]
+    return y.astype(x.dtype)
+
+
+def conv1d_init(key: jax.Array, kernel_size: int, in_dim: int, out_dim: int) -> Params:
+    return {
+        "kernel": _conv_init(key, (kernel_size, in_dim, out_dim), jnp.float32),
+        "bias": jnp.zeros((out_dim,), jnp.float32),
+    }
+
+
+def conv1d_apply(params: Params, x: jax.Array, dilation: int = 1) -> jax.Array:
+    """'SAME'-padded 1D convolution in (B, L, C) layout.
+
+    TPU-idiomatic lowering of the reference's torch Conv1d pair — the
+    narrow k=9 d=1 and wide k=9 d=5 local-track convs (reference
+    modules.py:124-147). XLA maps this onto the MXU as an implicit GEMM
+    and, under a sequence-sharded `jit`, inserts the halo exchange for the
+    (k-1)/2 * dilation boundary rows automatically.
+    """
+    y = lax.conv_general_dilated(
+        x,
+        params["kernel"].astype(x.dtype),
+        window_strides=(1,),
+        padding="SAME",
+        rhs_dilation=(dilation,),
+        dimension_numbers=("NWC", "WIO", "NWC"),
+    )
+    return y + params["bias"].astype(x.dtype)
+
+
+def embedding_init(key: jax.Array, vocab_size: int, dim: int) -> Params:
+    return {"embedding": _embed_init(key, (vocab_size, dim), jnp.float32)}
+
+
+def embedding_apply(params: Params, ids: jax.Array, dtype: Optional[jnp.dtype] = None) -> jax.Array:
+    table = params["embedding"]
+    if dtype is not None:
+        table = table.astype(dtype)
+    return jnp.take(table, ids, axis=0)

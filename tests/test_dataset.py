@@ -54,7 +54,12 @@ def test_equal_batches_per_host():
 
 
 def test_hosts_disjoint():
-    ds = _ds(n=64)
+    # Unique-by-construction rows (random fixtures can produce duplicate
+    # short/empty sequences, which would collide across hosts by content).
+    alphabet = "ACDEFGHIKLMNPQRSTVWY"
+    seqs = [alphabet[i % 20] * (i // 20 + 1) + alphabet[: i % 20] for i in range(64)]
+    ann = np.eye(64, 16, dtype=np.float32)
+    ds = InMemoryPretrainingDataset(seqs, ann, 32)
     b0 = next(make_pretrain_iterator(ds, 16, seed=1, process_index=0, process_count=2))
     b1 = next(make_pretrain_iterator(ds, 16, seed=1, process_index=1, process_count=2))
     s0 = {t.tobytes() for t in b0["tokens"]}
@@ -87,3 +92,35 @@ def test_block_shuffle_order_is_block_local():
     for i in range(0, 32, 8):
         run = order[i : i + 8]
         assert len({int(v) // 8 for v in run}) == 1
+
+
+def test_iterator_respects_shuffle_block_end_to_end():
+    """The iterator must discover `shuffle_block` and keep each host's
+    accesses block-local (one 8-row block per consecutive batch run)."""
+    rng = np.random.default_rng(0)
+    from tests.conftest import make_random_proteins
+
+    seqs, ann = make_random_proteins(32, rng, num_annotations=16, max_len=40)
+    ds = _BlockDS(seqs, ann, 32)
+    row_of = {ds[i]["tokens"].tobytes(): i for i in range(32)}
+    for p in range(2):
+        it = make_pretrain_iterator(ds, 8, seed=7, num_epochs=1,
+                                    process_index=p, process_count=2)
+        for b in it:
+            rows = [row_of[t.tobytes()] for t in b["tokens"]]
+            assert len({r // 8 for r in rows}) == 1, rows
+
+
+def test_inmemory_recrops_long_rows_per_access():
+    """Review fix: with crop_rng, long sequences get a fresh window each
+    access instead of one frozen window for the whole run."""
+    rng = np.random.default_rng(0)
+    long_seq = "".join(rng.choice(list("ACDEFGHIKLMNPQRSTVWY"), size=500))
+    ds = InMemoryPretrainingDataset(
+        [long_seq], np.zeros((1, 4)), seq_len=32,
+        crop_rng=np.random.default_rng(1),
+    )
+    draws = {ds[0]["tokens"].tobytes() for _ in range(10)}
+    assert len(draws) > 1
+    batch_draws = {ds.get_batch(np.array([0]))["tokens"].tobytes() for _ in range(10)}
+    assert len(batch_draws) > 1

@@ -1,0 +1,183 @@
+"""Model tests: shapes, gradient coverage, length-parametricity, masking.
+
+Mirrors what the reference's smoke driver eyeballs (reference
+dummy_tests.py:96-143: shape/param-count via torchinfo.summary) but as
+real assertions, plus regression tests for each paper-correction in the
+SURVEY faithfulness ledger (#1-#4).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from proteinbert_tpu.configs import ModelConfig
+from proteinbert_tpu.data.vocab import PAD_ID, SOS_ID, EOS_ID, N_SPECIAL, VOCAB_SIZE
+from proteinbert_tpu.models import proteinbert
+from proteinbert_tpu.ops.attention import (
+    global_attention_apply,
+    global_attention_init,
+)
+
+
+def tiny_cfg(**kw):
+    defaults = dict(
+        local_dim=16, global_dim=32, key_dim=8, num_heads=4, num_blocks=2,
+        num_annotations=64, dtype="float32",
+    )
+    defaults.update(kw)
+    return ModelConfig(**defaults)
+
+
+def make_batch(key, cfg, batch=4, seq_len=32):
+    k1, k2 = jax.random.split(key)
+    tokens = jax.random.randint(k1, (batch, seq_len), N_SPECIAL, VOCAB_SIZE)
+    tokens = tokens.at[:, 0].set(SOS_ID).at[:, -1].set(EOS_ID)
+    ann = (jax.random.uniform(k2, (batch, cfg.num_annotations)) < 0.05).astype(
+        jnp.float32
+    )
+    return tokens, ann
+
+
+def test_forward_shapes(key):
+    cfg = tiny_cfg()
+    params = proteinbert.init(key, cfg)
+    tokens, ann = make_batch(key, cfg)
+    local_logits, global_logits = jax.jit(
+        proteinbert.apply, static_argnames="cfg"
+    )(params, tokens, ann, cfg)
+    assert local_logits.shape == (4, 32, cfg.vocab_size)
+    assert global_logits.shape == (4, cfg.num_annotations)
+    assert local_logits.dtype == jnp.float32
+    assert np.isfinite(np.asarray(local_logits)).all()
+    assert np.isfinite(np.asarray(global_logits)).all()
+
+
+def test_heads_emit_logits_not_probs(key):
+    """Reference heads emit probabilities (modules.py:277-293, ledger #3);
+    ours must emit logits — i.e. per-position local outputs must not sum
+    to 1 under exp (they're unnormalized)."""
+    cfg = tiny_cfg()
+    params = proteinbert.init(key, cfg)
+    tokens, ann = make_batch(key, cfg)
+    local_logits, global_logits = proteinbert.apply(params, tokens, ann, cfg)
+    sums = np.asarray(jnp.exp(local_logits).sum(-1))
+    assert not np.allclose(sums, 1.0, atol=1e-3)
+    g = np.asarray(global_logits)
+    assert (g < 0).any() or (g > 1).any()
+
+
+def test_all_params_receive_gradients(key):
+    """Ledger #1 regression: the reference's attention-head params were
+    invisible to autograd (modules.py:73-81). Every leaf here must get a
+    nonzero gradient from a loss touching both heads."""
+    cfg = tiny_cfg()
+    params = proteinbert.init(key, cfg)
+    tokens, ann = make_batch(key, cfg)
+
+    def loss_fn(p):
+        l, g = proteinbert.apply(p, tokens, ann, cfg)
+        return jnp.abs(l).mean() + jnp.abs(g).mean()
+
+    grads = jax.grad(loss_fn)(params)
+    flat, _ = jax.tree_util.tree_flatten_with_path(grads)
+    for path, g in flat:
+        assert np.abs(np.asarray(g)).max() > 0, f"zero grad at {jax.tree_util.keystr(path)}"
+
+
+def test_length_parametric(key):
+    """Ledger #4 regression: one parameter set must serve multiple L
+    (the reference LayerNorm hard-codes L, modules.py:148-151)."""
+    cfg = tiny_cfg()
+    params = proteinbert.init(key, cfg)
+    for L in (16, 64, 128):
+        tokens, ann = make_batch(key, cfg, batch=2, seq_len=L)
+        local_logits, _ = proteinbert.apply(params, tokens, ann, cfg)
+        assert local_logits.shape == (2, L, cfg.vocab_size)
+
+
+def test_attention_softmax_over_sequence(key):
+    """Ledger #2 regression: softmax must run over L — attention weights
+    over the sequence sum to 1, verified indirectly: with V constant over
+    L, output must equal that constant row regardless of scores."""
+    B, L, C, G, H, k = 2, 10, 8, 16, 2, 4
+    params = global_attention_init(key, C, G, k, H)
+    local = jnp.broadcast_to(
+        jax.random.normal(key, (B, 1, C)), (B, L, C)
+    )  # constant over L
+    global_ = jax.random.normal(jax.random.fold_in(key, 1), (B, G))
+    out = global_attention_apply(params, local, global_)
+    v = jax.nn.gelu(jnp.einsum("blc,hcv->bhlv", local, params["wv"]))
+    expected = v[:, :, 0, :].reshape(B, G)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected), atol=1e-5)
+
+
+def test_attention_pad_masking(key):
+    """Padding positions must not influence the global track: outputs with
+    garbage in padded local positions must match outputs with zeros there."""
+    B, L, C, G, H, k = 2, 12, 8, 16, 2, 4
+    params = global_attention_init(key, C, G, k, H)
+    mask = jnp.array([[True] * 6 + [False] * 6] * B)
+    base = jax.random.normal(key, (B, L, C))
+    garbage = base + jnp.where(mask[..., None], 0.0, 100.0)
+    global_ = jax.random.normal(jax.random.fold_in(key, 1), (B, G))
+    out1 = global_attention_apply(params, base, global_, mask)
+    out2 = global_attention_apply(params, garbage, global_, mask)
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out2), atol=1e-5)
+
+
+def test_scan_matches_unrolled(key):
+    """lax.scan over stacked block params must equal the unrolled loop."""
+    cfg_scan = tiny_cfg(scan_blocks=True)
+    cfg_loop = tiny_cfg(scan_blocks=False)
+    params_loop = proteinbert.init(key, cfg_loop)
+    params_scan = dict(params_loop)
+    params_scan["blocks"] = jax.tree.map(
+        lambda *xs: jnp.stack(xs), *params_loop["blocks"]
+    )
+    tokens, ann = make_batch(key, cfg_scan)
+    out_s = proteinbert.apply(params_scan, tokens, ann, cfg_scan)
+    out_l = proteinbert.apply(params_loop, tokens, ann, cfg_loop)
+    for a, b in zip(out_s, out_l):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_remat_matches(key):
+    cfg = tiny_cfg()
+    cfg_r = tiny_cfg(remat=True)
+    params = proteinbert.init(key, cfg)
+    tokens, ann = make_batch(key, cfg)
+
+    def loss(p, c):
+        l, g = proteinbert.apply(p, tokens, ann, c)
+        return jnp.abs(l).mean() + jnp.abs(g).mean()
+
+    g1 = jax.grad(loss)(params, cfg)
+    g2 = jax.grad(loss)(params, cfg_r)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5
+        ),
+        g1,
+        g2,
+    )
+
+
+def test_param_count_scales():
+    cfg = tiny_cfg()
+    p = proteinbert.init(jax.random.PRNGKey(0), cfg)
+    n = proteinbert.param_count(p)
+    assert n > 0
+    cfg_big = tiny_cfg(num_blocks=4)
+    p_big = proteinbert.init(jax.random.PRNGKey(0), cfg_big)
+    assert proteinbert.param_count(p_big) > n
+
+
+def test_bfloat16_activations(key):
+    """bf16 path stays finite and heads still return fp32."""
+    cfg = tiny_cfg(dtype="bfloat16")
+    params = proteinbert.init(key, cfg)
+    tokens, ann = make_batch(key, cfg)
+    l, g = proteinbert.apply(params, tokens, ann, cfg)
+    assert l.dtype == jnp.float32 and g.dtype == jnp.float32
+    assert np.isfinite(np.asarray(l)).all()

@@ -1,0 +1,80 @@
+"""Benchmark: base-model pretraining throughput on the available chip(s).
+
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+
+Metric: residues/sec/chip on the BASELINE.json base config (6 blocks,
+d=512, seq_len 512) denoising pretrain, synthetic data (the reference has
+no published numbers to compare against — BASELINE.md; vs_baseline is
+therefore measured MFU / the 0.40 north-star MFU target, so 1.0 means
+"hit the ≥40% MFU goal").
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    from proteinbert_tpu.configs import (
+        DataConfig, ModelConfig, OptimizerConfig, PretrainConfig, TrainConfig,
+    )
+    from proteinbert_tpu.train import create_train_state, train_step
+    from proteinbert_tpu.train.metrics import (
+        peak_flops_per_chip, train_flops,
+    )
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    # Base config per BASELINE.json configs[1]; batch sized for one chip.
+    if on_tpu:
+        model = ModelConfig(local_dim=512, global_dim=512, key_dim=64,
+                            num_heads=8, num_blocks=6, dtype="bfloat16")
+        batch, seq_len, steps = 64, 512, 30
+    else:  # CPU fallback so the script always emits its line
+        model = ModelConfig(local_dim=64, global_dim=128, key_dim=16,
+                            num_heads=4, num_blocks=2, num_annotations=512,
+                            dtype="float32")
+        batch, seq_len, steps = 8, 128, 5
+
+    cfg = PretrainConfig(
+        model=model,
+        data=DataConfig(seq_len=seq_len, batch_size=batch),
+        optimizer=OptimizerConfig(warmup_steps=100),
+        train=TrainConfig(max_steps=steps),
+    )
+
+    rng = np.random.default_rng(0)
+    batch_np = {
+        "tokens": rng.integers(4, 26, size=(batch, seq_len)).astype(np.int32),
+        "annotations": (rng.random((batch, model.num_annotations)) < 0.01
+                        ).astype(np.float32),
+    }
+    state = create_train_state(jax.random.PRNGKey(0), cfg)
+    dbatch = jax.device_put(batch_np)
+
+    # Warmup/compile.
+    state, m = train_step(state, dbatch, cfg)
+    jax.block_until_ready(m["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, m = train_step(state, dbatch, cfg)
+    jax.block_until_ready(m["loss"])
+    dt = time.perf_counter() - t0
+
+    steps_per_sec = steps / dt
+    residues_per_sec = steps_per_sec * batch * seq_len
+    mfu = steps_per_sec * train_flops(model, batch, seq_len) / peak_flops_per_chip()
+
+    print(json.dumps({
+        "metric": "residues_per_sec_per_chip",
+        "value": round(residues_per_sec, 1),
+        "unit": "residues/s",
+        "vs_baseline": round(mfu / 0.40, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -65,11 +65,15 @@ class InMemoryPretrainingDataset:
         self.tokens = tokenize_batch(seqs, seq_len)
         if crop_rng is not None:
             # Only long rows need per-access re-tokenization; short rows
-            # always come from the dense cache.
-            self._seqs = list(seqs)
-            self._long = np.array([len(s) > seq_len - 2 for s in seqs])
+            # always come from the dense cache, and only long rows' raw
+            # strings are retained.
+            self._long_seqs = {
+                i: s for i, s in enumerate(seqs) if len(s) > seq_len - 2
+            }
+            self._long = np.zeros(len(seqs), dtype=bool)
+            self._long[list(self._long_seqs)] = True
         else:
-            self._seqs = None
+            self._long_seqs = None
             self._long = None
         self.annotations = annotations.astype(np.float32)
 
@@ -78,7 +82,7 @@ class InMemoryPretrainingDataset:
 
     def __getitem__(self, i) -> Dict[str, np.ndarray]:
         if self._long is not None and self._long[i]:
-            tok = tokenize_batch([self._seqs[i]], self.seq_len, self.crop_rng)[0]
+            tok = tokenize_batch([self._long_seqs[i]], self.seq_len, self.crop_rng)[0]
         else:
             tok = self.tokens[i]
         return {"tokens": tok, "annotations": self.annotations[i]}
@@ -87,11 +91,11 @@ class InMemoryPretrainingDataset:
         """Vectorized gather; long rows re-cropped per access if crop_rng."""
         tokens = self.tokens[idx]
         if self._long is not None:
-            for pos, i in enumerate(idx):
-                if self._long[i]:
-                    tokens[pos] = tokenize_batch(
-                        [self._seqs[int(i)]], self.seq_len, self.crop_rng
-                    )[0]
+            for pos in np.flatnonzero(self._long[idx]):
+                i = int(idx[pos])
+                tokens[pos] = tokenize_batch(
+                    [self._long_seqs[i]], self.seq_len, self.crop_rng
+                )[0]
         return {"tokens": tokens, "annotations": self.annotations[idx]}
 
 
@@ -203,6 +207,7 @@ def make_pretrain_iterator(
     num_epochs: Optional[int] = None,
     process_index: int = 0,
     process_count: int = 1,
+    skip_batches: int = 0,
 ) -> Iterator[Dict[str, np.ndarray]]:
     """Infinite (or num_epochs-bounded) per-host sharded batch iterator.
 
@@ -216,6 +221,15 @@ def make_pretrain_iterator(
 
     Raises if the per-host shard can't fill one batch (a silent empty
     iterator would busy-loop forever in the num_epochs=None case).
+
+    `skip_batches` fast-forwards past already-consumed batches on
+    checkpoint resume WITHOUT loading their data — only the (cheap) epoch
+    permutations are replayed, so the resumed run sees the same ROW
+    INDICES it would have seen uninterrupted (byte-identical batches too,
+    unless the dataset re-crops with its own crop_rng, whose state is not
+    advanced by skipping nor checkpointed). The reference resumes the
+    iteration counter but replays data from scratch (reference
+    utils.py:267-282).
     """
     n = len(dataset)
     per_host = n // process_count
@@ -231,10 +245,13 @@ def make_pretrain_iterator(
     while num_epochs is None or epoch < num_epochs:
         order = _epoch_order(n, rng, shuffle, block)[: per_host * process_count]
         # Contiguous split (not strided): keeps the block-local runs of
-        # _epoch_order intact per host, so each HDF5 block is read and
-        # decoded by exactly one host instead of all of them.
+        # _epoch_order intact per host, so each HDF5 block is read by one
+        # host (two at a shard boundary) instead of all of them.
         shard = order[process_index * per_host : (process_index + 1) * per_host]
         for lo in range(0, per_host - batch_size + 1, batch_size):
+            if skip_batches > 0:
+                skip_batches -= 1
+                continue
             idx = shard[lo : lo + batch_size]
             if get_batch is not None:
                 yield get_batch(idx)

@@ -63,7 +63,7 @@ Params = Dict[str, Any]
 def block_init(key: jax.Array, cfg: ModelConfig) -> Params:
     """One dual-track block's parameters (reference modules.py:95-199)."""
     C, G = cfg.local_dim, cfg.global_dim
-    ks = jax.random.split(key, 8)
+    ks = jax.random.split(key, 7)
     return {
         "narrow_conv": conv1d_init(ks[0], cfg.narrow_kernel, C, C),
         "wide_conv": conv1d_init(ks[1], cfg.wide_kernel, C, C),

@@ -1,0 +1,13 @@
+from proteinbert_tpu.parallel.mesh import make_mesh, mesh_for_devices
+from proteinbert_tpu.parallel.sharding import (
+    batch_sharding, state_sharding, shard_train_state,
+)
+from proteinbert_tpu.parallel.halo import (
+    halo_exchange, conv1d_halo, seq_parallel_conv1d,
+)
+
+__all__ = [
+    "make_mesh", "mesh_for_devices",
+    "batch_sharding", "state_sharding", "shard_train_state",
+    "halo_exchange", "conv1d_halo", "seq_parallel_conv1d",
+]

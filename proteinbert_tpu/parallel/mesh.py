@@ -1,0 +1,64 @@
+"""Device-mesh construction (SURVEY C18 — absent in the reference).
+
+The reference is single-device PyTorch with no torch.distributed anywhere
+(grep-verified, SURVEY §2 C18). This module supplies the distributed
+substrate TPU-natively: a `jax.sharding.Mesh` over the ICI fabric with
+four logical axes —
+
+  data  : pure data parallelism (gradient psum)
+  fsdp  : parameter/optimizer sharding over a data-like axis
+          (batch is sharded over data×fsdp jointly)
+  model : tensor parallelism for the G×A annotation head (SURVEY §7
+          hard-part (e))
+  seq   : sequence parallelism for the local conv track (XLA inserts
+          conv halo exchanges; see also parallel/halo.py for the
+          explicit shard_map version)
+
+For multi-slice topologies, put 'data' outermost so the gradient
+all-reduce's top level rides DCN while fsdp/model/seq collectives stay
+on intra-slice ICI (scaling-book recipe).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from proteinbert_tpu.configs import MeshConfig
+
+
+def make_mesh(
+    cfg: MeshConfig, devices: Optional[Sequence[jax.Device]] = None
+) -> Mesh:
+    """Build the (data, fsdp, model, seq) mesh from available devices.
+
+    Uses jax.experimental.mesh_utils device ordering on real TPU slices so
+    mesh-adjacent devices are ICI-adjacent; falls back to a plain reshape
+    on CPU/virtual platforms.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if cfg.num_devices != n:
+        raise ValueError(
+            f"mesh {cfg.shape} wants {cfg.num_devices} devices, have {n}"
+        )
+    if devices[0].platform == "tpu":
+        try:
+            from jax.experimental import mesh_utils
+
+            dev_array = mesh_utils.create_device_mesh(cfg.shape, devices=devices)
+            return Mesh(dev_array, cfg.axis_names)
+        except Exception:  # pragma: no cover - topology helpers can be picky
+            pass
+    dev_array = np.asarray(devices).reshape(cfg.shape)
+    return Mesh(dev_array, cfg.axis_names)
+
+
+def mesh_for_devices(n: int, data: Optional[int] = None, **axes) -> Mesh:
+    """Convenience: an n-device mesh, defaulting all parallelism to data."""
+    cfg = MeshConfig(data=data if data is not None else n, **axes)
+    return make_mesh(cfg, jax.devices()[:cfg.num_devices])

@@ -1,0 +1,92 @@
+"""NamedSharding rules for train state and batches (SURVEY C18/C19).
+
+The reference has no parallelism of any kind; these rules define how this
+framework lays out the ProteinBERT train state and input batches over the
+(data, fsdp, model, seq) mesh:
+
+- batch tokens (B, L): B over (data, fsdp), L over seq — sequence
+  parallelism enters at the input and propagates through the conv stack
+  (XLA adds halo exchange) and the attention softmax (psum over seq).
+- batch annotations (B, A): B over (data, fsdp); the 8943-dim annotation
+  vector stays whole per example.
+- params: tensor parallelism on the two A-sized matmuls — `global_head`
+  kernel (G, A) column-sharded and `global_in` kernel (A, G) row-sharded
+  over 'model' (the A dim is the big one, SURVEY §7 hard-part (e));
+  everything else ≥2D is FSDP-sharded over 'fsdp' on its largest
+  divisible axis (skipping the stacked-block leading N axis), scalars and
+  vectors replicated.
+- optimizer state: Adam's mu/nu mirror the params tree structure, so the
+  same path-driven rule applies (their tree paths contain the param
+  paths).
+
+All rules are resolved from an ABSTRACT pytree (jax.eval_shape) so no
+memory is allocated before shardings are known.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def batch_sharding(mesh: Mesh) -> Dict[str, NamedSharding]:
+    return {
+        "tokens": NamedSharding(mesh, P(("data", "fsdp"), "seq")),
+        "annotations": NamedSharding(mesh, P(("data", "fsdp"), None)),
+    }
+
+
+def _path_has(path, name: str) -> bool:
+    for p in path:
+        key = getattr(p, "key", None)
+        if key is None:
+            key = getattr(p, "name", None)
+        if key == name:
+            return True
+    return False
+
+
+def _leaf_spec(path, leaf, mesh: Mesh) -> P:
+    shape = leaf.shape
+    model_n = mesh.shape.get("model", 1)
+    fsdp_n = mesh.shape.get("fsdp", 1)
+
+    # Tensor parallelism over the annotation dimension A.
+    if model_n > 1 and _path_has(path, "global_head"):
+        if len(shape) >= 1 and shape[-1] % model_n == 0:
+            return P(*([None] * (len(shape) - 1) + ["model"]))
+    if model_n > 1 and _path_has(path, "global_in") and _path_has(path, "kernel"):
+        if len(shape) >= 2 and shape[-2] % model_n == 0:
+            return P(*([None] * (len(shape) - 2) + ["model", None]))
+
+    # FSDP: shard the largest divisible axis of big tensors; never the
+    # stacked-blocks leading axis (it is num_blocks-sized).
+    if fsdp_n > 1 and len(shape) >= 2:
+        start = 1 if _path_has(path, "blocks") else 0
+        axes = sorted(
+            range(start, len(shape)), key=lambda i: shape[i], reverse=True
+        )
+        for ax in axes:
+            if shape[ax] % fsdp_n == 0 and shape[ax] >= 2 * fsdp_n:
+                spec = [None] * len(shape)
+                spec[ax] = "fsdp"
+                return P(*spec)
+    return P()
+
+
+def state_sharding(mesh: Mesh, abstract_state: Any) -> Any:
+    """NamedSharding pytree matching `abstract_state` (from jax.eval_shape)."""
+    def rule(path, leaf):
+        if not hasattr(leaf, "shape") or len(getattr(leaf, "shape", ())) == 0:
+            return NamedSharding(mesh, P())
+        return NamedSharding(mesh, _leaf_spec(path, leaf, mesh))
+
+    return jax.tree_util.tree_map_with_path(rule, abstract_state)
+
+
+def shard_train_state(state: Any, mesh: Mesh) -> Any:
+    """Place a concrete TrainState onto the mesh per `state_sharding`."""
+    shardings = state_sharding(mesh, jax.eval_shape(lambda: state))
+    return jax.device_put(state, shardings)

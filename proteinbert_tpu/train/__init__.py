@@ -1,0 +1,17 @@
+from proteinbert_tpu.train.loss import pretrain_loss
+from proteinbert_tpu.train.schedule import make_schedule, make_optimizer, needs_loss_value
+from proteinbert_tpu.train.train_state import (
+    TrainState, create_train_state, train_step, eval_step,
+)
+from proteinbert_tpu.train.metrics import (
+    forward_flops, train_flops, peak_flops_per_chip, StepTimer,
+)
+from proteinbert_tpu.train.checkpoint import Checkpointer
+from proteinbert_tpu.train.trainer import pretrain
+
+__all__ = [
+    "pretrain_loss", "make_schedule", "make_optimizer", "needs_loss_value",
+    "TrainState", "create_train_state", "train_step", "eval_step",
+    "forward_flops", "train_flops", "peak_flops_per_chip", "StepTimer",
+    "Checkpointer", "pretrain",
+]

@@ -1,0 +1,118 @@
+"""Step-time / throughput / MFU accounting.
+
+The reference logs raw per-iteration wall-clock only (reference
+utils.py:284,306-313). The north-star metric for this build is
+residues/sec/chip and MFU (BASELINE.json), which needs an analytic FLOPs
+model of the conv+attention hybrid — per-block shapes in SURVEY §3.4.
+
+All matmul/conv terms count 2·MACs; training ≈ 3× forward (fwd + 2×bwd).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+import jax
+
+from proteinbert_tpu.configs import ModelConfig
+
+# Peak dense FLOPs/s per chip (bf16), by jax device_kind substring.
+PEAK_FLOPS = {
+    "v5 lite": 197e12,     # TPU v5e
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v4": 275e12,
+    "v6 lite": 918e12,     # TPU v6e (Trillium)
+    "v6e": 918e12,
+    "cpu": 5e11,           # nominal, for smoke-test MFU sanity only
+}
+
+
+def forward_flops(cfg: ModelConfig, batch: int, seq_len: int) -> float:
+    """Analytic forward-pass FLOPs (2·MACs) for one batch."""
+    B, L = batch, seq_len
+    C, G, A = cfg.local_dim, cfg.global_dim, cfg.num_annotations
+    H, k = cfg.num_heads, cfg.key_dim
+    v = cfg.value_dim
+    K = cfg.narrow_kernel
+
+    per_block = (
+        2 * B * L * K * C * C          # narrow conv (modules.py:126 analogue)
+        + 2 * B * L * cfg.wide_kernel * C * C  # wide dilated conv
+        + 2 * B * G * C                # global->local broadcast dense
+        + 2 * B * L * C * C            # local residual dense
+        + 2 * B * G * G                # global dense 1
+        + 2 * B * H * G * k            # attention q
+        + 2 * B * L * H * C * k        # attention K
+        + 2 * B * L * H * C * v        # attention V
+        + 2 * B * H * L * k            # scores
+        + 2 * B * H * L * v            # weighted sum
+        + 2 * B * G * G                # global dense 2
+    )
+    io = (
+        2 * B * A * G                  # global input dense
+        + 2 * B * L * C * cfg.vocab_size   # local head
+        + 2 * B * G * A                # global head
+    )
+    return float(cfg.num_blocks * per_block + io)
+
+
+def train_flops(cfg: ModelConfig, batch: int, seq_len: int) -> float:
+    return 3.0 * forward_flops(cfg, batch, seq_len)
+
+
+def peak_flops_per_chip(device: Optional[jax.Device] = None) -> float:
+    if device is None:
+        device = jax.devices()[0]
+    kind = device.device_kind.lower()
+    for pat, val in PEAK_FLOPS.items():
+        if pat in kind:
+            return val
+    return PEAK_FLOPS["cpu"]
+
+
+class StepTimer:
+    """Wall-clock meter → steps/s, residues/s/chip, MFU.
+
+    `update()` once per host-side step loop iteration; the first
+    `warmup_steps` are excluded (compile + cache warmup).
+    """
+
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        batch: int,
+        seq_len: int,
+        n_chips: int = 1,
+        warmup_steps: int = 2,
+    ):
+        self.flops_per_step = train_flops(cfg, batch, seq_len)
+        self.residues_per_step = batch * seq_len
+        self.n_chips = max(n_chips, 1)
+        self.warmup_steps = warmup_steps
+        self.peak = peak_flops_per_chip()
+        self._count = 0
+        self._t0 = None
+        self._steps_timed = 0
+
+    def update(self) -> None:
+        self._count += 1
+        if self._count == self.warmup_steps:
+            self._t0 = time.perf_counter()
+        elif self._count > self.warmup_steps:
+            self._steps_timed = self._count - self.warmup_steps
+
+    def summary(self) -> Dict[str, float]:
+        if not self._steps_timed or self._t0 is None:
+            return {}
+        dt = time.perf_counter() - self._t0
+        steps_per_sec = self._steps_timed / dt
+        flops_per_sec = steps_per_sec * self.flops_per_step
+        return {
+            "steps_per_sec": steps_per_sec,
+            "step_ms": 1000.0 / steps_per_sec,
+            "residues_per_sec_per_chip": steps_per_sec
+            * self.residues_per_step / self.n_chips,
+            "mfu": flops_per_sec / (self.peak * self.n_chips),
+        }

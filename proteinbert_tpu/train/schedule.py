@@ -1,0 +1,67 @@
+"""LR schedules + optimizer factory (reference utils.py:257-264, fixed).
+
+The reference chains a LambdaLR linear warmup into ReduceLROnPlateau via
+SequentialLR; plateau's `step()` needs a metric, so the post-warmup phase
+would crash the run at iteration `warmup_duration` (SURVEY ledger #7 —
+latent because the smoke run stops at 250). Here:
+
+- "warmup_cosine": optax warmup_cosine_decay — the recommended default.
+- "warmup_plateau": linear warmup composed with
+  `optax.contrib.reduce_on_plateau`, the working version of what the
+  reference intended; the plateau transform consumes the loss through
+  optax's injected-hyperparams extra-args mechanism (pass `value=loss` to
+  `update`).
+- "constant": flat LR after warmup.
+
+All variants are wrapped with global-norm clipping (reference
+utils.py:136) and Adam(b1,b2) (reference dummy_tests.py:127-130).
+"""
+
+from __future__ import annotations
+
+import optax
+
+from proteinbert_tpu.configs import OptimizerConfig
+
+
+def make_schedule(cfg: OptimizerConfig):
+    warmup = optax.linear_schedule(0.0, cfg.learning_rate, cfg.warmup_steps)
+    if cfg.schedule == "warmup_cosine":
+        return optax.warmup_cosine_decay_schedule(
+            init_value=0.0,
+            peak_value=cfg.learning_rate,
+            warmup_steps=cfg.warmup_steps,
+            decay_steps=max(cfg.total_steps, cfg.warmup_steps + 1),
+        )
+    if cfg.schedule in ("warmup_plateau", "constant"):
+        return optax.join_schedules(
+            [warmup, optax.constant_schedule(cfg.learning_rate)],
+            [cfg.warmup_steps],
+        )
+    raise ValueError(f"unknown schedule {cfg.schedule!r}")
+
+
+def make_optimizer(cfg: OptimizerConfig) -> optax.GradientTransformation:
+    """Clip → Adam(schedule) [→ plateau scaling]. Returns a transformation
+    whose `update` accepts `value=` when schedule == 'warmup_plateau'."""
+    schedule = make_schedule(cfg)
+    if cfg.weight_decay > 0:
+        adam = optax.adamw(
+            schedule, b1=cfg.b1, b2=cfg.b2, weight_decay=cfg.weight_decay
+        )
+    else:
+        adam = optax.adam(schedule, b1=cfg.b1, b2=cfg.b2)
+    chain = [optax.clip_by_global_norm(cfg.grad_clip_norm), adam]
+    if cfg.schedule == "warmup_plateau":
+        chain.append(
+            optax.contrib.reduce_on_plateau(
+                factor=cfg.plateau_factor,
+                patience=cfg.plateau_patience,
+            )
+        )
+    return optax.chain(*chain)
+
+
+def needs_loss_value(cfg: OptimizerConfig) -> bool:
+    """True if the optimizer's update requires `value=loss` (plateau)."""
+    return cfg.schedule == "warmup_plateau"

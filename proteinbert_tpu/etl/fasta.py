@@ -1,0 +1,121 @@
+"""Random-access FASTA reader (replaces the reference's pyfaidx dependency).
+
+The reference joins SQLite annotation records to sequences through
+`pyfaidx.Faidx` (reference uniref_dataset.py:299-313). pyfaidx is not in
+this image, and the join only ever needs whole-record fetches by id — so
+this is a minimal two-level design: an index pass recording
+(byte offset, sequence length, line layout) per record in `.fai` format
+(samtools-compatible: name, rlen, offset, line bases, line bytes), and an
+O(1) fetch that seeks and strips newlines. Gzip inputs are supported for
+indexing by streaming (no random access; `fetch` requires the plain file).
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+from typing import Dict, Iterator, Tuple
+
+
+def _open_text(path: str):
+    if path.endswith(".gz"):
+        return gzip.open(path, "rt")
+    return open(path, "r")
+
+
+def iter_fasta(path: str) -> Iterator[Tuple[str, str]]:
+    """Stream (name, sequence) pairs; name is the first word of the header."""
+    name, parts = None, []
+    with _open_text(path) as f:
+        for line in f:
+            line = line.rstrip("\n")
+            if line.startswith(">"):
+                if name is not None:
+                    yield name, "".join(parts)
+                name, parts = line[1:].split()[0] if len(line) > 1 else "", []
+            elif line:
+                parts.append(line)
+        if name is not None:
+            yield name, "".join(parts)
+
+
+def build_index(fasta_path: str, index_path: str | None = None) -> str:
+    """Write a samtools-style .fai index; returns its path."""
+    index_path = index_path or fasta_path + ".fai"
+    with open(fasta_path, "rb") as f, open(index_path, "w") as out:
+        name = None
+        rlen = 0
+        seq_offset = 0
+        line_bases = 0
+        line_bytes = 0
+        offset = 0
+        for raw in f:
+            if raw.startswith(b">"):
+                if name is not None:
+                    out.write(f"{name}\t{rlen}\t{seq_offset}\t{line_bases}\t{line_bytes}\n")
+                header = raw[1:].split()
+                name = header[0].decode() if header else ""
+                rlen = 0
+                line_bases = 0
+                line_bytes = 0
+                seq_offset = offset + len(raw)
+            else:
+                stripped = raw.rstrip(b"\r\n")
+                if stripped:
+                    if line_bases == 0:
+                        line_bases = len(stripped)
+                        line_bytes = len(raw)
+                    rlen += len(stripped)
+            offset += len(raw)
+        if name is not None:
+            out.write(f"{name}\t{rlen}\t{seq_offset}\t{line_bases}\t{line_bytes}\n")
+    return index_path
+
+
+class FastaReader:
+    """O(1) whole-record fetch by id over an indexed plain-text FASTA."""
+
+    def __init__(self, fasta_path: str):
+        if fasta_path.endswith(".gz"):
+            raise ValueError(
+                "random access needs an uncompressed FASTA; gunzip first "
+                "(indexing via iter_fasta works on .gz)"
+            )
+        fai = fasta_path + ".fai"
+        if not os.path.exists(fai):
+            build_index(fasta_path, fai)
+        self.index: Dict[str, Tuple[int, int, int, int]] = {}
+        with open(fai) as f:
+            for line in f:
+                name, rlen, off, lb, lw = line.rstrip("\n").split("\t")
+                self.index[name] = (int(rlen), int(off), int(lb), int(lw))
+        self._f = open(fasta_path, "rb")
+
+    def __contains__(self, name: str) -> bool:
+        return name in self.index
+
+    def __len__(self) -> int:
+        return len(self.index)
+
+    def length(self, name: str) -> int:
+        return self.index[name][0]
+
+    def fetch(self, name: str) -> str:
+        """Full sequence for `name` (KeyError if absent, like pyfaidx)."""
+        rlen, off, line_bases, line_bytes = self.index[name]
+        if rlen == 0:
+            return ""
+        n_full = (rlen - 1) // line_bases if line_bases else 0
+        span = rlen + n_full * (line_bytes - line_bases)
+        self._f.seek(off)
+        raw = self._f.read(span)
+        return raw.replace(b"\n", b"").replace(b"\r", b"").decode()
+
+    def close(self) -> None:
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

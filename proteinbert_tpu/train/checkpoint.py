@@ -49,13 +49,11 @@ class Checkpointer:
         if step is None:
             return None, None
         abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, state_like)
-        restored = self._mngr.restore(
-            step,
-            args=ocp.args.Composite(
-                state=ocp.args.StandardRestore(abstract),
-                data=ocp.args.JsonRestore(),
-            ),
-        )
+        args = {"state": ocp.args.StandardRestore(abstract)}
+        # 'data' is optional at save time; requesting an absent item raises.
+        if "data" in (self._mngr.item_metadata(step) or {}):
+            args["data"] = ocp.args.JsonRestore()
+        restored = self._mngr.restore(step, args=ocp.args.Composite(**args))
         return restored["state"], restored.get("data")
 
     def latest_step(self) -> Optional[int]:

@@ -94,6 +94,7 @@ class StepTimer:
         self.peak = peak_flops_per_chip()
         self._count = 0
         self._t0 = None
+        self._t_last = None
         self._steps_timed = 0
 
     def update(self) -> None:
@@ -102,11 +103,15 @@ class StepTimer:
             self._t0 = time.perf_counter()
         elif self._count > self.warmup_steps:
             self._steps_timed = self._count - self.warmup_steps
+            # Snapshot here, not in summary(): work done AFTER the last
+            # step (final checkpoint save, host teardown) must not
+            # deflate the reported throughput/MFU.
+            self._t_last = time.perf_counter()
 
     def summary(self) -> Dict[str, float]:
         if not self._steps_timed or self._t0 is None:
             return {}
-        dt = time.perf_counter() - self._t0
+        dt = self._t_last - self._t0
         steps_per_sec = self._steps_timed / dt
         flops_per_sec = steps_per_sec * self.flops_per_step
         return {

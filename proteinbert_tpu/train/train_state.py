@@ -25,6 +25,7 @@ from typing import Any, Dict, Tuple
 import flax.struct
 import jax
 import jax.numpy as jnp
+import optax
 
 from proteinbert_tpu.configs import PretrainConfig
 from proteinbert_tpu.models import proteinbert
@@ -87,7 +88,7 @@ def train_step(
                           state.params, updates)
 
     metrics = dict(metrics)
-    metrics["grad_norm"] = optax_global_norm(grads)
+    metrics["grad_norm"] = optax.global_norm(grads)
     new_state = TrainState(
         step=state.step + 1, params=params, opt_state=opt_state, key=key
     )
@@ -115,8 +116,3 @@ def eval_step(
     )
     _, metrics = pretrain_loss(local_logits, global_logits, Y, W)
     return metrics
-
-
-def optax_global_norm(tree: Any) -> jax.Array:
-    leaves = jax.tree.leaves(tree)
-    return jnp.sqrt(sum(jnp.sum(jnp.square(x.astype(jnp.float32))) for x in leaves))

@@ -17,7 +17,7 @@ What changed vs the reference `pretrain()`:
 from __future__ import annotations
 
 import logging
-from typing import Any, Dict, Iterator, Optional
+from typing import Any, Dict, Optional
 
 import jax
 import numpy as np
@@ -32,7 +32,7 @@ logger = logging.getLogger(__name__)
 
 def pretrain(
     cfg: PretrainConfig,
-    batch_iterator: Iterator[Dict[str, np.ndarray]],
+    batch_iterator,
     state: Optional[ts.TrainState] = None,
     checkpointer: Optional[Checkpointer] = None,
     mesh: Optional[jax.sharding.Mesh] = None,
@@ -42,8 +42,13 @@ def pretrain(
 
     Args:
       cfg: full config (model/data/optimizer/train/checkpoint).
-      batch_iterator: yields CLEAN {"tokens","annotations"} numpy batches
-        (per-host shards under multi-host).
+      batch_iterator: either an iterator of CLEAN {"tokens","annotations"}
+        numpy batches (per-host shards under multi-host), or — preferred
+        when resuming — a callable `(skip_batches: int) -> iterator` so a
+        restored run can fast-forward the data stream without loading the
+        already-consumed batches (see make_pretrain_iterator's
+        skip_batches). A plain iterator on resume falls back to draining
+        the consumed batches one by one.
       state: resume state; fresh-initialized if None (and restored from
         `checkpointer` if it has a saved step).
       checkpointer: optional; enables save/restore at
@@ -52,11 +57,26 @@ def pretrain(
         axis (and train state per parallel/sharding.py rules).
       log_fn: optional callable(step, metrics_dict) for external loggers.
     """
+    batches_consumed = 0
     if state is None:
         state = ts.create_train_state(jax.random.PRNGKey(cfg.train.seed), cfg)
         if checkpointer is not None and checkpointer.latest_step() is not None:
-            state, _data = checkpointer.restore(state)
-            logger.info("resumed from checkpoint at step %d", int(state.step))
+            state, data_state = checkpointer.restore(state)
+            batches_consumed = int((data_state or {}).get("batches_consumed", 0))
+            logger.info("resumed from checkpoint at step %d (%d batches consumed)",
+                        int(state.step), batches_consumed)
+
+    if callable(batch_iterator):
+        batch_iterator = batch_iterator(batches_consumed)
+    elif batches_consumed:
+        # Keep the resumed run on the same data stream position it would
+        # have had uninterrupted (the reference replays from scratch,
+        # reference utils.py:267-282).
+        logger.warning(
+            "resuming with a plain iterator: draining %d consumed batches "
+            "(pass a factory to skip them for free)", batches_consumed)
+        for _ in range(batches_consumed):
+            next(batch_iterator)
 
     put = _make_batch_put(mesh)
 

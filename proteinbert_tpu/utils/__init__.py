@@ -1,0 +1,20 @@
+"""Host-side utilities: logging, profiling, ETL sharding, h5 helpers
+(reference C17/C20/C21, rebuilt — see each module's docstring)."""
+
+from proteinbert_tpu.utils.logging import log, start_log
+from proteinbert_tpu.utils.profiling import Profiler, TimeMeasure, device_trace
+from proteinbert_tpu.utils.sharding import (
+    all_shard_file_names,
+    shard_file_name,
+    shard_items,
+    shard_range,
+    task_identity,
+    to_chunks,
+)
+
+__all__ = [
+    "log", "start_log",
+    "Profiler", "TimeMeasure", "device_trace",
+    "to_chunks", "shard_range", "shard_items", "task_identity",
+    "shard_file_name", "all_shard_file_names",
+]

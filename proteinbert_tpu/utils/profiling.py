@@ -1,0 +1,87 @@
+"""Wall-clock + device profiling (reference C20, TPU-aware).
+
+The reference ships `TimeMeasure` (a with-block wall-clock logger,
+shared_utils/util.py:1212-1223) and `Profiler` (named aggregating
+time/invoke counters, shared_utils/util.py:1226-1263). Both are kept —
+they are genuinely useful on the host side — and joined by
+`device_trace()`, a thin wrapper over `jax.profiler` that captures an XLA
+trace viewable in TensorBoard/Perfetto, which is the real profiling story
+on TPU (per-op time lives on device, invisible to host timers).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, Optional
+
+from proteinbert_tpu.utils.logging import log
+
+
+class TimeMeasure:
+    """`with TimeMeasure('phase'):` — logs elapsed wall-clock on exit."""
+
+    def __init__(self, name: str = "", verbose: bool = True):
+        self.name = name
+        self.verbose = verbose
+        self.elapsed: Optional[float] = None
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.elapsed = time.perf_counter() - self._t0
+        if self.verbose:
+            log(f"{self.name or 'block'}: {self.elapsed:.3f}s")
+        return False
+
+
+class Profiler:
+    """Named aggregating profiler: total time + invoke count per name."""
+
+    def __init__(self):
+        self._totals: Dict[str, float] = {}
+        self._counts: Dict[str, int] = {}
+
+    @contextlib.contextmanager
+    def measure(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self._totals[name] = self._totals.get(name, 0.0) + dt
+            self._counts[name] = self._counts.get(name, 0) + 1
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        return {
+            name: {
+                "total_s": self._totals[name],
+                "count": self._counts[name],
+                "mean_s": self._totals[name] / self._counts[name],
+            }
+            for name in self._totals
+        }
+
+    def report(self) -> str:
+        rows = sorted(self._totals.items(), key=lambda kv: -kv[1])
+        return "\n".join(
+            f"{name}: {total:.3f}s / {self._counts[name]} calls "
+            f"({total / self._counts[name] * 1e3:.2f} ms each)"
+            for name, total in rows
+        )
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str, host_profile: bool = False):
+    """Capture a jax.profiler trace (XLA ops, HBM, fusion view) to
+    `log_dir`; open with TensorBoard or ui.perfetto.dev."""
+    import jax
+
+    jax.profiler.start_trace(log_dir, create_perfetto_trace=host_profile)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+        log(f"device trace written to {log_dir}")

@@ -1,0 +1,268 @@
+"""End-to-end + unit tests for the offline ETL (reference C1-C4 parity).
+
+Fixtures are synthetic miniatures of the real inputs: an OBO-style GO
+file (the CAFA go.txt format, reference uniref_dataset.py:158-198), a
+UniRef90-shaped XML (reference uniref_dataset.py:76-98 element layout),
+and a FASTA of representative sequences keyed UniRef90_<accession>.
+"""
+
+import gzip
+import json
+import sqlite3
+
+import numpy as np
+import pytest
+
+from proteinbert_tpu.etl import (
+    FastaReader,
+    UnirefToSqliteParser,
+    create_h5_dataset,
+    iter_fasta,
+    load_seqs_and_annotations,
+    merge_shard_dbs,
+    parse_obo,
+    read_aggregates,
+    save_meta_csv,
+)
+
+# DAG: root → a → b, root → c; d is an orphan root.
+GO_TXT = """\
+[Term]
+id: GO:0000001
+name: root
+namespace: molecular_function
+
+[Term]
+id: GO:0000002
+name: a
+namespace: molecular_function
+is_a: GO:0000001 ! root
+
+[Term]
+id: GO:0000003
+name: b
+namespace: molecular_function
+is_a: GO:0000002 ! a
+
+[Term]
+id: GO:0000004
+name: c
+namespace: molecular_function
+is_a: GO:0000001 ! root
+
+[Term]
+id: GO:0000005
+name: d
+namespace: biological_process
+"""
+
+_XML_ENTRY = """\
+  <entry id="UniRef90_{acc}" updated="2020-01-01">
+    <name>Cluster: protein {acc}</name>
+    <representativeMember>
+      <dbReference type="UniProtKB ID" id="{acc}_HUMAN">
+        <property type="NCBI taxonomy" value="{tax}"/>
+{props}
+      </dbReference>
+      <sequence length="{length}">IGNORED</sequence>
+    </representativeMember>
+  </entry>
+"""
+
+
+def _make_xml(records):
+    """records: list of (accession, tax, go_ids_by_category)."""
+    entries = []
+    for acc, tax, gos in records:
+        props = "\n".join(
+            f'        <property type="{cat}" value="{gid}"/>'
+            for cat, gids in gos.items() for gid in gids
+        )
+        entries.append(_XML_ENTRY.format(acc=acc, tax=tax, props=props, length=10))
+    return (
+        '<?xml version="1.0" encoding="ISO-8859-1"?>\n'
+        '<UniRef90 xmlns="http://uniprot.org/uniref" releaseDate="2020-01-01">\n'
+        + "".join(entries)
+        + "</UniRef90>\n"
+    )
+
+
+RECORDS = [
+    ("P00001", 9606, {"GO Molecular Function": ["GO:0000003"]}),          # completes to {1,2,3}
+    ("P00002", 10090, {"GO Biological Process": ["GO:0000004"]}),          # completes to {1,4}
+    ("P00003", 9606, {"GO Molecular Function": ["GO:0000002", "GO:9999999"]}),  # unknown id dropped
+    ("P00004", 562, {}),                                                   # no annotations
+]
+
+SEQS = {
+    "UniRef90_P00001": "MKVLAAGIAKWT",
+    "UniRef90_P00002": "ACDEFGHIKLMNPQRSTVWY",
+    "UniRef90_P00003": "MSTNPKPQRKTKRNTNRRPQDVK",
+    # P00004 intentionally missing from FASTA → join failure path
+}
+
+
+@pytest.fixture(scope="module")
+def etl_files(tmp_path_factory):
+    d = tmp_path_factory.mktemp("etl")
+    go_path = d / "go.txt"
+    go_path.write_text(GO_TXT)
+    xml_path = d / "uniref90.xml.gz"
+    with gzip.open(xml_path, "wt") as f:
+        f.write(_make_xml(RECORDS))
+    fasta_path = d / "uniref90.fasta"
+    fasta_path.write_text(
+        "".join(f">{k} some description\n{v[:7]}\n{v[7:]}\n" for k, v in SEQS.items())
+    )
+    return {"dir": d, "go": str(go_path), "xml": str(xml_path),
+            "fasta": str(fasta_path)}
+
+
+# ---------------------------------------------------------------- ontology
+
+def test_obo_parse_and_closure(etl_files):
+    onto = parse_obo(etl_files["go"])
+    assert len(onto) == 5
+    # ancestors include self (reference closure convention).
+    assert onto.ancestors["GO:0000003"] == {"GO:0000001", "GO:0000002", "GO:0000003"}
+    assert onto.ancestors["GO:0000001"] == {"GO:0000001"}
+    assert onto.offspring["GO:0000001"] == {
+        "GO:0000001", "GO:0000002", "GO:0000003", "GO:0000004"}
+    assert set(onto.roots()) == {"GO:0000001", "GO:0000005"}
+
+
+def test_complete_fixes_reference_bug(etl_files):
+    # The reference computes the completion then stores raw indices
+    # (SURVEY ledger #6); ours must store the completed set.
+    onto = parse_obo(etl_files["go"])
+    assert onto.complete_indices(["GO:0000003"]) == [0, 1, 2]
+    assert onto.complete_indices(["GO:9999999"]) == []  # unknown → dropped
+
+
+# ------------------------------------------------------------------- fasta
+
+def test_fasta_reader_roundtrip(etl_files):
+    with FastaReader(etl_files["fasta"]) as r:
+        assert len(r) == len(SEQS)
+        for name, seq in SEQS.items():
+            assert r.fetch(name) == seq
+            assert r.length(name) == len(seq)
+        assert "UniRef90_P00004" not in r
+    assert dict(iter_fasta(etl_files["fasta"])) == SEQS
+
+
+# ------------------------------------------------------------ xml → sqlite
+
+def _parse_to_sqlite(etl_files, db_path, **kw):
+    onto = parse_obo(etl_files["go"])
+    parser = UnirefToSqliteParser(etl_files["xml"], onto, str(db_path),
+                                  verbose=False, **kw)
+    parser.parse()
+    return onto, parser
+
+
+def test_uniref_parser(etl_files, tmp_path):
+    onto, parser = _parse_to_sqlite(etl_files, tmp_path / "ann.db")
+    conn = sqlite3.connect(tmp_path / "ann.db")
+    rows = conn.execute(
+        "SELECT uniprot_name, tax_id, complete_go_annotation_indices, "
+        "n_complete_go_annotations FROM protein_annotations ORDER BY entry_index"
+    ).fetchall()
+    conn.close()
+    assert [r[0] for r in rows] == [
+        "P00001_HUMAN", "P00002_HUMAN", "P00003_HUMAN", "P00004_HUMAN"]
+    assert rows[0][1] == 9606
+    assert json.loads(rows[0][2]) == [0, 1, 2]     # ancestor-completed
+    assert json.loads(rows[1][2]) == [0, 3]
+    assert json.loads(rows[2][2]) == [0, 1]        # unknown GO id dropped
+    assert rows[3][3] == 0
+    assert parser.n_records_with_any_go == 3
+    assert parser.unrecognized_go == {"GO:9999999": 1}
+    # per-term record counts (completed): root appears in 3 records.
+    assert parser.go_record_counts["GO:0000001"] == 3
+    assert parser.go_record_counts["GO:0000002"] == 2
+
+
+def test_uniref_parser_sharding(etl_files, tmp_path):
+    onto = parse_obo(etl_files["go"])
+    paths = [str(tmp_path / f"s{k}.db") for k in range(2)]
+    for k in range(2):
+        UnirefToSqliteParser(
+            etl_files["xml"], onto, paths[k], verbose=False,
+            shard_index=k, num_shards=2,
+        ).parse()
+    merged = tmp_path / "merged.db"
+    assert merge_shard_dbs(paths, str(merged)) == len(RECORDS)
+    conn = sqlite3.connect(merged)
+    n = conn.execute("SELECT COUNT(*) FROM protein_annotations").fetchone()[0]
+    names = {r[0] for r in conn.execute(
+        "SELECT uniprot_name FROM protein_annotations")}
+    conn.close()
+    assert n == len(RECORDS)
+    assert names == {f"P0000{i}_HUMAN" for i in range(1, 5)}
+    # Aggregates must be SUMMED across shards (not one shard's view) so
+    # the h5 builder's >=min_records gate sees corpus-wide counts.
+    counts, n_any = read_aggregates(str(merged))
+    assert n_any == 3
+    assert counts["GO:0000001"] == 3
+    assert counts["GO:0000002"] == 2
+    # ...and match an unsharded parse exactly.
+    _, ref_parser = _parse_to_sqlite(etl_files, tmp_path / "ref.db")
+    assert counts == ref_parser.go_record_counts
+
+
+# ------------------------------------------------------- join + h5 builder
+
+@pytest.fixture(scope="module")
+def built_db(etl_files):
+    d = etl_files["dir"]
+    onto, parser = _parse_to_sqlite(etl_files, d / "full.db")
+    meta_csv = d / "go_meta.csv"
+    save_meta_csv(onto, str(meta_csv), counts=parser.go_record_counts,
+                  total_records=parser.n_records_with_any_go)
+    return {"db": str(d / "full.db"), "meta": str(meta_csv), **etl_files}
+
+
+def test_join(built_db):
+    rows = list(load_seqs_and_annotations(
+        built_db["db"], built_db["fasta"], shuffle=False, verbose=False))
+    # P00004 has no FASTA record → dropped, counted as failure.
+    assert [r[0] for r in rows] == ["P00001_HUMAN", "P00002_HUMAN", "P00003_HUMAN"]
+    assert rows[0][1] == SEQS["UniRef90_P00001"]
+    assert rows[0][2] == [0, 1, 2]
+
+
+def test_h5_builder_and_reader_roundtrip(built_db, tmp_path):
+    import h5py
+
+    out = tmp_path / "data.h5"
+    # min_records 2: term counts are root=3, a=2, b=1, c=1, d=0 → keep root+a.
+    n = create_h5_dataset(
+        built_db["db"], built_db["fasta"], built_db["meta"], str(out),
+        shuffle=True, min_records_to_keep_annotation=2, verbose=False)
+    assert n == 3
+    with h5py.File(out, "r") as f:
+        kept = [s.decode() for s in f["included_annotations"][:]]
+        assert kept == ["GO:0000001", "GO:0000002"]
+        ids = [s.decode() for s in f["uniprot_ids"][:]]
+        seqs = [s.decode() for s in f["seqs"][:]]
+        masks = f["annotation_masks"][:]
+        lengths = f["seq_lengths"][:]
+    assert sorted(ids) == ["P00001_HUMAN", "P00002_HUMAN", "P00003_HUMAN"]
+    by_id = {i: (s, m, l) for i, s, m, l in zip(ids, seqs, masks, lengths)}
+    assert by_id["P00001_HUMAN"][0] == SEQS["UniRef90_P00001"]
+    assert by_id["P00001_HUMAN"][2] == len(SEQS["UniRef90_P00001"])
+    # P00001 completes to {root,a,b} → mask [1,1]; P00002 to {root,c} → [1,0].
+    np.testing.assert_array_equal(by_id["P00001_HUMAN"][1], [True, True])
+    np.testing.assert_array_equal(by_id["P00002_HUMAN"][1], [True, False])
+
+    # The training-feed reader serves this file directly.
+    from proteinbert_tpu.data.dataset import HDF5PretrainingDataset
+
+    ds = HDF5PretrainingDataset(str(out), seq_len=32)
+    assert len(ds) == 3
+    row = ds[ids.index("P00001_HUMAN")]
+    assert row["tokens"].shape == (32,)
+    np.testing.assert_array_equal(
+        row["annotations"], by_id["P00001_HUMAN"][1].astype(np.float32))
+    ds.close()

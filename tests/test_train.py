@@ -160,6 +160,54 @@ def _skip(it, n):
     return it
 
 
+def test_auto_resume_uses_data_position(tmp_path):
+    """pretrain(checkpointer=...) with an iterator FACTORY must restore
+    the state AND fast-forward the data stream — matching an
+    uninterrupted run exactly."""
+    cfg = smoke_cfg(max_steps=60)
+    ck_cfg = CheckpointConfig(every_steps=30, async_save=False)
+    cfg_a = cfg.replace(checkpoint=ck_cfg,
+                        train=TrainConfig(max_steps=30, log_every=10))
+    cfg_b = cfg.replace(checkpoint=ck_cfg,
+                        train=TrainConfig(max_steps=60, log_every=10))
+
+    full = pretrain(cfg, make_iter(cfg))
+
+    factory = lambda skip: make_pretrain_iterator(  # noqa: E731
+        _make_ds(cfg), cfg.data.batch_size, seed=0, skip_batches=skip)
+    ck1 = Checkpointer(str(tmp_path / "ck"), async_save=False)
+    pretrain(cfg_a, factory, checkpointer=ck1)
+    ck1.close()
+
+    ck2 = Checkpointer(str(tmp_path / "ck"), async_save=False)
+    resumed = pretrain(cfg_b, factory, checkpointer=ck2)
+    ck2.close()
+    assert int(resumed["state"].step) == 60
+    np.testing.assert_allclose(
+        resumed["history"][-1]["loss"], full["history"][-1]["loss"], rtol=1e-4)
+
+
+def _make_ds(cfg, n=64, seed=0):
+    rng = np.random.default_rng(seed)
+    seqs, ann = make_random_proteins(
+        n, rng, num_annotations=cfg.model.num_annotations, max_len=40)
+    return InMemoryPretrainingDataset(seqs, ann, cfg.data.seq_len)
+
+
+def test_checkpoint_restore_without_data_item(tmp_path):
+    """save(step, state) with no data_state is documented-optional;
+    restore must not crash on the missing 'data' item."""
+    cfg = smoke_cfg(max_steps=5)
+    state = create_train_state(jax.random.PRNGKey(0), cfg)
+    ck = Checkpointer(str(tmp_path / "ck"), async_save=False)
+    ck.save(5, state)
+    restored, data_state = ck.restore(state)
+    ck.close()
+    assert data_state is None
+    np.testing.assert_array_equal(
+        np.asarray(restored.step), np.asarray(state.step))
+
+
 def test_iterator_skip_batches_matches_manual_skip():
     cfg = smoke_cfg()
     it_a = make_iter(cfg)

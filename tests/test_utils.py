@@ -1,0 +1,91 @@
+"""Tests for host utilities (reference C17/C20/C21 parity)."""
+
+import numpy as np
+import pytest
+
+from proteinbert_tpu.utils import (
+    Profiler,
+    TimeMeasure,
+    shard_items,
+    shard_range,
+    task_identity,
+    to_chunks,
+)
+from proteinbert_tpu.utils.h5 import (
+    find_linearly_independent_columns,
+    normalize,
+    random_mask,
+    transpose_dataset,
+)
+
+
+def test_to_chunks():
+    assert list(to_chunks(range(7), 3)) == [[0, 1, 2], [3, 4, 5], [6]]
+    assert list(to_chunks([], 3)) == []
+    with pytest.raises(ValueError):
+        list(to_chunks([1], 0))
+
+
+def test_shard_range_covers_and_balances():
+    n, k = 17, 5
+    spans = [shard_range(n, i, k) for i in range(k)]
+    assert spans[0][0] == 0 and spans[-1][1] == n
+    for (a, b), (c, d) in zip(spans, spans[1:]):
+        assert b == c
+    sizes = [b - a for a, b in spans]
+    assert max(sizes) - min(sizes) <= 1
+    assert shard_items(list(range(10)), 1, 3) == [4, 5, 6]
+
+
+def test_task_identity(monkeypatch):
+    monkeypatch.delenv("SLURM_ARRAY_TASK_ID", raising=False)
+    monkeypatch.delenv("TASK_INDEX", raising=False)
+    assert task_identity() == (0, 1)
+    assert task_identity(2, 4) == (2, 4)
+    with pytest.raises(ValueError):
+        task_identity(4, 4)
+    monkeypatch.setenv("SLURM_ARRAY_TASK_ID", "3")
+    monkeypatch.setenv("SLURM_ARRAY_TASK_COUNT", "8")
+    assert task_identity() == (3, 8)
+    monkeypatch.setenv("TASK_ID_OFFSET", "10")
+    assert task_identity() == (13, 8)
+
+
+def test_profiler_and_time_measure():
+    p = Profiler()
+    with p.measure("a"):
+        pass
+    with p.measure("a"):
+        pass
+    with p.measure("b"):
+        pass
+    s = p.summary()
+    assert s["a"]["count"] == 2 and s["b"]["count"] == 1
+    assert "a:" in p.report()
+    with TimeMeasure("t", verbose=False) as tm:
+        pass
+    assert tm.elapsed is not None and tm.elapsed >= 0
+
+
+def test_transpose_dataset(tmp_path):
+    import h5py
+
+    rng = np.random.default_rng(0)
+    x = rng.random((37, 11)).astype(np.float32)
+    with h5py.File(tmp_path / "t.h5", "w") as f:
+        f.create_dataset("src", data=x)
+        transpose_dataset(f, "src", "dst", chunk_rows=8)
+        np.testing.assert_array_equal(f["dst"][:], x.T)
+
+
+def test_numpy_helpers():
+    rng = np.random.default_rng(0)
+    v = normalize(rng.random((4, 6)))
+    np.testing.assert_allclose(np.linalg.norm(v, axis=-1), 1.0, atol=1e-9)
+    m = random_mask((1000,), 0.3, rng)
+    assert 0.2 < m.mean() < 0.4
+    # col2 = col0 + col1 → dependent; expect 3 independent of 4.
+    a = rng.random((10, 2))
+    x = np.column_stack([a[:, 0], a[:, 1], a[:, 0] + a[:, 1], rng.random(10)])
+    idx = find_linearly_independent_columns(x)
+    assert len(idx) == 3

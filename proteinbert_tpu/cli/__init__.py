@@ -1,0 +1,5 @@
+"""CLI entry points (reference C15/C16, rebuilt as one console)."""
+
+from proteinbert_tpu.cli.main import build_parser, main
+
+__all__ = ["build_parser", "main"]

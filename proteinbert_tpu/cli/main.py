@@ -1,0 +1,305 @@
+"""Unified CLI: `python -m proteinbert_tpu <command>`.
+
+The reference ships two argparse ETL scripts — one of which crashes at
+parser construction from `est=`/`ype=` typos (reference
+create_uniref_db.py:23,33; SURVEY ledger #9) — and NO training CLI (its
+README promises one "Soon(TM)", reference README.md:5-6). Here everything
+is one console with subcommands:
+
+  create-uniref-db   UniRef90 XML(.gz) + GO OBO → SQLite (+ meta CSV)
+  merge-uniref-dbs   combine task-array shard DBs (sums aggregates)
+  create-h5          SQLite + FASTA + meta CSV → HDF5 training dataset
+  pretrain           denoising pretrain from an HDF5 file or synthetic data
+  smoke              the dummy_tests-equivalent end-to-end sanity run
+
+Cluster sharding (reference C17 parity): create-uniref-db reads
+--task-index/--task-count or SLURM array env vars (utils/sharding.py) and
+writes a per-shard DB that merge-uniref-dbs combines.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+from typing import List, Optional
+
+from proteinbert_tpu.utils.logging import log, start_log
+
+
+# ------------------------------------------------------------------ types
+
+def existing_file(path: str) -> str:
+    """Validated argparse type (reference shared_utils/util.py:387-408)."""
+    if not os.path.isfile(path):
+        raise argparse.ArgumentTypeError(f"not a file: {path}")
+    return path
+
+
+def creatable_path(path: str) -> str:
+    parent = os.path.dirname(os.path.abspath(path))
+    if not os.path.isdir(parent):
+        raise argparse.ArgumentTypeError(f"parent dir missing: {path}")
+    return path
+
+
+# -------------------------------------------------------------- config CLI
+
+def apply_overrides(cfg, overrides: List[str]):
+    """`--set model.local_dim=256` dotted-path overrides on the frozen
+    dataclass config tree (the reference has no config system at all)."""
+    for ov in overrides:
+        if "=" not in ov:
+            raise SystemExit(f"--set expects path=value, got {ov!r}")
+        path, raw = ov.split("=", 1)
+        keys = path.split(".")
+        node_path = []
+        node = cfg
+        for k in keys[:-1]:
+            if not hasattr(node, k):
+                raise SystemExit(f"unknown config path {path!r}")
+            node_path.append((node, k))
+            node = getattr(node, k)
+        leaf = keys[-1]
+        if not hasattr(node, leaf):
+            raise SystemExit(f"unknown config path {path!r}")
+        current = getattr(node, leaf)
+        value = _parse_value(raw, current)
+        node = dataclasses.replace(node, **{leaf: value})
+        for parent, k in reversed(node_path):
+            node = dataclasses.replace(parent, **{k: node})
+        cfg = node
+    return cfg
+
+
+def _parse_value(raw: str, current):
+    if isinstance(current, bool):
+        return raw.lower() in ("1", "true", "yes")
+    if isinstance(current, int):
+        return int(raw)
+    if isinstance(current, float):
+        return float(raw)
+    if current is None:
+        try:
+            return json.loads(raw)
+        except json.JSONDecodeError:
+            return raw
+    return type(current)(raw)
+
+
+# ------------------------------------------------------------- subcommands
+
+def cmd_create_uniref_db(args) -> int:
+    from proteinbert_tpu.etl import (
+        UnirefToSqliteParser, parse_obo, save_meta_csv,
+    )
+    from proteinbert_tpu.utils.sharding import shard_file_name, task_identity
+
+    task_index, task_count = task_identity(args.task_index, args.task_count)
+    db_path = shard_file_name(args.output_db, task_index, task_count)
+    log(f"parsing {args.uniref_xml} (shard {task_index}/{task_count}) → {db_path}")
+    onto = parse_obo(args.go_meta)
+    parser = UnirefToSqliteParser(
+        args.uniref_xml, onto, db_path,
+        shard_index=task_index, num_shards=task_count,
+        max_entries=args.records_limit,
+    )
+    parser.parse()
+    if args.go_meta_csv and task_count == 1:
+        save_meta_csv(onto, args.go_meta_csv, counts=parser.go_record_counts,
+                      total_records=parser.n_records_with_any_go)
+        log(f"wrote GO meta CSV → {args.go_meta_csv}")
+    elif args.go_meta_csv:
+        log("sharded run: write the meta CSV from merge-uniref-dbs instead")
+    return 0
+
+
+def cmd_merge_uniref_dbs(args) -> int:
+    from proteinbert_tpu.etl import merge_shard_dbs, parse_obo, read_aggregates, save_meta_csv
+    from proteinbert_tpu.utils.sharding import all_shard_file_names
+
+    if not args.shards and args.num_shards is None:
+        raise SystemExit("merge-uniref-dbs needs --shards or --num-shards")
+    shards = args.shards or all_shard_file_names(args.output_db, args.num_shards)
+    missing = [s for s in shards if not os.path.isfile(s)]
+    if missing:
+        raise SystemExit(f"missing shard files: {missing}")
+    n = merge_shard_dbs(shards, args.output_db)
+    log(f"merged {len(shards)} shards ({n} rows) → {args.output_db}")
+    if args.go_meta_csv:
+        if not args.go_meta:
+            raise SystemExit("--go-meta is required with --go-meta-csv")
+        counts, n_any = read_aggregates(args.output_db)
+        save_meta_csv(parse_obo(args.go_meta), args.go_meta_csv,
+                      counts=counts, total_records=n_any)
+        log(f"wrote merged GO meta CSV → {args.go_meta_csv}")
+    return 0
+
+
+def cmd_create_h5(args) -> int:
+    from proteinbert_tpu.etl import create_h5_dataset
+
+    n = create_h5_dataset(
+        args.db, args.fasta, args.go_meta_csv, args.output,
+        shuffle=not args.no_shuffle,
+        min_records_to_keep_annotation=args.min_records,
+        records_limit=args.records_limit,
+    )
+    log(f"created {args.output} with {n} rows")
+    return 0
+
+
+def _build_config(args):
+    from proteinbert_tpu.configs import get_preset
+
+    cfg = get_preset(args.preset)
+    if args.max_steps is not None:
+        cfg = cfg.replace(train=dataclasses.replace(
+            cfg.train, max_steps=args.max_steps))
+    if args.checkpoint_dir is not None:
+        cfg = cfg.replace(checkpoint=dataclasses.replace(
+            cfg.checkpoint, directory=args.checkpoint_dir))
+    return apply_overrides(cfg, args.set or [])
+
+
+def cmd_pretrain(args) -> int:
+    import jax
+    import numpy as np
+
+    from proteinbert_tpu.data.dataset import (
+        HDF5PretrainingDataset, InMemoryPretrainingDataset,
+        make_pretrain_iterator,
+    )
+    from proteinbert_tpu.parallel import make_mesh
+    from proteinbert_tpu.train import Checkpointer, pretrain
+
+    cfg = _build_config(args)
+
+    if args.data is not None:
+        ds = HDF5PretrainingDataset(
+            args.data, cfg.data.seq_len,
+            crop_rng=np.random.default_rng(cfg.train.seed + 1))
+        n_ann = ds.num_annotations
+        if n_ann != cfg.model.num_annotations:
+            log(f"setting model.num_annotations={n_ann} from {args.data}")
+            cfg = cfg.replace(model=dataclasses.replace(
+                cfg.model, num_annotations=n_ann))
+    else:
+        from proteinbert_tpu.data.synthetic import make_random_proteins
+        rng = np.random.default_rng(cfg.train.seed)
+        seqs, ann = make_random_proteins(
+            max(4 * cfg.data.batch_size, 256), rng,
+            num_annotations=cfg.model.num_annotations)
+        ds = InMemoryPretrainingDataset(seqs, ann, cfg.data.seq_len)
+        log("no --data given: pretraining on synthetic random proteins")
+
+    mesh = None
+    if cfg.mesh.num_devices > 1:
+        mesh = make_mesh(cfg.mesh)
+        log(f"mesh: {dict(mesh.shape)} over {mesh.size} devices")
+
+    factory = lambda skip: make_pretrain_iterator(  # noqa: E731
+        ds, cfg.data.batch_size, seed=cfg.train.seed,
+        process_index=jax.process_index(), process_count=jax.process_count(),
+        skip_batches=skip)
+    ck = Checkpointer(cfg.checkpoint.directory,
+                      max_to_keep=cfg.checkpoint.max_to_keep,
+                      async_save=cfg.checkpoint.async_save)
+    out = pretrain(cfg, factory, checkpointer=ck, mesh=mesh)
+    ck.close()
+    perf = out["perf"]
+    if perf:
+        log(f"done: {perf.get('residues_per_sec_per_chip', 0):.0f} "
+            f"residues/s/chip, MFU {perf.get('mfu', 0):.3f}")
+    if args.history_json:
+        with open(args.history_json, "w") as f:
+            json.dump(out["history"], f, indent=2)
+    return 0
+
+
+def cmd_smoke(args) -> int:
+    """dummy_tests.main() equivalent (reference dummy_tests.py:96-155):
+    synthetic proteins → tiny config by default → loss must decrease.
+    --preset/--data are honored if given (the smoke subparser defaults
+    preset to tiny; pretrain defaults it to base)."""
+    if args.max_steps is None:
+        args.max_steps = 250
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        if args.checkpoint_dir is None:
+            args.checkpoint_dir = os.path.join(d, "ck")
+        rc = cmd_pretrain(args)
+    return rc
+
+
+# ------------------------------------------------------------------ parser
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="proteinbert_tpu",
+        description="TPU-native ProteinBERT: ETL + pretraining CLI",
+    )
+    sub = p.add_subparsers(dest="command", required=True)
+
+    db = sub.add_parser("create-uniref-db", help="UniRef XML → SQLite")
+    db.add_argument("--uniref-xml", type=existing_file, required=True)
+    db.add_argument("--go-meta", type=existing_file, required=True,
+                    help="GO OBO-style file (CAFA go.txt)")
+    db.add_argument("--output-db", type=creatable_path, required=True)
+    db.add_argument("--go-meta-csv", type=creatable_path)
+    db.add_argument("--records-limit", type=int)
+    db.add_argument("--task-index", type=int)
+    db.add_argument("--task-count", type=int)
+    db.set_defaults(fn=cmd_create_uniref_db)
+
+    mg = sub.add_parser("merge-uniref-dbs", help="merge task-array shard DBs")
+    mg.add_argument("--output-db", type=creatable_path, required=True)
+    mg.add_argument("--num-shards", type=int)
+    mg.add_argument("--shards", nargs="*")
+    mg.add_argument("--go-meta", type=existing_file)
+    mg.add_argument("--go-meta-csv", type=creatable_path)
+    mg.set_defaults(fn=cmd_merge_uniref_dbs)
+
+    h5 = sub.add_parser("create-h5", help="SQLite + FASTA → HDF5 dataset")
+    h5.add_argument("--db", type=existing_file, required=True)
+    h5.add_argument("--fasta", type=existing_file, required=True)
+    h5.add_argument("--go-meta-csv", type=existing_file, required=True)
+    h5.add_argument("--output", type=creatable_path, required=True)
+    h5.add_argument("--min-records", type=int, default=100)
+    h5.add_argument("--records-limit", type=int)
+    h5.add_argument("--no-shuffle", action="store_true")
+    h5.set_defaults(fn=cmd_create_h5)
+
+    def add_train_args(sp, default_preset="base"):
+        sp.add_argument("--preset", default=default_preset,
+                        choices=["tiny", "base", "long", "large"])
+        sp.add_argument("--data", type=existing_file,
+                        help="HDF5 dataset from create-h5 (default: synthetic)")
+        sp.add_argument("--max-steps", type=int)
+        sp.add_argument("--checkpoint-dir")
+        sp.add_argument("--history-json", type=creatable_path)
+        sp.add_argument("--set", action="append", metavar="PATH=VALUE",
+                        help="config override, e.g. --set model.local_dim=256")
+
+    tr = sub.add_parser("pretrain", help="denoising pretraining")
+    add_train_args(tr)
+    tr.set_defaults(fn=cmd_pretrain)
+
+    sm = sub.add_parser("smoke", help="end-to-end sanity run (tiny preset)")
+    add_train_args(sm, default_preset="tiny")
+    sm.set_defaults(fn=cmd_smoke)
+
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    start_log()
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

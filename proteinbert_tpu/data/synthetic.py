@@ -1,0 +1,32 @@
+"""Synthetic UniRef-like data (reference C15 fixture,
+dummy_tests.py:23-38 parity): random AA strings + sparse annotations.
+
+Used by the test suite, the `smoke` CLI command, and `pretrain` when no
+--data file is given — the same role the reference's
+`create_random_samples` plays for its smoke driver.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import numpy as np
+
+
+def make_random_proteins(
+    n: int,
+    rng: np.random.Generator,
+    num_annotations: int = 512,
+    max_len: int = 250,
+    density: float = 0.005,
+) -> Tuple[List[str], np.ndarray]:
+    """n random AA strings of length 0..max_len and (n, A) sparse 0/1
+    annotation rows (~`density` positive rate)."""
+    from proteinbert_tpu.data.vocab import ALPHABET
+
+    seqs = []
+    for _ in range(n):
+        L = int(rng.integers(0, max_len + 1))
+        seqs.append("".join(rng.choice(list(ALPHABET), size=L)))
+    ann = (rng.random((n, num_annotations)) < density).astype(np.float32)
+    return seqs, ann

@@ -34,7 +34,7 @@ def iter_fasta(path: str) -> Iterator[Tuple[str, str]]:
                     yield name, "".join(parts)
                 name, parts = line[1:].split()[0] if len(line) > 1 else "", []
             elif line:
-                parts.append(line)
+                parts.append(line.rstrip("\r"))
         if name is not None:
             yield name, "".join(parts)
 
@@ -49,6 +49,7 @@ def build_index(fasta_path: str, index_path: str | None = None) -> str:
         line_bases = 0
         line_bytes = 0
         offset = 0
+        short_line_seen = False  # a narrower line is only legal as the LAST
         for raw in f:
             if raw.startswith(b">"):
                 if name is not None:
@@ -58,13 +59,24 @@ def build_index(fasta_path: str, index_path: str | None = None) -> str:
                 rlen = 0
                 line_bases = 0
                 line_bytes = 0
+                short_line_seen = False
                 seq_offset = offset + len(raw)
             else:
                 stripped = raw.rstrip(b"\r\n")
                 if stripped:
+                    # The offset arithmetic in fetch() only holds for
+                    # uniformly wrapped records (all lines equal width,
+                    # except possibly the last). Reject anything else
+                    # rather than silently truncate.
+                    if short_line_seen or (line_bases and len(stripped) > line_bases):
+                        raise ValueError(
+                            f"record {name!r} in {fasta_path} has non-uniform "
+                            "line widths; re-wrap the FASTA before indexing")
                     if line_bases == 0:
                         line_bases = len(stripped)
                         line_bytes = len(raw)
+                    elif len(stripped) < line_bases:
+                        short_line_seen = True
                     rlen += len(stripped)
             offset += len(raw)
         if name is not None:

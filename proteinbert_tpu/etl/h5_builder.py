@@ -111,6 +111,11 @@ def create_h5_dataset(
     # (reference uniref_dataset.py:216-217).
     orig_to_common = {r["index"]: i for i, r in enumerate(common)}
     n_common = len(common)
+    if n_common == 0:
+        raise ValueError(
+            f"no GO annotation has >= {min_records_to_keep_annotation} records "
+            f"in {go_meta_csv_path}; lower min_records_to_keep_annotation "
+            "(--min-records) for small corpora")
     if verbose:
         log(f"encoding the {n_common} annotations with >= "
             f"{min_records_to_keep_annotation} records")

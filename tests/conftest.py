@@ -37,17 +37,7 @@ def key():
     return jax.random.PRNGKey(0)
 
 
-def make_random_proteins(n, rng, num_annotations=512, max_len=250, density=0.005):
-    """Synthetic UniRef-like fixture (reference dummy_tests.py:23-38 parity):
-    random AA strings of length 0..max_len and sparse 0/1 annotation rows."""
-    from proteinbert_tpu.data.vocab import ALPHABET
-
-    seqs = []
-    for _ in range(n):
-        L = int(rng.integers(0, max_len + 1))
-        seqs.append("".join(rng.choice(list(ALPHABET), size=L)))
-    ann = (rng.random((n, num_annotations)) < density).astype(np.float32)
-    return seqs, ann
+from proteinbert_tpu.data.synthetic import make_random_proteins  # noqa: E402
 
 
 @pytest.fixture

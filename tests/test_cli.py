@@ -1,0 +1,141 @@
+"""CLI tests (reference C15/C16 parity — and unlike the reference's
+create_uniref_db.py, these parsers must actually construct)."""
+
+import gzip
+import json
+import os
+
+import numpy as np
+import pytest
+
+from proteinbert_tpu.cli.main import apply_overrides, build_parser, main
+from proteinbert_tpu.configs import get_preset
+
+from tests.test_etl import GO_TXT, RECORDS, SEQS, _make_xml
+
+
+@pytest.fixture(scope="module")
+def etl_inputs(tmp_path_factory):
+    d = tmp_path_factory.mktemp("cli")
+    (d / "go.txt").write_text(GO_TXT)
+    with gzip.open(d / "uniref.xml.gz", "wt") as f:
+        f.write(_make_xml(RECORDS))
+    (d / "uniref.fasta").write_text(
+        "".join(f">{k} desc\n{v}\n" for k, v in SEQS.items()))
+    return d
+
+
+def test_parser_constructs():
+    p = build_parser()
+    for cmd in ("create-uniref-db", "merge-uniref-dbs", "create-h5",
+                "pretrain", "smoke"):
+        assert cmd in p.format_help()
+
+
+def test_apply_overrides():
+    cfg = get_preset("tiny")
+    cfg2 = apply_overrides(cfg, ["model.local_dim=64", "train.max_steps=7",
+                                 "model.remat=true"])
+    assert cfg2.model.local_dim == 64
+    assert cfg2.train.max_steps == 7
+    assert cfg2.model.remat is True
+    assert cfg.model.local_dim == 32  # original untouched (frozen tree)
+    with pytest.raises(SystemExit):
+        apply_overrides(cfg, ["model.nope=1"])
+
+
+def test_etl_commands_end_to_end(etl_inputs, tmp_path):
+    db = tmp_path / "ann.db"
+    csv = tmp_path / "meta.csv"
+    h5 = tmp_path / "data.h5"
+    assert main([
+        "create-uniref-db",
+        "--uniref-xml", str(etl_inputs / "uniref.xml.gz"),
+        "--go-meta", str(etl_inputs / "go.txt"),
+        "--output-db", str(db),
+        "--go-meta-csv", str(csv),
+    ]) == 0
+    assert db.exists() and csv.exists()
+    assert main([
+        "create-h5",
+        "--db", str(db),
+        "--fasta", str(etl_inputs / "uniref.fasta"),
+        "--go-meta-csv", str(csv),
+        "--output", str(h5),
+        "--min-records", "2",
+    ]) == 0
+    assert h5.exists()
+
+    import h5py
+
+    with h5py.File(h5, "r") as f:
+        assert f["seqs"].shape[0] == 3  # one record has no FASTA entry
+
+
+def test_sharded_etl_commands(etl_inputs, tmp_path):
+    merged = tmp_path / "merged.db"
+    csv = tmp_path / "meta.csv"
+    for k in range(2):
+        assert main([
+            "create-uniref-db",
+            "--uniref-xml", str(etl_inputs / "uniref.xml.gz"),
+            "--go-meta", str(etl_inputs / "go.txt"),
+            "--output-db", str(merged),
+            "--task-index", str(k), "--task-count", "2",
+        ]) == 0
+    assert main([
+        "merge-uniref-dbs",
+        "--output-db", str(merged), "--num-shards", "2",
+        "--go-meta", str(etl_inputs / "go.txt"),
+        "--go-meta-csv", str(csv),
+    ]) == 0
+    from proteinbert_tpu.etl import read_aggregates
+
+    counts, n_any = read_aggregates(str(merged))
+    assert n_any == 3 and counts["GO:0000001"] == 3
+
+
+def test_pretrain_cli_on_h5(etl_inputs, tmp_path):
+    """Full user journey: ETL → pretrain CLI on the built file."""
+    db, csv, h5 = tmp_path / "a.db", tmp_path / "m.csv", tmp_path / "d.h5"
+    main(["create-uniref-db", "--uniref-xml", str(etl_inputs / "uniref.xml.gz"),
+          "--go-meta", str(etl_inputs / "go.txt"), "--output-db", str(db),
+          "--go-meta-csv", str(csv)])
+    main(["create-h5", "--db", str(db), "--fasta", str(etl_inputs / "uniref.fasta"),
+          "--go-meta-csv", str(csv), "--output", str(h5), "--min-records", "2"])
+    hist = tmp_path / "hist.json"
+    assert main([
+        "pretrain", "--preset", "tiny", "--data", str(h5),
+        "--max-steps", "4", "--checkpoint-dir", str(tmp_path / "ck"),
+        "--history-json", str(hist),
+        "--set", "data.batch_size=2", "--set", "train.log_every=2",
+        "--set", "checkpoint.every_steps=0", "--set", "optimizer.warmup_steps=2",
+        "--set", "model.num_blocks=1", "--set", "model.local_dim=8",
+        "--set", "model.global_dim=16", "--set", "model.key_dim=4",
+        "--set", "data.seq_len=32",
+    ]) == 0
+    h = json.loads(hist.read_text())
+    assert len(h) == 2 and np.isfinite(h[-1]["loss"])
+
+
+def test_merge_requires_shard_spec(tmp_path):
+    with pytest.raises(SystemExit, match="--shards or --num-shards"):
+        main(["merge-uniref-dbs", "--output-db", str(tmp_path / "m.db")])
+
+
+def test_smoke_honors_preset_flag():
+    # smoke defaults to tiny but must not silently override a user choice.
+    p = build_parser()
+    assert p.parse_args(["smoke"]).preset == "tiny"
+    assert p.parse_args(["smoke", "--preset", "base"]).preset == "base"
+
+
+def test_smoke_cli(tmp_path):
+    assert main([
+        "smoke", "--max-steps", "4",
+        "--set", "data.batch_size=4", "--set", "train.log_every=2",
+        "--set", "model.num_blocks=1", "--set", "model.local_dim=8",
+        "--set", "model.global_dim=16", "--set", "model.key_dim=4",
+        "--set", "model.num_annotations=32", "--set", "data.seq_len=32",
+        "--set", "checkpoint.every_steps=0",
+    ]) == 0

@@ -111,8 +111,12 @@ def etl_files(tmp_path_factory):
     with gzip.open(xml_path, "wt") as f:
         f.write(_make_xml(RECORDS))
     fasta_path = d / "uniref90.fasta"
+
+    def wrap(s, w=7):
+        return "\n".join(s[i : i + w] for i in range(0, len(s), w))
+
     fasta_path.write_text(
-        "".join(f">{k} some description\n{v[:7]}\n{v[7:]}\n" for k, v in SEQS.items())
+        "".join(f">{k} some description\n{wrap(v)}\n" for k, v in SEQS.items())
     )
     return {"dir": d, "go": str(go_path), "xml": str(xml_path),
             "fasta": str(fasta_path)}
@@ -149,6 +153,37 @@ def test_fasta_reader_roundtrip(etl_files):
             assert r.length(name) == len(seq)
         assert "UniRef90_P00004" not in r
     assert dict(iter_fasta(etl_files["fasta"])) == SEQS
+
+
+def test_fasta_rejects_non_uniform_wrapping(tmp_path):
+    # Offset arithmetic only holds for uniform wrapping; silent
+    # truncation is worse than an error (pyfaidx also rejects this).
+    p = tmp_path / "bad.fasta"
+    p.write_text(">A\nABCDEFGHIJKLMNOPQRST\nUVWXY\nABCDEFGHIJ\n")
+    with pytest.raises(ValueError, match="non-uniform"):
+        FastaReader(str(p))
+    # A short FINAL line is legal.
+    q = tmp_path / "ok.fasta"
+    q.write_text(">A\nABCDEFGHIJ\nKLM\n>B\nNOP\n")
+    with FastaReader(str(q)) as r:
+        assert r.fetch("A") == "ABCDEFGHIJKLM"
+        assert r.fetch("B") == "NOP"
+
+
+def test_fasta_crlf(tmp_path):
+    p = tmp_path / "crlf.fasta"
+    p.write_bytes(b">A desc\r\nABCDE\r\nFGH\r\n")
+    assert dict(iter_fasta(str(p))) == {"A": "ABCDEFGH"}
+    with FastaReader(str(p)) as r:
+        assert r.fetch("A") == "ABCDEFGH"
+
+
+def test_h5_builder_errors_when_no_common_annotations(built_db, tmp_path):
+    with pytest.raises(ValueError, match="min_records"):
+        create_h5_dataset(
+            built_db["db"], built_db["fasta"], built_db["meta"],
+            str(tmp_path / "x.h5"), min_records_to_keep_annotation=100,
+            verbose=False)
 
 
 # ------------------------------------------------------------ xml → sqlite

@@ -78,6 +78,10 @@ def build_index(fasta_path: str, index_path: str | None = None) -> str:
                     elif len(stripped) < line_bases:
                         short_line_seen = True
                     rlen += len(stripped)
+                elif line_bases:
+                    # A blank line inside a record is a width-0 line: legal
+                    # only if nothing follows (same rule as a short line).
+                    short_line_seen = True
             offset += len(raw)
         if name is not None:
             out.write(f"{name}\t{rlen}\t{seq_offset}\t{line_bases}\t{line_bytes}\n")

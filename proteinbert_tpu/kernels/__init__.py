@@ -1,0 +1,15 @@
+"""Pallas TPU kernels (SURVEY §7 stage 8)."""
+
+from proteinbert_tpu.kernels.fused_block import (
+    MAX_PALLAS_DIM,
+    fused_local_track,
+    local_track_reference,
+    pallas_supported,
+)
+
+__all__ = [
+    "MAX_PALLAS_DIM",
+    "fused_local_track",
+    "local_track_reference",
+    "pallas_supported",
+]

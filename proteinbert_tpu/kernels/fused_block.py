@@ -1,0 +1,238 @@
+"""Pallas TPU kernel: fused local-track block (SURVEY §7 stage 8).
+
+The local (per-residue) track of a ProteinBERT block is the FLOPs and
+bandwidth hot spot (SURVEY §3.4; reference modules.py:201-217):
+
+    h  = x + gelu(narrow_conv(x)) + gelu(wide_conv(x)) + broadcast
+    x1 = LN(h)
+    y  = LN(x1 + gelu(dense(x1)))
+
+Composed from jax.nn ops, XLA materialises several (B, L, C) intermediates
+in HBM. This kernel computes the whole chain in one VMEM-resident pass:
+
+- each 'SAME' dilated conv is lowered to K shifted (TL, C) @ (C, C)
+  matmuls on the MXU (an implicit GEMM: tap t of a kernel-size-K,
+  dilation-d conv contributes x[l + (t-(K-1)/2)·d] @ W[t]);
+- the input is zero-padded by the widest halo (20 rows for k=9, d=5) on
+  the host side so every tap is a static in-VMEM slice;
+- conv accumulation and LayerNorm statistics are float32; matmul inputs
+  stay in the activation dtype (bfloat16 on TPU) so the MXU runs native;
+- grid is (B, L/TL); the full padded row sits in VMEM and is re-fetched
+  only when the batch index changes (the L-tile axis iterates fastest).
+
+Backward: `fused_local_track` is a jax.custom_vjp whose backward pass
+recomputes the plain-JAX composition (`local_track_reference`) and
+differentiates it — i.e. the kernel behaves like a rematerialised
+(jax.checkpoint) block, saving only (params, x, broadcast).
+
+VMEM budget: weights dominate at 2·K·C² + C² activation-dtype bytes
+(~10 MB at C=512 bf16), so the kernel is gated to C ≤ 512; larger
+configs (ProteinBERT-Large C=1024) use the XLA path automatically.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+Params = Dict[str, jax.Array]
+
+# Largest feature dim whose weights fit the VMEM budget (see module doc).
+MAX_PALLAS_DIM = 512
+_LANE = 128  # TPU lane width; C must be a multiple for clean tiling
+
+
+def _gelu(x):
+    return jax.nn.gelu(x)
+
+
+def local_track_reference(
+    params: Params, x: jax.Array, broadcast: jax.Array,
+    narrow_dilation: int = 1, wide_dilation: int = 5,
+) -> jax.Array:
+    """Plain-JAX local track, the kernel's semantic ground truth (and its
+    recompute path in the backward pass). Mirrors models/proteinbert.py
+    block_apply's local half (reference modules.py:201-217)."""
+    from proteinbert_tpu.ops.layers import conv1d_apply, dense_apply, layer_norm_apply
+
+    narrow = _gelu(conv1d_apply(params["narrow_conv"], x, dilation=narrow_dilation))
+    wide = _gelu(conv1d_apply(params["wide_conv"], x, dilation=wide_dilation))
+    h = layer_norm_apply(
+        params["local_ln1"], x + narrow + wide + broadcast[:, None, :]
+    )
+    return layer_norm_apply(
+        params["local_ln2"],
+        h + _gelu(dense_apply(params["local_dense"], h)),
+    )
+
+
+def _tap_matmuls(window, kernel, taps, dilation, halo, tile):
+    """Σ_t window[halo + (t-(K-1)/2)·d : …+tile] @ kernel[t]  (fp32 acc).
+
+    `window` is (tile + 2·halo, C) in activation dtype; every slice is
+    static so XLA/Mosaic sees `taps` plain MXU matmuls.
+    """
+    center = (taps - 1) // 2
+    acc = None
+    for t in range(taps):
+        off = halo + (t - center) * dilation
+        part = lax.dot_general(
+            window[off:off + tile],
+            kernel[t],
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc = part if acc is None else acc + part
+    return acc
+
+
+def _layer_norm_f32(x32, scale, bias, eps=1e-5):
+    mean = x32.mean(axis=-1, keepdims=True)
+    var = x32.var(axis=-1, keepdims=True)
+    return (x32 - mean) * lax.rsqrt(var + eps) * scale + bias
+
+
+def _fused_kernel(
+    x_ref, bcast_ref,
+    nk_ref, nb_ref, wk_ref, wb_ref,
+    s1_ref, b1_ref, dk_ref, db_ref, s2_ref, b2_ref,
+    out_ref,
+    *, tile, halo, narrow_taps, wide_taps, narrow_dilation, wide_dilation,
+):
+    j = pl.program_id(1)
+    dtype = x_ref.dtype
+    # Window of padded rows covering this tile plus both halos.
+    window = x_ref[0, pl.ds(j * tile, tile + 2 * halo), :]
+    x_center = window[halo:halo + tile].astype(jnp.float32)
+
+    narrow = _tap_matmuls(window, nk_ref[:], narrow_taps, narrow_dilation, halo, tile)
+    narrow = _gelu(narrow + nb_ref[0].astype(jnp.float32))
+    wide = _tap_matmuls(window, wk_ref[:], wide_taps, wide_dilation, halo, tile)
+    wide = _gelu(wide + wb_ref[0].astype(jnp.float32))
+
+    h = x_center + narrow + wide + bcast_ref[0].astype(jnp.float32)[None, :]
+    x1 = _layer_norm_f32(h, s1_ref[0], b1_ref[0]).astype(dtype)
+
+    d = lax.dot_general(
+        x1, dk_ref[:], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) + db_ref[0].astype(jnp.float32)
+    h2 = x1.astype(jnp.float32) + _gelu(d)
+    out_ref[0] = _layer_norm_f32(h2, s2_ref[0], b2_ref[0]).astype(dtype)
+
+
+def _pallas_forward(
+    params: Params, x: jax.Array, broadcast: jax.Array,
+    narrow_dilation: int, wide_dilation: int, interpret: bool,
+) -> jax.Array:
+    B, L, C = x.shape
+    nk = params["narrow_conv"]["kernel"]
+    wk = params["wide_conv"]["kernel"]
+    narrow_taps, wide_taps = nk.shape[0], wk.shape[0]
+    halo = max((narrow_taps - 1) // 2 * narrow_dilation,
+               (wide_taps - 1) // 2 * wide_dilation)
+
+    tile = L
+    for cand in (512, 256, 128):
+        if L > cand and L % cand == 0:
+            tile = cand
+            break
+    grid = (B, L // tile)
+
+    dtype = x.dtype
+    x_padded = jnp.pad(x, ((0, 0), (halo, halo), (0, 0)))
+    Lp = L + 2 * halo
+
+    def vec(p):  # (C,) fp32 vector → (1, C) activation-dtype VMEM block
+        return p.reshape(1, C)
+
+    ln1, ln2, dn = params["local_ln1"], params["local_ln2"], params["local_dense"]
+    inputs = (
+        x_padded,
+        broadcast.astype(dtype),
+        nk.astype(dtype), vec(params["narrow_conv"]["bias"]),
+        wk.astype(dtype), vec(params["wide_conv"]["bias"]),
+        vec(ln1["scale"]), vec(ln1["bias"]),
+        dn["kernel"].astype(dtype), vec(dn["bias"]),
+        vec(ln2["scale"]), vec(ln2["bias"]),
+    )
+
+    row_spec = pl.BlockSpec((1, Lp, C), lambda b, j: (b, 0, 0),
+                            memory_space=pltpu.VMEM)
+    bcast_spec = pl.BlockSpec((1, C), lambda b, j: (b, 0),
+                              memory_space=pltpu.VMEM)
+
+    def whole(a):
+        return pl.BlockSpec(a.shape, lambda b, j: (0,) * a.ndim,
+                            memory_space=pltpu.VMEM)
+
+    kernel = functools.partial(
+        _fused_kernel, tile=tile, halo=halo,
+        narrow_taps=narrow_taps, wide_taps=wide_taps,
+        narrow_dilation=narrow_dilation, wide_dilation=wide_dilation,
+    )
+    flops_conv = 2 * B * L * C * C * (narrow_taps + wide_taps + 1)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[row_spec, bcast_spec] + [whole(a) for a in inputs[2:]],
+        out_specs=pl.BlockSpec((1, tile, C), lambda b, j: (b, j, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((B, L, C), dtype),
+        cost_estimate=pl.CostEstimate(
+            flops=flops_conv,
+            bytes_accessed=x.size * x.dtype.itemsize * 2,
+            transcendentals=3 * B * L * C,
+        ),
+        interpret=interpret,
+    )(*inputs)
+
+
+def pallas_supported(local_dim: int, seq_len: int) -> bool:
+    """Whether the fused kernel handles this shape (else use the XLA path)."""
+    return local_dim % _LANE == 0 and local_dim <= MAX_PALLAS_DIM and seq_len >= 8
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def fused_local_track(
+    params: Params, x: jax.Array, broadcast: jax.Array,
+    narrow_dilation: int = 1, wide_dilation: int = 5,
+    interpret: bool = False,
+) -> jax.Array:
+    """Fused local-track block: Pallas forward, rematerialised backward.
+
+    Args:
+      params: the local-track subset of a block's params (narrow_conv,
+        wide_conv, local_ln1, local_dense, local_ln2).
+      x: (B, L, C) activations.
+      broadcast: (B, C) — the already-projected global→local vector
+        (gelu(dense(global)) in block_apply).
+    """
+    return _pallas_forward(params, x, broadcast,
+                           narrow_dilation, wide_dilation, interpret)
+
+
+def _fwd(params, x, broadcast, narrow_dilation, wide_dilation, interpret):
+    y = _pallas_forward(params, x, broadcast,
+                        narrow_dilation, wide_dilation, interpret)
+    return y, (params, x, broadcast)
+
+
+def _bwd(narrow_dilation, wide_dilation, interpret, res, g):
+    params, x, broadcast = res
+    _, vjp = jax.vjp(
+        lambda p, xx, bb: local_track_reference(
+            p, xx, bb, narrow_dilation, wide_dilation
+        ),
+        params, x, broadcast,
+    )
+    return vjp(g)
+
+
+fused_local_track.defvjp(_fwd, _bwd)

@@ -47,7 +47,6 @@ from proteinbert_tpu.ops.attention import (
     global_attention_init,
 )
 from proteinbert_tpu.ops.layers import (
-    conv1d_apply,
     conv1d_init,
     dense_apply,
     dense_init,
@@ -88,18 +87,25 @@ def block_apply(
 ) -> Tuple[jax.Array, jax.Array]:
     """Apply one block. local (B,L,C), global (B,G), pad_mask (B,L) bool."""
     # Local track (reference modules.py:201-217).
-    narrow = jax.nn.gelu(conv1d_apply(params["narrow_conv"], local))
-    wide = jax.nn.gelu(
-        conv1d_apply(params["wide_conv"], local, dilation=cfg.wide_dilation)
-    )
     broadcast = jax.nn.gelu(dense_apply(params["global_to_local"], global_))
-    local = layer_norm_apply(
-        params["local_ln1"], local + narrow + wide + broadcast[:, None, :]
+    from proteinbert_tpu.kernels import (
+        fused_local_track, local_track_reference, pallas_supported,
     )
-    local = layer_norm_apply(
-        params["local_ln2"],
-        local + jax.nn.gelu(dense_apply(params["local_dense"], local)),
-    )
+
+    track_params = {k: params[k] for k in ("narrow_conv", "wide_conv",
+                                           "local_ln1", "local_dense",
+                                           "local_ln2")}
+    if cfg.use_pallas and pallas_supported(cfg.local_dim, local.shape[1]):
+        # Fused Pallas kernel (kernels/fused_block.py); interpreted off-TPU
+        # so tests and CPU runs exercise the same code path.
+        local = fused_local_track(
+            track_params, local, broadcast, 1, cfg.wide_dilation,
+            jax.default_backend() != "tpu",
+        )
+    else:
+        local = local_track_reference(
+            track_params, local, broadcast, 1, cfg.wide_dilation
+        )
 
     # Global track (reference modules.py:219-229).
     dense1 = jax.nn.gelu(dense_apply(params["global_dense1"], global_))

@@ -168,6 +168,11 @@ def test_fasta_rejects_non_uniform_wrapping(tmp_path):
     with FastaReader(str(q)) as r:
         assert r.fetch("A") == "ABCDEFGHIJKLM"
         assert r.fetch("B") == "NOP"
+    # A blank INTERIOR line is a width-0 line → also non-uniform.
+    b = tmp_path / "blank.fasta"
+    b.write_text(">A\nABCDE\n\nFGHIJ\n")
+    with pytest.raises(ValueError, match="non-uniform"):
+        FastaReader(str(b))
 
 
 def test_fasta_crlf(tmp_path):

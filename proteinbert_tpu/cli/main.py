@@ -11,6 +11,7 @@ is one console with subcommands:
   create-h5          SQLite + FASTA + meta CSV → HDF5 training dataset
   pretrain           denoising pretrain from an HDF5 file or synthetic data
   smoke              the dummy_tests-equivalent end-to-end sanity run
+  finetune           supervised task head on a (pretrained) trunk
 
 Cluster sharding (reference C17 parity): create-uniref-db reads
 --task-index/--task-count or SLURM array env vars (utils/sharding.py) and
@@ -219,6 +220,100 @@ def cmd_pretrain(args) -> int:
     return 0
 
 
+def cmd_finetune(args) -> int:
+    """Fine-tune a task head on a pretrained trunk (SURVEY C14, completed —
+    the reference's fine-tune harness is commented-out code, reference
+    utils.py:348-493). --data/--eval-data read the TSV format of
+    data/finetune_data.py; without --data, synthetic labeled batches
+    (data/synthetic.make_task_batches) serve as the smoke path."""
+    import jax
+    import numpy as np
+
+    from proteinbert_tpu.configs import (
+        FinetuneConfig, TaskConfig, get_preset,
+    )
+    from proteinbert_tpu.data.finetune_data import batch_task_data, load_task_tsv
+    from proteinbert_tpu.data.synthetic import make_task_batches
+    from proteinbert_tpu.train import (
+        Checkpointer, create_train_state, finetune,
+    )
+
+    base = get_preset(args.preset)
+    cfg = FinetuneConfig(
+        model=base.model,
+        data=base.data,
+        task=TaskConfig(kind=args.task, num_outputs=args.num_outputs,
+                        epochs=args.epochs, freeze_trunk=args.freeze_trunk),
+    )
+    if args.checkpoint_dir:
+        cfg = cfg.replace(checkpoint=dataclasses.replace(
+            cfg.checkpoint, directory=args.checkpoint_dir))
+    cfg = apply_overrides(cfg, args.set or [])
+
+    trunk = None
+    if args.pretrained:
+        # Rebuild the pretrain-time state template from the same preset +
+        # overrides (task.* is finetune-only and doesn't shape the trunk).
+        pre_cfg = get_preset(args.preset)
+        pre_cfg = apply_overrides(
+            pre_cfg,
+            [ov for ov in (args.set or []) if not ov.startswith("task.")])
+        template = create_train_state(
+            jax.random.PRNGKey(pre_cfg.train.seed), pre_cfg)
+        ck = Checkpointer(args.pretrained, async_save=False)
+        state, _ = ck.restore(template)
+        ck.close()
+        if state is None:
+            raise SystemExit(f"no checkpoint found in {args.pretrained}")
+        trunk = state.params
+        log(f"loaded pretrained trunk from {args.pretrained} "
+            f"(step {int(state.step)})")
+
+    rng = np.random.default_rng(cfg.train.seed)
+    if args.data:
+        tokens, labels = load_task_tsv(args.data, cfg.task.kind,
+                                       cfg.data.seq_len)
+        train_batches = lambda epoch: iter(batch_task_data(  # noqa: E731
+            tokens, labels, cfg.data.batch_size,
+            np.random.default_rng(cfg.train.seed + epoch)))
+        n_train = len(tokens) // cfg.data.batch_size
+        if args.eval_data:
+            ev_tokens, ev_labels = load_task_tsv(
+                args.eval_data, cfg.task.kind, cfg.data.seq_len)
+            eval_batches = lambda: iter(batch_task_data(  # noqa: E731
+                ev_tokens, ev_labels, cfg.data.batch_size))
+        else:
+            eval_batches = None
+    else:
+        log("no --data given: fine-tuning on synthetic labeled batches")
+        n = max(8 * cfg.data.batch_size, 64)
+        train_b = make_task_batches(n, rng, cfg.task.kind,
+                                    cfg.task.num_outputs,
+                                    cfg.data.seq_len, cfg.data.batch_size)
+        eval_b = make_task_batches(n // 4, rng, cfg.task.kind,
+                                   cfg.task.num_outputs, cfg.data.seq_len,
+                                   cfg.data.batch_size)
+        train_batches = lambda epoch: iter(train_b)  # noqa: E731
+        eval_batches = lambda: iter(eval_b)  # noqa: E731
+        n_train = len(train_b)
+
+    log(f"finetune {cfg.task.kind}: {n_train} train batches/epoch, "
+        f"{cfg.task.epochs} epochs → checkpoints in "
+        f"{cfg.checkpoint.directory}")
+    ck = Checkpointer(cfg.checkpoint.directory,
+                      max_to_keep=cfg.checkpoint.max_to_keep,
+                      async_save=cfg.checkpoint.async_save)
+    out = finetune(cfg, train_batches, eval_batches=eval_batches,
+                   pretrained_trunk=trunk, checkpointer=ck)
+    ck.close()
+    best = out["best"]
+    log(f"best epoch {best['epoch']}: score {best['score']:.4f}")
+    if args.history_json:
+        with open(args.history_json, "w") as f:
+            json.dump(out["history"], f, indent=2)
+    return 0
+
+
 def cmd_smoke(args) -> int:
     """dummy_tests.main() equivalent (reference dummy_tests.py:96-155):
     synthetic proteins → tiny config by default → loss must decrease.
@@ -291,6 +386,26 @@ def build_parser() -> argparse.ArgumentParser:
     sm = sub.add_parser("smoke", help="end-to-end sanity run (tiny preset)")
     add_train_args(sm, default_preset="tiny")
     sm.set_defaults(fn=cmd_smoke)
+
+    ftp = sub.add_parser("finetune", help="fine-tune a task head on a trunk")
+    ftp.add_argument("--preset", default="tiny",
+                     choices=["tiny", "base", "long", "large"])
+    ftp.add_argument("--task", default="token_classification",
+                     choices=["token_classification",
+                              "sequence_classification",
+                              "sequence_regression"])
+    ftp.add_argument("--num-outputs", type=int, default=8)
+    ftp.add_argument("--epochs", type=int, default=3)
+    ftp.add_argument("--freeze-trunk", action="store_true")
+    ftp.add_argument("--pretrained", help="pretrain checkpoint dir for the trunk")
+    ftp.add_argument("--data", type=existing_file,
+                     help="labeled TSV (data/finetune_data.py format); "
+                          "default: synthetic smoke batches")
+    ftp.add_argument("--eval-data", type=existing_file)
+    ftp.add_argument("--checkpoint-dir")
+    ftp.add_argument("--history-json", type=creatable_path)
+    ftp.add_argument("--set", action="append", metavar="PATH=VALUE")
+    ftp.set_defaults(fn=cmd_finetune)
 
     return p
 

@@ -1,10 +1,12 @@
 from proteinbert_tpu.configs.config import (
     CheckpointConfig,
     DataConfig,
+    FinetuneConfig,
     MeshConfig,
     ModelConfig,
     OptimizerConfig,
     PretrainConfig,
+    TaskConfig,
     TrainConfig,
     get_preset,
     PRESETS,
@@ -13,10 +15,12 @@ from proteinbert_tpu.configs.config import (
 __all__ = [
     "CheckpointConfig",
     "DataConfig",
+    "FinetuneConfig",
     "MeshConfig",
     "ModelConfig",
     "OptimizerConfig",
     "PretrainConfig",
+    "TaskConfig",
     "TrainConfig",
     "get_preset",
     "PRESETS",

@@ -139,6 +139,46 @@ class TrainConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class TaskConfig:
+    """A supervised fine-tuning task on the pretrained trunk (SURVEY C14 —
+    the reference's fine-tune harness exists only as commented-out code,
+    reference utils.py:348-493; completed here).
+
+    Kinds (the ProteinBERT paper's benchmark shapes):
+      token_classification  — per-residue labels (secondary structure);
+      sequence_classification — per-protein label (remote homology);
+      sequence_regression   — per-protein scalar (stability, fluorescence).
+    """
+
+    kind: str = "token_classification"
+    num_outputs: int = 8                # classes, or 1 for regression
+    freeze_trunk: bool = False          # train head only
+    head_hidden_dim: int = 0            # 0 = linear head, else one MLP layer
+    epochs: int = 10
+    eval_every_epochs: int = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class FinetuneConfig:
+    model: "ModelConfig" = dataclasses.field(default_factory=lambda: ModelConfig())
+    task: TaskConfig = dataclasses.field(default_factory=TaskConfig)
+    data: "DataConfig" = dataclasses.field(default_factory=lambda: DataConfig())
+    optimizer: "OptimizerConfig" = dataclasses.field(
+        default_factory=lambda: OptimizerConfig(
+            learning_rate=1e-4, warmup_steps=100, schedule="warmup_cosine",
+            total_steps=10_000,
+        )
+    )
+    checkpoint: "CheckpointConfig" = dataclasses.field(
+        default_factory=lambda: CheckpointConfig(directory="finetune_checkpoints")
+    )
+    train: "TrainConfig" = dataclasses.field(default_factory=lambda: TrainConfig())
+
+    def replace(self, **kw) -> "FinetuneConfig":
+        return dataclasses.replace(self, **kw)
+
+
+@dataclasses.dataclass(frozen=True)
 class PretrainConfig:
     model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
     data: DataConfig = dataclasses.field(default_factory=DataConfig)

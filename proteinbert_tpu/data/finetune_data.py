@@ -1,0 +1,98 @@
+"""Labeled fine-tuning datasets from TSV files (SURVEY C14).
+
+The reference never defined a fine-tuning data format (its harness is
+commented-out code, reference utils.py:348-493). Ours is a 2-column TSV,
+`sequence<TAB>label`, one protein per line, `#` comments allowed:
+
+  token_classification    label per residue: either a digit string as long
+                          as the sequence ("01123...") or comma-separated
+                          ints ("0,1,12,3"); positions that carry no label
+                          (<sos>/<eos>/<pad>) are -1 in the batch and
+                          masked out of the loss (train/finetune.task_loss).
+  sequence_classification one int per line.
+  sequence_regression     one float per line.
+
+This covers the ProteinBERT paper's benchmark shapes (secondary
+structure, remote homology, stability, fluorescence).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from proteinbert_tpu.data.transforms import tokenize_batch
+
+
+def _parse_token_labels(raw: str, seq: str, lineno: int) -> List[int]:
+    if "," in raw:
+        labels = [int(x) for x in raw.split(",")]
+    else:
+        labels = [int(c) for c in raw]
+    if len(labels) != len(seq):
+        raise ValueError(
+            f"line {lineno}: {len(labels)} labels for {len(seq)} residues"
+        )
+    return labels
+
+
+def load_task_tsv(
+    path: str, kind: str, seq_len: int
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Returns (tokens (N, seq_len) int32, labels).
+
+    labels: (N, seq_len) int32 with -1 at unlabeled positions for
+    token_classification (aligned to the <sos>-shifted token layout);
+    (N,) int32 for sequence_classification; (N,) float32 for regression.
+    """
+    seqs: List[str] = []
+    raw_labels: List[str] = []
+    linenos: List[int] = []
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.rstrip("\n")
+            if not line or line.startswith("#"):
+                continue
+            parts = line.split("\t")
+            if len(parts) != 2:
+                raise ValueError(
+                    f"line {lineno}: expected 'sequence<TAB>label', "
+                    f"got {len(parts)} fields")
+            seqs.append(parts[0])
+            raw_labels.append(parts[1])
+            linenos.append(lineno)
+
+    tokens = tokenize_batch(seqs, seq_len)
+
+    if kind == "token_classification":
+        labels = np.full((len(seqs), seq_len), -1, np.int32)
+        for i, (seq, raw) in enumerate(zip(seqs, raw_labels)):
+            per_res = _parse_token_labels(raw, seq, linenos[i])
+            # Residue j sits at token position j+1 (<sos> at 0); residues
+            # beyond the crop window are dropped with their labels.
+            n = min(len(per_res), seq_len - 2)
+            labels[i, 1:1 + n] = per_res[:n]
+        return tokens, labels
+    if kind == "sequence_classification":
+        return tokens, np.array([int(x) for x in raw_labels], np.int32)
+    if kind == "sequence_regression":
+        return tokens, np.array([float(x) for x in raw_labels], np.float32)
+    raise ValueError(f"unknown task kind {kind!r}")
+
+
+def batch_task_data(
+    tokens: np.ndarray,
+    labels: np.ndarray,
+    batch_size: int,
+    rng: np.random.Generator | None = None,
+) -> List[Dict[str, np.ndarray]]:
+    """Shuffle (if rng) and split into full batches (remainder dropped —
+    static shapes keep every step on the same compiled program)."""
+    n = len(tokens)
+    order = rng.permutation(n) if rng is not None else np.arange(n)
+    batches = []
+    for i in range(0, n - batch_size + 1, batch_size):
+        idx = order[i:i + batch_size]
+        batches.append({"tokens": tokens[idx], "labels": labels[idx]})
+    return batches

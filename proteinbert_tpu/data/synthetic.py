@@ -30,3 +30,55 @@ def make_random_proteins(
         seqs.append("".join(rng.choice(list(ALPHABET), size=L)))
     ann = (rng.random((n, num_annotations)) < density).astype(np.float32)
     return seqs, ann
+
+
+# Hydrophobic residues, used to derive LEARNABLE synthetic labels below.
+_HYDROPHOBIC = set("AVILMFWC")
+
+
+def make_task_batches(
+    n: int,
+    rng: np.random.Generator,
+    kind: str,
+    num_outputs: int,
+    seq_len: int,
+    batch_size: int,
+):
+    """Synthetic supervised batches whose labels are deterministic
+    functions of the sequence — so a working fine-tune loop must drive the
+    loss down (the role the reference's random-label smoke data cannot
+    play). Labels:
+      token_classification    — residue's token id mod num_outputs;
+      sequence_classification — dominant-class of the per-residue labels;
+      sequence_regression     — hydrophobic fraction of the sequence.
+    Returns a list of {"tokens", "labels"} numpy batches.
+    """
+    from proteinbert_tpu.data.vocab import ALPHABET, PAD_ID
+    from proteinbert_tpu.data.transforms import tokenize_batch
+
+    seqs = []
+    for _ in range(n):
+        L = int(rng.integers(seq_len // 4, seq_len - 2))
+        seqs.append("".join(rng.choice(list(ALPHABET), size=L)))
+    tokens = tokenize_batch(seqs, seq_len)
+
+    if kind == "token_classification":
+        labels = (tokens % num_outputs).astype(np.int32)
+    elif kind == "sequence_classification":
+        per_tok = tokens % num_outputs
+        labels = np.zeros(n, np.int32)
+        for i in range(n):
+            real = tokens[i] != PAD_ID
+            labels[i] = np.bincount(per_tok[i][real],
+                                    minlength=num_outputs).argmax()
+    elif kind == "sequence_regression":
+        labels = np.array(
+            [sum(c in _HYDROPHOBIC for c in s) / max(len(s), 1) for s in seqs],
+            np.float32,
+        )
+    else:
+        raise ValueError(f"unknown task kind {kind!r}")
+
+    from proteinbert_tpu.data.finetune_data import batch_task_data
+
+    return batch_task_data(tokens, labels, batch_size)

@@ -46,6 +46,7 @@ Params = Dict[str, jax.Array]
 # Largest feature dim whose weights fit the VMEM budget (see module doc).
 MAX_PALLAS_DIM = 512
 _LANE = 128  # TPU lane width; C must be a multiple for clean tiling
+_VMEM_BUDGET = 13 * 1024 * 1024  # per-core VMEM we allow the kernel to plan for
 
 
 def _gelu(x):
@@ -138,11 +139,7 @@ def _pallas_forward(
     halo = max((narrow_taps - 1) // 2 * narrow_dilation,
                (wide_taps - 1) // 2 * wide_dilation)
 
-    tile = L
-    for cand in (512, 256, 128):
-        if L > cand and L % cand == 0:
-            tile = cand
-            break
+    tile = _pick_tile(L)
     grid = (B, L // tile)
 
     dtype = x.dtype
@@ -194,9 +191,33 @@ def _pallas_forward(
     )(*inputs)
 
 
-def pallas_supported(local_dim: int, seq_len: int) -> bool:
-    """Whether the fused kernel handles this shape (else use the XLA path)."""
-    return local_dim % _LANE == 0 and local_dim <= MAX_PALLAS_DIM and seq_len >= 8
+def _pick_tile(L: int) -> int:
+    for cand in (512, 256, 128):
+        if L > cand and L % cand == 0:
+            return cand
+    return L
+
+
+def pallas_supported(
+    local_dim: int, seq_len: int, dtype: str = "bfloat16",
+    narrow_taps: int = 9, wide_taps: int = 9, wide_dilation: int = 5,
+) -> bool:
+    """Whether the fused kernel handles this shape+dtype within the VMEM
+    budget (else the model falls back to the XLA path). The dominant
+    residents per program are the conv/dense weights, the full padded
+    input row, and fp32 (tile, C) temporaries. Note `seq_len` is the
+    PER-SHARD length the kernel actually sees — under sequence
+    parallelism a long global L divides down to supportable shards."""
+    if local_dim % _LANE or local_dim > MAX_PALLAS_DIM or seq_len < 8:
+        return False
+    itemsize = jnp.dtype(dtype).itemsize
+    C = local_dim
+    halo = max((narrow_taps - 1) // 2, (wide_taps - 1) // 2 * wide_dilation)
+    tile = _pick_tile(seq_len)
+    weights = (narrow_taps + wide_taps + 1) * C * C * itemsize
+    row = (seq_len + 2 * halo) * C * itemsize
+    temps = 3 * tile * C * 4
+    return weights + row + temps <= _VMEM_BUDGET
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))

@@ -1,3 +1,3 @@
-from proteinbert_tpu.models import proteinbert
+from proteinbert_tpu.models import finetune, proteinbert
 
-__all__ = ["proteinbert"]
+__all__ = ["finetune", "proteinbert"]

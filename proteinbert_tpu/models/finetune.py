@@ -1,0 +1,113 @@
+"""Fine-tuning task heads on the pretrained dual-track trunk (SURVEY C14).
+
+The reference's fine-tune harness is entirely commented-out code
+(reference utils.py:348-493: epoch-based train()/test() with a pluggable
+metric dict, never finished). This module completes the design the
+TPU-native way: task heads are pure-pytree layers over `proteinbert.encode`
+representations, and trunk + head params live in one tree so a single
+`jax.grad` covers both (with an optax mask freezing the trunk when
+task.freeze_trunk is set — the reference could not even train its attention
+heads, SURVEY ledger #1).
+
+Head shapes per task kind (TaskConfig.kind):
+  token_classification    local (B, L, C)             → (B, L, num_outputs)
+  sequence_classification [global ‖ masked-mean local] → (B, num_outputs)
+  sequence_regression     [global ‖ masked-mean local] → (B, 1)
+
+Sequence-level heads read BOTH tracks: the global track is the model's
+own whole-protein summary; the masked mean over the local track adds
+per-residue evidence the paper's benchmarks (stability, fluorescence)
+depend on.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from proteinbert_tpu.configs import ModelConfig, TaskConfig
+from proteinbert_tpu.data.vocab import PAD_ID
+from proteinbert_tpu.models import proteinbert
+from proteinbert_tpu.ops.layers import dense_apply, dense_init
+
+Params = Dict[str, Any]
+
+KINDS = ("token_classification", "sequence_classification", "sequence_regression")
+
+
+def head_in_dim(model_cfg: ModelConfig, task: TaskConfig) -> int:
+    if task.kind == "token_classification":
+        return model_cfg.local_dim
+    return model_cfg.global_dim + model_cfg.local_dim
+
+
+def head_init(key: jax.Array, model_cfg: ModelConfig, task: TaskConfig) -> Params:
+    if task.kind not in KINDS:
+        raise ValueError(f"unknown task kind {task.kind!r}; have {KINDS}")
+    in_dim = head_in_dim(model_cfg, task)
+    if task.head_hidden_dim:
+        k1, k2 = jax.random.split(key)
+        return {
+            "hidden": dense_init(k1, in_dim, task.head_hidden_dim),
+            "out": dense_init(k2, task.head_hidden_dim, task.num_outputs),
+        }
+    return {"out": dense_init(key, in_dim, task.num_outputs)}
+
+
+def init(
+    key: jax.Array,
+    model_cfg: ModelConfig,
+    task: TaskConfig,
+    pretrained_trunk: Optional[Params] = None,
+) -> Params:
+    """{"trunk", "head"} param tree; trunk from a pretrain checkpoint's
+    params (its pretraining heads are dropped) or freshly initialized."""
+    k_trunk, k_head = jax.random.split(key)
+    if pretrained_trunk is not None:
+        trunk = {k: v for k, v in pretrained_trunk.items()
+                 if k not in ("local_head", "global_head")}
+    else:
+        trunk = {k: v for k, v in proteinbert.init(k_trunk, model_cfg).items()
+                 if k not in ("local_head", "global_head")}
+    return {"trunk": trunk, "head": head_init(k_head, model_cfg, task)}
+
+
+def _head_apply(head: Params, x: jax.Array) -> jax.Array:
+    if "hidden" in head:
+        x = jax.nn.gelu(dense_apply(head["hidden"], x))
+    return dense_apply(head["out"], x)
+
+
+def apply(
+    params: Params,
+    tokens: jax.Array,
+    model_cfg: ModelConfig,
+    task: TaskConfig,
+    annotations: Optional[jax.Array] = None,
+    pad_mask: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Task logits/predictions in float32.
+
+    `annotations` defaults to zeros — fine-tuning datasets normally carry
+    no GO annotations, which matches the pretraining corruption's
+    hide-all-annotations branch (reference data_processing.py:127-128),
+    so a zero global input is in-distribution for the trunk.
+    """
+    if pad_mask is None:
+        pad_mask = tokens != PAD_ID
+    if annotations is None:
+        annotations = jnp.zeros(
+            (tokens.shape[0], model_cfg.num_annotations), jnp.float32
+        )
+    local, global_ = proteinbert.encode(
+        params["trunk"], tokens, annotations, model_cfg, pad_mask
+    )
+    if task.kind == "token_classification":
+        return _head_apply(params["head"], local).astype(jnp.float32)
+
+    m = pad_mask.astype(local.dtype)[..., None]
+    pooled = (local * m).sum(axis=1) / jnp.maximum(m.sum(axis=1), 1.0)
+    feats = jnp.concatenate([global_, pooled], axis=-1)
+    return _head_apply(params["head"], feats).astype(jnp.float32)

@@ -95,7 +95,10 @@ def block_apply(
     track_params = {k: params[k] for k in ("narrow_conv", "wide_conv",
                                            "local_ln1", "local_dense",
                                            "local_ln2")}
-    if cfg.use_pallas and pallas_supported(cfg.local_dim, local.shape[1]):
+    if cfg.use_pallas and pallas_supported(
+        cfg.local_dim, local.shape[1], cfg.dtype,
+        cfg.narrow_kernel, cfg.wide_kernel, cfg.wide_dilation,
+    ):
         # Fused Pallas kernel (kernels/fused_block.py); interpreted off-TPU
         # so tests and CPU runs exercise the same code path.
         local = fused_local_track(
@@ -134,23 +137,19 @@ def init(key: jax.Array, cfg: ModelConfig) -> Params:
     }
 
 
-def apply(
+def encode(
     params: Params,
     tokens: jax.Array,
     annotations: jax.Array,
     cfg: ModelConfig,
     pad_mask: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array]:
-    """Forward pass.
+    """Trunk forward: embeddings + N dual-track blocks, no output heads.
 
-    Args:
-      tokens: (B, L) int token ids (the corrupted "local" input).
-      annotations: (B, A) float annotation vector (the corrupted "global"
-        input; reference input contract at modules.py:295-304).
-      pad_mask: (B, L) bool, True at real positions; derived from tokens
-        if omitted.
-    Returns:
-      (local_logits (B, L, V), global_logits (B, A)) — LOGITS, in float32.
+    Returns (local (B, L, C), global (B, G)) representations — the input
+    to the pretraining heads here and to fine-tuning task heads
+    (models/finetune.py), which the reference only sketched in
+    commented-out code (reference utils.py:348-493, SURVEY C14).
     """
     dtype = jnp.dtype(cfg.dtype)
     if pad_mask is None:
@@ -175,7 +174,28 @@ def apply(
     else:
         for blk in params["blocks"]:
             local, global_ = body(blk, local, global_, pad_mask)
+    return local, global_
 
+
+def apply(
+    params: Params,
+    tokens: jax.Array,
+    annotations: jax.Array,
+    cfg: ModelConfig,
+    pad_mask: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Forward pass.
+
+    Args:
+      tokens: (B, L) int token ids (the corrupted "local" input).
+      annotations: (B, A) float annotation vector (the corrupted "global"
+        input; reference input contract at modules.py:295-304).
+      pad_mask: (B, L) bool, True at real positions; derived from tokens
+        if omitted.
+    Returns:
+      (local_logits (B, L, V), global_logits (B, A)) — LOGITS, in float32.
+    """
+    local, global_ = encode(params, tokens, annotations, cfg, pad_mask)
     local_logits = dense_apply(params["local_head"], local).astype(jnp.float32)
     global_logits = dense_apply(params["global_head"], global_).astype(jnp.float32)
     return local_logits, global_logits

@@ -8,10 +8,16 @@ from proteinbert_tpu.train.metrics import (
 )
 from proteinbert_tpu.train.checkpoint import Checkpointer
 from proteinbert_tpu.train.trainer import pretrain
+from proteinbert_tpu.train.finetune import (
+    FinetuneState, create_finetune_state, finetune, finetune_step,
+    finetune_eval_step,
+)
 
 __all__ = [
     "pretrain_loss", "make_schedule", "make_optimizer", "needs_loss_value",
     "TrainState", "create_train_state", "train_step", "eval_step",
     "forward_flops", "train_flops", "peak_flops_per_chip", "StepTimer",
     "Checkpointer", "pretrain",
+    "FinetuneState", "create_finetune_state", "finetune", "finetune_step",
+    "finetune_eval_step",
 ]

@@ -1,0 +1,209 @@
+"""Epoch-based fine-tuning engine (SURVEY C14, completed).
+
+The reference's fine-tune `train()`/`test()` pair exists only as
+commented-out code — epoch loop, CosineAnnealingLR, grad clip, pluggable
+metric dict, per-epoch checkpoints (reference utils.py:348-493). This is
+that design finished and made TPU-native:
+
+- one jitted `finetune_step` per iteration (forward + masked task loss +
+  backward + clip + Adam with warmup-cosine), trunk and head in one
+  gradient — or trunk frozen via an optax mask (task.freeze_trunk);
+- epoch-based loop with per-epoch eval and best-metric tracking, the
+  epoch/eval structure of the reference's sketch (reference
+  utils.py:442-458);
+- task losses by TaskConfig.kind: masked softmax CE (per-residue),
+  softmax CE (per-protein class), MSE (per-protein scalar), all from
+  logits (the reference pairs probability heads with CE — SURVEY ledger
+  #3 — never repeated here).
+"""
+
+from __future__ import annotations
+
+import logging
+from functools import partial
+from typing import Any, Dict, Iterable, Optional, Tuple
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+
+from proteinbert_tpu.configs import FinetuneConfig
+from proteinbert_tpu.data.vocab import PAD_ID
+from proteinbert_tpu.models import finetune as ft_model
+from proteinbert_tpu.train.schedule import make_optimizer, needs_loss_value
+
+logger = logging.getLogger(__name__)
+
+
+@flax.struct.dataclass
+class FinetuneState:
+    step: jax.Array
+    params: Any          # {"trunk", "head"}
+    opt_state: Any
+
+
+def make_finetune_optimizer(cfg: FinetuneConfig) -> optax.GradientTransformation:
+    tx = make_optimizer(cfg.optimizer)
+    if cfg.task.freeze_trunk:
+        # Mask the trunk subtree: its params get zero updates but remain
+        # in the tree (so checkpoints and shardings see one structure).
+        tx = optax.multi_transform(
+            {"train": tx, "freeze": optax.set_to_zero()},
+            param_labels=lambda params: {
+                "trunk": jax.tree.map(lambda _: "freeze", params["trunk"]),
+                "head": jax.tree.map(lambda _: "train", params["head"]),
+            },
+        )
+    return tx
+
+
+def create_finetune_state(
+    key: jax.Array,
+    cfg: FinetuneConfig,
+    pretrained_trunk: Optional[Any] = None,
+) -> FinetuneState:
+    params = ft_model.init(key, cfg.model, cfg.task, pretrained_trunk)
+    tx = make_finetune_optimizer(cfg)
+    return FinetuneState(
+        step=jnp.zeros((), jnp.int32), params=params, opt_state=tx.init(params)
+    )
+
+
+def task_loss(
+    outputs: jax.Array, batch: Dict[str, jax.Array], kind: str
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Loss + metrics for one batch. `batch["labels"]`: (B, L) int for
+    token_classification (pad positions ignored), (B,) int for
+    sequence_classification, (B,) float for sequence_regression."""
+    labels = batch["labels"]
+    if kind == "token_classification":
+        # Unlabeled positions are -1 (data/finetune_data.py): <sos>/<eos>,
+        # padding, and any residue the source didn't label.
+        w = ((batch["tokens"] != PAD_ID) & (labels >= 0)).astype(jnp.float32)
+        safe = jnp.maximum(labels, 0)
+        ce = optax.softmax_cross_entropy_with_integer_labels(outputs, safe)
+        denom = jnp.maximum(w.sum(), 1.0)
+        loss = (ce * w).sum() / denom
+        acc = ((outputs.argmax(-1) == safe) * w).sum() / denom
+        return loss, {"loss": loss, "accuracy": acc}
+    if kind == "sequence_classification":
+        ce = optax.softmax_cross_entropy_with_integer_labels(outputs, labels)
+        loss = ce.mean()
+        acc = (outputs.argmax(-1) == labels).mean().astype(jnp.float32)
+        return loss, {"loss": loss, "accuracy": acc}
+    if kind == "sequence_regression":
+        pred = outputs[..., 0]
+        err = pred - labels.astype(jnp.float32)
+        loss = (err ** 2).mean()
+        return loss, {"loss": loss, "mae": jnp.abs(err).mean()}
+    raise ValueError(f"unknown task kind {kind!r}")
+
+
+@partial(jax.jit, static_argnames="cfg", donate_argnums=0)
+def finetune_step(
+    state: FinetuneState, batch: Dict[str, jax.Array], cfg: FinetuneConfig
+) -> Tuple[FinetuneState, Dict[str, jax.Array]]:
+    def loss_fn(params):
+        outputs = ft_model.apply(
+            params, batch["tokens"], cfg.model, cfg.task,
+            batch.get("annotations"),
+        )
+        return task_loss(outputs, batch, cfg.task.kind)
+
+    grads, metrics = jax.grad(loss_fn, has_aux=True)(state.params)
+    tx = make_finetune_optimizer(cfg)
+    extra = ({"value": metrics["loss"]}
+             if needs_loss_value(cfg.optimizer) else {})
+    updates, opt_state = tx.update(grads, state.opt_state, state.params,
+                                   **extra)
+    params = jax.tree.map(lambda p, u: p + u.astype(p.dtype),
+                          state.params, updates)
+    return FinetuneState(step=state.step + 1, params=params,
+                         opt_state=opt_state), metrics
+
+
+@partial(jax.jit, static_argnames="cfg")
+def finetune_eval_step(
+    state: FinetuneState, batch: Dict[str, jax.Array], cfg: FinetuneConfig
+) -> Dict[str, jax.Array]:
+    outputs = ft_model.apply(
+        state.params, batch["tokens"], cfg.model, cfg.task,
+        batch.get("annotations"),
+    )
+    _, metrics = task_loss(outputs, batch, cfg.task.kind)
+    return metrics
+
+
+def evaluate(
+    state: FinetuneState, batches: Iterable[Dict[str, Any]], cfg: FinetuneConfig
+) -> Dict[str, float]:
+    """Mean metrics over an eval split (the reference's test_step + metric
+    aggregation, reference utils.py:171-217)."""
+    sums: Dict[str, float] = {}
+    n = 0
+    for batch in batches:
+        m = finetune_eval_step(state, batch, cfg)
+        for k, v in m.items():
+            sums[k] = sums.get(k, 0.0) + float(v)
+        n += 1
+    return {k: v / max(n, 1) for k, v in sums.items()}
+
+
+def finetune(
+    cfg: FinetuneConfig,
+    train_batches,                      # callable(epoch) -> iterator of batches
+    eval_batches=None,                  # callable() -> iterator, or None
+    state: Optional[FinetuneState] = None,
+    pretrained_trunk: Optional[Any] = None,
+    checkpointer=None,                  # train.checkpoint.Checkpointer
+    log_fn=None,
+) -> Dict[str, Any]:
+    """Epoch loop; returns {"state", "history", "best"}.
+
+    `best` tracks the best eval epoch by accuracy (classification) or
+    -loss (regression), and with a `checkpointer` each epoch's state is
+    saved (epoch number as the step) — the per-epoch-checkpoint +
+    model-selection design of the reference's sketch (reference
+    utils.py:442-458).
+    """
+    if state is None:
+        state = create_finetune_state(
+            jax.random.PRNGKey(cfg.train.seed), cfg, pretrained_trunk
+        )
+
+    history = []
+    best: Dict[str, Any] = {"epoch": -1, "score": -float("inf")}
+    for epoch in range(cfg.task.epochs):
+        train_sums: Dict[str, float] = {}
+        n = 0
+        for batch in train_batches(epoch):
+            state, metrics = finetune_step(state, batch, cfg)
+            for k, v in metrics.items():
+                train_sums[k] = train_sums.get(k, 0.0) + float(v)
+            n += 1
+        record = {
+            "epoch": epoch,
+            **{f"train_{k}": v / max(n, 1) for k, v in train_sums.items()},
+        }
+
+        if eval_batches is not None and (
+            (epoch + 1) % cfg.task.eval_every_epochs == 0
+            or epoch == cfg.task.epochs - 1
+        ):
+            em = evaluate(state, eval_batches(), cfg)
+            record.update({f"eval_{k}": v for k, v in em.items()})
+            score = em.get("accuracy", -em.get("loss", float("inf")))
+            if score > best["score"]:
+                best = {"epoch": epoch, "score": score, **record}
+
+        history.append(record)
+        logger.info("finetune %s", record)
+        if log_fn is not None:
+            log_fn(epoch, record)
+        if checkpointer is not None:
+            checkpointer.save(epoch + 1, state, {"record": record})
+
+    if checkpointer is not None:
+        checkpointer.wait()
+    return {"state": state, "history": history, "best": best}

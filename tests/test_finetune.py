@@ -1,0 +1,148 @@
+"""Fine-tuning layer tests (SURVEY C14 — the reference's fine-tune harness
+is commented-out code; this is its completed equivalent)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from proteinbert_tpu.configs import (
+    DataConfig, FinetuneConfig, ModelConfig, OptimizerConfig, TaskConfig,
+    TrainConfig,
+)
+from proteinbert_tpu.data.synthetic import make_task_batches
+from proteinbert_tpu.models import finetune as ft_model, proteinbert
+from proteinbert_tpu.train.finetune import (
+    create_finetune_state, finetune, finetune_eval_step, finetune_step,
+)
+
+MODEL = ModelConfig(local_dim=32, global_dim=64, key_dim=16, num_heads=4,
+                    num_blocks=2, num_annotations=64, dtype="float32")
+
+
+def _cfg(kind, num_outputs, epochs=2, freeze=False):
+    return FinetuneConfig(
+        model=MODEL,
+        task=TaskConfig(kind=kind, num_outputs=num_outputs, epochs=epochs,
+                        freeze_trunk=freeze),
+        data=DataConfig(seq_len=64, batch_size=8),
+        optimizer=OptimizerConfig(learning_rate=3e-3, warmup_steps=5,
+                                  schedule="warmup_cosine", total_steps=200),
+        train=TrainConfig(seed=0),
+    )
+
+
+@pytest.mark.parametrize("kind,num_outputs,out_shape", [
+    ("token_classification", 8, (4, 64, 8)),
+    ("sequence_classification", 5, (4, 5)),
+    ("sequence_regression", 1, (4, 1)),
+])
+def test_head_shapes(key, kind, num_outputs, out_shape):
+    task = TaskConfig(kind=kind, num_outputs=num_outputs)
+    params = ft_model.init(key, MODEL, task)
+    tokens = jax.random.randint(key, (4, 64), 4, 26)
+    out = ft_model.apply(params, tokens, MODEL, task)
+    assert out.shape == out_shape
+    assert out.dtype == jnp.float32
+
+
+def test_unknown_kind_raises(key):
+    with pytest.raises(ValueError, match="unknown task kind"):
+        ft_model.init(key, MODEL, TaskConfig(kind="nope"))
+
+
+def test_init_from_pretrained_trunk(key):
+    pre = proteinbert.init(key, MODEL)
+    params = ft_model.init(key, MODEL, TaskConfig(), pretrained_trunk=pre)
+    # Trunk weights are the pretrained ones, pretraining heads dropped.
+    np.testing.assert_array_equal(
+        np.asarray(params["trunk"]["embedding"]["embedding"]),
+        np.asarray(pre["embedding"]["embedding"]))
+    assert "local_head" not in params["trunk"]
+    assert "global_head" not in params["trunk"]
+
+
+@pytest.mark.parametrize("kind,num_outputs", [
+    ("token_classification", 4),
+    ("sequence_classification", 3),
+    ("sequence_regression", 1),
+])
+def test_finetune_learns(rng, kind, num_outputs):
+    cfg = _cfg(kind, num_outputs, epochs=3)
+    batches = make_task_batches(64, rng, kind, num_outputs,
+                                cfg.data.seq_len, cfg.data.batch_size)
+    out = finetune(cfg, lambda epoch: iter(batches),
+                   eval_batches=lambda: iter(batches))
+    first, last = out["history"][0], out["history"][-1]
+    assert last["train_loss"] < first["train_loss"]
+    assert np.isfinite(last["train_loss"])
+    assert out["best"]["epoch"] >= 0
+
+
+def test_freeze_trunk(rng, key):
+    cfg = _cfg("sequence_classification", 3, epochs=1, freeze=True)
+    state = create_finetune_state(key, cfg)
+    trunk_before = jax.tree.map(np.asarray, state.params["trunk"])
+    head_before = jax.tree.map(np.asarray, state.params["head"])
+    batches = make_task_batches(16, rng, "sequence_classification", 3,
+                                cfg.data.seq_len, cfg.data.batch_size)
+    for b in batches:
+        state, _ = finetune_step(state, b, cfg)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(a, np.asarray(b)),
+        trunk_before, state.params["trunk"])
+    # ... while the head DID move.
+    moved = jax.tree.leaves(jax.tree.map(
+        lambda a, b: bool(np.any(a != np.asarray(b))),
+        head_before, state.params["head"]))
+    assert any(moved)
+
+
+def test_task_tsv_roundtrip(tmp_path):
+    from proteinbert_tpu.data.finetune_data import batch_task_data, load_task_tsv
+    from proteinbert_tpu.data.vocab import PAD_ID, SOS_ID
+
+    tsv = tmp_path / "t.tsv"
+    tsv.write_text("# comment\nACDE\t0123\nKLM\t0,1,2\n")
+    tokens, labels = load_task_tsv(str(tsv), "token_classification", 16)
+    assert tokens.shape == labels.shape == (2, 16)
+    assert tokens[0, 0] == SOS_ID
+    # Residue j's label sits at position j+1 (after <sos>).
+    np.testing.assert_array_equal(labels[0, 1:5], [0, 1, 2, 3])
+    np.testing.assert_array_equal(labels[1, 1:4], [0, 1, 2])
+    assert labels[0, 0] == -1 and labels[0, 5] == -1  # sos/eos unlabeled
+    assert (labels[:, 8:] == -1).all()                # padding unlabeled
+    assert (tokens[:, 8:] == PAD_ID).all()
+
+    batches = batch_task_data(tokens, labels, 2)
+    assert len(batches) == 1 and batches[0]["tokens"].shape == (2, 16)
+
+    tsv2 = tmp_path / "r.tsv"
+    tsv2.write_text("ACDE\t0.5\nKLM\t-1.25\n")
+    _, vals = load_task_tsv(str(tsv2), "sequence_regression", 16)
+    np.testing.assert_allclose(vals, [0.5, -1.25])
+
+
+def test_task_tsv_errors(tmp_path):
+    from proteinbert_tpu.data.finetune_data import load_task_tsv
+
+    bad = tmp_path / "b.tsv"
+    bad.write_text("ACDE\t012\n")  # 3 labels, 4 residues
+    with pytest.raises(ValueError, match="3 labels for 4 residues"):
+        load_task_tsv(str(bad), "token_classification", 16)
+    bad.write_text("ACDE\n")
+    with pytest.raises(ValueError, match="sequence<TAB>label"):
+        load_task_tsv(str(bad), "sequence_classification", 16)
+
+
+def test_eval_step_metrics(rng, key):
+    cfg = _cfg("token_classification", 4)
+    state = create_finetune_state(key, cfg)
+    batch = make_task_batches(8, rng, "token_classification", 4,
+                              cfg.data.seq_len, cfg.data.batch_size)[0]
+    m = finetune_eval_step(state, batch, cfg)
+    assert set(m) == {"loss", "accuracy"}
+    assert np.isfinite(float(m["loss"]))
+    assert 0.0 <= float(m["accuracy"]) <= 1.0

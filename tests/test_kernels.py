@@ -96,9 +96,16 @@ def test_model_level_parity(key):
 
 def test_pallas_supported_gating():
     assert pallas_supported(128, 256)
-    assert pallas_supported(512, 512)
-    assert not pallas_supported(1024, 512)   # Large config → XLA path
-    assert not pallas_supported(96, 256)     # non-lane-aligned C
+    assert pallas_supported(512, 512)               # base config, bf16
+    assert not pallas_supported(1024, 512)          # Large config → XLA path
+    assert not pallas_supported(96, 256)            # non-lane-aligned C
+    assert not pallas_supported(512, 512, "float32")  # fp32 weights blow VMEM
+    assert pallas_supported(128, 64, "float32")     # small fp32 is fine
+    # Unsharded long rows keep the whole padded row in VMEM — too big at
+    # C=512; the seq-sharded per-shard length (2048/4=512) is what the
+    # kernel sees under the long preset, and that fits.
+    assert not pallas_supported(512, 2048)
+    assert pallas_supported(512, 2048 // 4)
 
 
 def test_train_step_with_pallas(key):

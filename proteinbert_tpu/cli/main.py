@@ -82,11 +82,16 @@ def _parse_value(raw: str, current):
         return int(raw)
     if isinstance(current, float):
         return float(raw)
-    if current is None:
+    if current is None or isinstance(current, tuple):
         try:
-            return json.loads(raw)
+            value = json.loads(raw)
         except json.JSONDecodeError:
-            return raw
+            if current is None:
+                return raw  # string-valued optional fields
+            raise SystemExit(
+                f"expected a JSON list (e.g. [512,1024]) or null, got {raw!r}")
+        # Configs must stay hashable (they are jit-static args).
+        return tuple(value) if isinstance(value, list) else value
     return type(current)(raw)
 
 
@@ -201,10 +206,20 @@ def cmd_pretrain(args) -> int:
         mesh = make_mesh(cfg.mesh)
         log(f"mesh: {dict(mesh.shape)} over {mesh.size} devices")
 
-    factory = lambda skip: make_pretrain_iterator(  # noqa: E731
-        ds, cfg.data.batch_size, seed=cfg.train.seed,
-        process_index=jax.process_index(), process_count=jax.process_count(),
-        skip_batches=skip)
+    if cfg.data.buckets:
+        from proteinbert_tpu.data.dataset import make_bucketed_iterator
+
+        log(f"length bucketing: {cfg.data.buckets}")
+
+        factory = lambda skip: make_bucketed_iterator(  # noqa: E731
+            ds, cfg.data.batch_size, cfg.data.buckets, seed=cfg.train.seed,
+            process_index=jax.process_index(),
+            process_count=jax.process_count(), skip_batches=skip)
+    else:
+        factory = lambda skip: make_pretrain_iterator(  # noqa: E731
+            ds, cfg.data.batch_size, seed=cfg.train.seed,
+            process_index=jax.process_index(),
+            process_count=jax.process_count(), skip_batches=skip)
     ck = Checkpointer(cfg.checkpoint.directory,
                       max_to_keep=cfg.checkpoint.max_to_keep,
                       async_save=cfg.checkpoint.async_save)
@@ -252,12 +267,17 @@ def cmd_finetune(args) -> int:
 
     trunk = None
     if args.pretrained:
-        # Rebuild the pretrain-time state template from the same preset +
-        # overrides (task.* is finetune-only and doesn't shape the trunk).
+        # Rebuild the pretrain-time state template: only model.* overrides
+        # shape the trunk params (optimizer/train overrides meant for the
+        # FINE-TUNE run must not leak in — they would change the template's
+        # opt_state structure and break the orbax restore). If the
+        # pretrain run itself used non-default optimizer/data settings,
+        # repeat them via --pretrained-set.
         pre_cfg = get_preset(args.preset)
         pre_cfg = apply_overrides(
             pre_cfg,
-            [ov for ov in (args.set or []) if not ov.startswith("task.")])
+            [ov for ov in (args.set or []) if ov.startswith("model.")]
+            + (args.pretrained_set or []))
         template = create_train_state(
             jax.random.PRNGKey(pre_cfg.train.seed), pre_cfg)
         ck = Checkpointer(args.pretrained, async_save=False)
@@ -398,6 +418,9 @@ def build_parser() -> argparse.ArgumentParser:
     ftp.add_argument("--epochs", type=int, default=3)
     ftp.add_argument("--freeze-trunk", action="store_true")
     ftp.add_argument("--pretrained", help="pretrain checkpoint dir for the trunk")
+    ftp.add_argument("--pretrained-set", action="append", metavar="PATH=VALUE",
+                     help="config override the PRETRAIN run was made with "
+                          "(rebuilds its state template for restore)")
     ftp.add_argument("--data", type=existing_file,
                      help="labeled TSV (data/finetune_data.py format); "
                           "default: synthetic smoke batches")

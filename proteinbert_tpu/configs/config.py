@@ -57,6 +57,8 @@ class DataConfig:
     """
 
     seq_len: int = 256                      # fixed padded length fed to the model
+    buckets: Optional[Tuple[int, ...]] = None  # length buckets (last == seq_len);
+                                            # None = single padded length
     token_randomize_prob: float = 0.05      # data_processing.py:90
     annotation_corrupt_prob: float = 0.5    # P(keep-and-noise); else hide all
                                             # (data_processing.py:127-128)
@@ -215,11 +217,13 @@ def _base() -> PretrainConfig:
 
 
 def _long() -> PretrainConfig:
-    # BASELINE.json configs[2]: seq_len=2048 long-context, sequence-parallel.
+    # BASELINE.json configs[2]: seq_len=2048 long-context, sequence-parallel,
+    # length-bucketed (most UniRef sequences are far shorter than 2048).
     return PretrainConfig(
         model=ModelConfig(local_dim=512, global_dim=512, key_dim=64, num_heads=8,
                           num_blocks=6, remat=True),
-        data=DataConfig(seq_len=2048, batch_size=64),
+        data=DataConfig(seq_len=2048, batch_size=64,
+                        buckets=(512, 1024, 2048)),
         optimizer=OptimizerConfig(warmup_steps=10_000, total_steps=1_000_000),
         train=TrainConfig(max_steps=1_000_000),
         mesh=MeshConfig(data=4, seq=4),

@@ -23,6 +23,7 @@ from proteinbert_tpu.data.corruption import (
 from proteinbert_tpu.data.dataset import (
     InMemoryPretrainingDataset,
     HDF5PretrainingDataset,
+    make_bucketed_iterator,
     make_pretrain_iterator,
 )
 
@@ -33,5 +34,5 @@ __all__ = [
     "randomize_tokens", "corrupt_annotations", "corrupt_batch",
     "pretrain_weights",
     "InMemoryPretrainingDataset", "HDF5PretrainingDataset",
-    "make_pretrain_iterator",
+    "make_bucketed_iterator", "make_pretrain_iterator",
 ]

@@ -77,6 +77,10 @@ class InMemoryPretrainingDataset:
             self._long = None
         self.annotations = annotations.astype(np.float32)
 
+    def row_lengths(self) -> np.ndarray:
+        """(N,) tokenized lengths incl. <sos>/<eos> (crop-invariant)."""
+        return (self.tokens != 0).sum(axis=1).astype(np.int64)
+
     def __len__(self) -> int:
         return len(self.tokens)
 
@@ -130,6 +134,14 @@ class HDF5PretrainingDataset:
 
     def __len__(self) -> int:
         return self._n
+
+    def row_lengths(self) -> np.ndarray:
+        """(N,) tokenized lengths incl. <sos>/<eos>, capped at seq_len —
+        stable across epochs even under re-cropping (a crop moves the
+        window, not the length). Reads the h5 `seq_lengths` column the
+        reference writes but never uses (reference uniref_dataset.py:245)."""
+        raw = self._f["seq_lengths"][:].astype(np.int64)
+        return np.minimum(raw + 2, self.seq_len)
 
     @property
     def shuffle_block(self) -> int:
@@ -199,6 +211,33 @@ def _epoch_order(
     return out
 
 
+def _make_fetch(dataset):
+    """Row-index array → {"tokens","annotations"} batch, via the dataset's
+    batched gather when it has one."""
+    get_batch = getattr(dataset, "get_batch", None)
+
+    def fetch(idx: np.ndarray) -> Dict[str, np.ndarray]:
+        if get_batch is not None:
+            return get_batch(idx)
+        rows = [dataset[int(i)] for i in idx]
+        return {
+            "tokens": np.stack([r["tokens"] for r in rows]),
+            "annotations": np.stack([r["annotations"] for r in rows]),
+        }
+
+    return fetch
+
+
+def _check_per_host(n: int, batch_size: int, process_count: int) -> int:
+    per_host = n // process_count
+    if per_host < batch_size:
+        raise ValueError(
+            f"per-host shard of {per_host} rows (n={n}, hosts={process_count}) "
+            f"cannot fill a batch of {batch_size}"
+        )
+    return per_host
+
+
 def make_pretrain_iterator(
     dataset,
     batch_size: int,
@@ -232,14 +271,9 @@ def make_pretrain_iterator(
     utils.py:267-282).
     """
     n = len(dataset)
-    per_host = n // process_count
-    if per_host < batch_size:
-        raise ValueError(
-            f"per-host shard of {per_host} rows (n={n}, hosts={process_count}) "
-            f"cannot fill a batch of {batch_size}"
-        )
+    per_host = _check_per_host(n, batch_size, process_count)
     block = getattr(dataset, "shuffle_block", None)
-    get_batch = getattr(dataset, "get_batch", None)
+    fetch = _make_fetch(dataset)
     rng = np.random.default_rng(seed)
     epoch = 0
     while num_epochs is None or epoch < num_epochs:
@@ -252,13 +286,88 @@ def make_pretrain_iterator(
             if skip_batches > 0:
                 skip_batches -= 1
                 continue
-            idx = shard[lo : lo + batch_size]
-            if get_batch is not None:
-                yield get_batch(idx)
-            else:
-                rows = [dataset[int(i)] for i in idx]
-                yield {
-                    "tokens": np.stack([r["tokens"] for r in rows]),
-                    "annotations": np.stack([r["annotations"] for r in rows]),
-                }
+            yield fetch(shard[lo : lo + batch_size])
+        epoch += 1
+
+
+def make_bucketed_iterator(
+    dataset,
+    batch_size: int,
+    buckets: Sequence[int],
+    seed: int = 0,
+    shuffle: bool = True,
+    num_epochs: Optional[int] = None,
+    process_index: int = 0,
+    process_count: int = 1,
+    skip_batches: int = 0,
+) -> Iterator[Dict[str, np.ndarray]]:
+    """Length-bucketed batch iterator (SURVEY §7 stage 10).
+
+    The reference pads every sequence to one global max length (reference
+    data_processing.py:155,165-167) — at seq_len 2048 with typical UniRef
+    lengths (~350) that is >80% pad FLOPs. Here each row goes to the
+    smallest bucket that fits its tokenized length and batches are emitted
+    per bucket, sliced to the bucket length. Model + loss are
+    shape-parametric in L (per-feature LN, weighted loss), so each bucket
+    just compiles one more executable of the same jitted step.
+
+    Multi-host lockstep: every host runs the SAME bucket bookkeeping over
+    the full global index stream (identical seed → identical fill order),
+    and when a bucket fills with batch_size·process_count rows each host
+    fetches only its slice — so at every step all hosts present the same
+    batch shape and per-epoch batch count, the invariant collective steps
+    require (`batch_size` stays per-host, like make_pretrain_iterator).
+
+    `skip_batches` replays only the (cheap) index bookkeeping — no data is
+    fetched for skipped batches, so checkpoint resume costs seconds, not
+    an I/O replay of the consumed stream.
+
+    Buckets must be ascending; the last must equal the dataset seq_len
+    (rows longer than it are cropped there by tokenization). Bucket
+    remainders carry over epoch boundaries and are dropped only when the
+    iterator ends (num_epochs reached) — with static batch shapes a
+    partial batch cannot be emitted.
+    """
+    if isinstance(buckets, str) or not hasattr(buckets, "__iter__"):
+        raise ValueError(
+            f"buckets must be a sequence of ints, got {buckets!r} "
+            "(e.g. --set data.buckets=[512,1024,2048])")
+    try:
+        buckets = sorted(int(b) for b in buckets)
+    except (TypeError, ValueError):
+        raise ValueError(f"buckets must be ints, got {buckets!r}") from None
+    if buckets[-1] != dataset.seq_len:
+        raise ValueError(
+            f"last bucket {buckets[-1]} must equal dataset seq_len "
+            f"{dataset.seq_len}")
+    lengths = dataset.row_lengths()
+    n = len(dataset)
+    per_host = _check_per_host(n, batch_size, process_count)
+    global_batch = batch_size * process_count
+    # Assign each row to its bucket once (lengths are crop-invariant).
+    bucket_of = np.searchsorted(buckets, lengths)
+
+    block = getattr(dataset, "shuffle_block", None)
+    fetch = _make_fetch(dataset)
+    rng = np.random.default_rng(seed)
+    pending: Dict[int, list] = {b: [] for b in range(len(buckets))}
+    epoch = 0
+    while num_epochs is None or epoch < num_epochs:
+        order = _epoch_order(n, rng, shuffle, block)[: per_host * process_count]
+        for i in order:
+            b = int(bucket_of[i])
+            pending[b].append(i)
+            if len(pending[b]) < global_batch:
+                continue
+            rows = pending[b]
+            pending[b] = []
+            if skip_batches > 0:
+                skip_batches -= 1
+                continue
+            mine = np.asarray(
+                rows[process_index * batch_size
+                     : (process_index + 1) * batch_size])
+            batch = fetch(mine)
+            batch["tokens"] = batch["tokens"][:, : buckets[b]]
+            yield batch
         epoch += 1

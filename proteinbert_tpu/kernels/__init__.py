@@ -3,13 +3,19 @@
 from proteinbert_tpu.kernels.fused_block import (
     MAX_PALLAS_DIM,
     fused_local_track,
+    fused_local_track_valid,
     local_track_reference,
+    local_track_valid_reference,
     pallas_supported,
+    track_halo,
 )
 
 __all__ = [
     "MAX_PALLAS_DIM",
     "fused_local_track",
+    "fused_local_track_valid",
     "local_track_reference",
+    "local_track_valid_reference",
     "pallas_supported",
+    "track_halo",
 ]

@@ -73,6 +73,55 @@ def local_track_reference(
     )
 
 
+def track_halo(params: Params, narrow_dilation: int = 1,
+               wide_dilation: int = 5) -> int:
+    """Context rows each side a shard needs for exact conv results (20 for
+    the reference k=9/d=5 geometry)."""
+    nt = params["narrow_conv"]["kernel"].shape[0]
+    wt = params["wide_conv"]["kernel"].shape[0]
+    return max((nt - 1) // 2 * narrow_dilation, (wt - 1) // 2 * wide_dilation)
+
+
+def local_track_valid_reference(
+    params: Params, xh: jax.Array, broadcast: jax.Array,
+    narrow_dilation: int = 1, wide_dilation: int = 5,
+) -> jax.Array:
+    """Local track on a PRE-HALOED shard: `xh` is (B, L + 2·halo, C) whose
+    first/last `halo` rows are real neighbor context (sequence
+    parallelism, parallel/halo.py) rather than zeros; output is the (B, L,
+    C) center. Semantically equals slicing rows [halo, halo+L) out of
+    local_track_reference applied to the neighbor-stitched sequence."""
+    from proteinbert_tpu.ops.layers import dense_apply, layer_norm_apply
+
+    H = track_halo(params, narrow_dilation, wide_dilation)
+    L = xh.shape[1] - 2 * H
+
+    def valid_conv(p, dilation):
+        y = lax.conv_general_dilated(
+            xh, p["kernel"].astype(xh.dtype), window_strides=(1,),
+            padding="VALID", rhs_dilation=(dilation,),
+            dimension_numbers=("NWC", "WIO", "NWC"),
+        )
+        return y + p["bias"].astype(xh.dtype)
+
+    # VALID output row m covers input rows starting at m; center row l of
+    # a 'SAME' conv corresponds to window start l + H - ((k-1)/2)·d.
+    n_off = H - (params["narrow_conv"]["kernel"].shape[0] - 1) // 2 * narrow_dilation
+    w_off = H - (params["wide_conv"]["kernel"].shape[0] - 1) // 2 * wide_dilation
+    narrow = _gelu(valid_conv(params["narrow_conv"], narrow_dilation)
+                   [:, n_off:n_off + L])
+    wide = _gelu(valid_conv(params["wide_conv"], wide_dilation)
+                 [:, w_off:w_off + L])
+    h = layer_norm_apply(
+        params["local_ln1"],
+        xh[:, H:H + L] + narrow + wide + broadcast[:, None, :],
+    )
+    return layer_norm_apply(
+        params["local_ln2"],
+        h + _gelu(dense_apply(params["local_dense"], h)),
+    )
+
+
 def _tap_matmuls(window, kernel, taps, dilation, halo, tile):
     """Σ_t window[halo + (t-(K-1)/2)·d : …+tile] @ kernel[t]  (fp32 acc).
 
@@ -131,20 +180,28 @@ def _fused_kernel(
 def _pallas_forward(
     params: Params, x: jax.Array, broadcast: jax.Array,
     narrow_dilation: int, wide_dilation: int, interpret: bool,
+    prehaloed: bool = False,
 ) -> jax.Array:
-    B, L, C = x.shape
     nk = params["narrow_conv"]["kernel"]
     wk = params["wide_conv"]["kernel"]
     narrow_taps, wide_taps = nk.shape[0], wk.shape[0]
     halo = max((narrow_taps - 1) // 2 * narrow_dilation,
                (wide_taps - 1) // 2 * wide_dilation)
 
+    dtype = x.dtype
+    if prehaloed:
+        # x rows already carry `halo` rows of real neighbor context on
+        # each side (sequence parallelism); output is the center.
+        B, Lp, C = x.shape
+        L = Lp - 2 * halo
+        x_padded = x
+    else:
+        B, L, C = x.shape
+        x_padded = jnp.pad(x, ((0, 0), (halo, halo), (0, 0)))
+        Lp = L + 2 * halo
+
     tile = _pick_tile(L)
     grid = (B, L // tile)
-
-    dtype = x.dtype
-    x_padded = jnp.pad(x, ((0, 0), (halo, halo), (0, 0)))
-    Lp = L + 2 * halo
 
     def vec(p):  # (C,) fp32 vector → (1, C) activation-dtype VMEM block
         return p.reshape(1, C)
@@ -257,3 +314,38 @@ def _bwd(narrow_dilation, wide_dilation, interpret, res, g):
 
 
 fused_local_track.defvjp(_fwd, _bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def fused_local_track_valid(
+    params: Params, xh: jax.Array, broadcast: jax.Array,
+    narrow_dilation: int = 1, wide_dilation: int = 5,
+    interpret: bool = False,
+) -> jax.Array:
+    """Pre-haloed variant for sequence parallelism: `xh` (B, L+2·halo, C)
+    carries real neighbor rows (parallel/halo.halo_exchange); returns the
+    (B, L, C) center. Ground truth: local_track_valid_reference."""
+    return _pallas_forward(params, xh, broadcast,
+                           narrow_dilation, wide_dilation, interpret,
+                           prehaloed=True)
+
+
+def _fwd_valid(params, xh, broadcast, narrow_dilation, wide_dilation, interpret):
+    y = _pallas_forward(params, xh, broadcast,
+                        narrow_dilation, wide_dilation, interpret,
+                        prehaloed=True)
+    return y, (params, xh, broadcast)
+
+
+def _bwd_valid(narrow_dilation, wide_dilation, interpret, res, g):
+    params, xh, broadcast = res
+    _, vjp = jax.vjp(
+        lambda p, xx, bb: local_track_valid_reference(
+            p, xx, bb, narrow_dilation, wide_dilation
+        ),
+        params, xh, broadcast,
+    )
+    return vjp(g)
+
+
+fused_local_track_valid.defvjp(_fwd_valid, _bwd_valid)

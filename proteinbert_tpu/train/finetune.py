@@ -32,6 +32,7 @@ from proteinbert_tpu.configs import FinetuneConfig
 from proteinbert_tpu.data.vocab import PAD_ID
 from proteinbert_tpu.models import finetune as ft_model
 from proteinbert_tpu.train.schedule import make_optimizer, needs_loss_value
+from proteinbert_tpu.train.train_state import gradient_update
 
 logger = logging.getLogger(__name__)
 
@@ -112,13 +113,10 @@ def finetune_step(
         return task_loss(outputs, batch, cfg.task.kind)
 
     grads, metrics = jax.grad(loss_fn, has_aux=True)(state.params)
-    tx = make_finetune_optimizer(cfg)
-    extra = ({"value": metrics["loss"]}
-             if needs_loss_value(cfg.optimizer) else {})
-    updates, opt_state = tx.update(grads, state.opt_state, state.params,
-                                   **extra)
-    params = jax.tree.map(lambda p, u: p + u.astype(p.dtype),
-                          state.params, updates)
+    params, opt_state = gradient_update(
+        make_finetune_optimizer(cfg), state.params, grads, state.opt_state,
+        metrics["loss"], needs_loss_value(cfg.optimizer),
+    )
     return FinetuneState(step=state.step + 1, params=params,
                          opt_state=opt_state), metrics
 

@@ -42,6 +42,19 @@ class TrainState:
     key: jax.Array
 
 
+def gradient_update(
+    tx, params: Any, grads: Any, opt_state: Any,
+    loss: Any = None, needs_value: bool = False,
+) -> Tuple[Any, Any]:
+    """Shared optimizer-apply: update → params + cast-preserving add.
+    Single source of truth for the default, sequence-parallel
+    (parallel/seq_parallel.py) and fine-tune (train/finetune.py) steps."""
+    extra = {"value": loss} if needs_value else {}
+    updates, opt_state = tx.update(grads, opt_state, params, **extra)
+    params = jax.tree.map(lambda p, u: p + u.astype(p.dtype), params, updates)
+    return params, opt_state
+
+
 def create_train_state(key: jax.Array, cfg: PretrainConfig) -> TrainState:
     k_init, k_state = jax.random.split(key)
     params = proteinbert.init(k_init, cfg.model)
@@ -79,13 +92,10 @@ def train_step(
 
     grads, metrics = jax.grad(loss_fn, has_aux=True)(state.params)
 
-    tx = make_optimizer(cfg.optimizer)
-    extra = {"value": metrics["loss"]} if needs_loss_value(cfg.optimizer) else {}
-    updates, opt_state = tx.update(
-        grads, state.opt_state, state.params, **extra
+    params, opt_state = gradient_update(
+        make_optimizer(cfg.optimizer), state.params, grads, state.opt_state,
+        metrics["loss"], needs_loss_value(cfg.optimizer),
     )
-    params = jax.tree.map(lambda p, u: p + u.astype(p.dtype),
-                          state.params, updates)
 
     metrics = dict(metrics)
     metrics["grad_norm"] = optax.global_norm(grads)

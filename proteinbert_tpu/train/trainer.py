@@ -80,6 +80,21 @@ def pretrain(
 
     put = _make_batch_put(mesh)
 
+    # The implicit-SPMD jit handles every sharding EXCEPT the Pallas fused
+    # kernel under sequence parallelism (a pallas_call is opaque to the
+    # partitioner) — that combination runs the explicit shard_map step
+    # (parallel/seq_parallel.py).
+    if mesh is not None and cfg.mesh.seq > 1 and cfg.model.use_pallas:
+        from proteinbert_tpu.parallel.seq_parallel import (
+            make_seq_parallel_train_step,
+        )
+
+        seq_step = make_seq_parallel_train_step(mesh, cfg)
+        step_fn = lambda state, batch, _cfg: seq_step(state, batch)  # noqa: E731
+        logger.info("using explicit sequence-parallel train step (pallas)")
+    else:
+        step_fn = ts.train_step
+
     start_step = int(state.step)
     n_chips = mesh.size if mesh is not None else jax.device_count()
     timer = StepTimer(
@@ -92,7 +107,7 @@ def pretrain(
 
     for step in range(start_step, cfg.train.max_steps):
         batch = next(batch_iterator)
-        state, metrics = ts.train_step(state, put(batch), cfg)
+        state, metrics = step_fn(state, put(batch), cfg)
         timer.update()
 
         if cfg.train.log_every and (step + 1) % cfg.train.log_every == 0:

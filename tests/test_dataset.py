@@ -125,3 +125,97 @@ def test_inmemory_recrops_long_rows_per_access():
     assert len(draws) > 1
     batch_draws = {ds.get_batch(np.array([0]))["tokens"].tobytes() for _ in range(10)}
     assert len(batch_draws) > 1
+
+
+def test_row_lengths():
+    seqs = ["ACDE", "A" * 100, ""]
+    ds = InMemoryPretrainingDataset(seqs, np.zeros((3, 4)), seq_len=32)
+    # tokenized = min(raw, seq_len-2) + sos + eos
+    np.testing.assert_array_equal(ds.row_lengths(), [6, 32, 2])
+
+
+def test_bucketed_iterator():
+    from proteinbert_tpu.data.dataset import make_bucketed_iterator
+
+    rng = np.random.default_rng(0)
+    seqs = ["".join(rng.choice(list("ACDEFGHIKLMNPQRSTVWY"),
+                               size=int(rng.integers(1, 120))))
+            for _ in range(96)]
+    ds = InMemoryPretrainingDataset(seqs, np.zeros((96, 8)), seq_len=128)
+    it = make_bucketed_iterator(ds, 4, buckets=(32, 64, 128), seed=0,
+                                num_epochs=1)
+    seen = 0
+    for batch in it:
+        L = batch["tokens"].shape[1]
+        assert L in (32, 64, 128)
+        lengths = (batch["tokens"] != 0).sum(axis=1)
+        # Every row fits its bucket and (except the smallest bucket)
+        # would NOT fit the next smaller one.
+        assert (lengths <= L).all()
+        if L > 32:
+            prev = {64: 32, 128: 64}[L]
+            assert (lengths > prev).all()
+        seen += len(batch["tokens"])
+    assert seen >= 96 - 3 * 4 + 4  # at most one partial batch per bucket lost
+
+
+def test_bucketed_iterator_validates():
+    from proteinbert_tpu.data.dataset import make_bucketed_iterator
+
+    ds = InMemoryPretrainingDataset(["ACDE"] * 8, np.zeros((8, 4)), seq_len=64)
+    with pytest.raises(ValueError, match="must equal dataset seq_len"):
+        next(make_bucketed_iterator(ds, 2, buckets=(32,), num_epochs=1))
+
+
+def test_bucketed_iterator_multihost_lockstep():
+    """Review fix: every host must emit the same batch-shape sequence and
+    count (collective steps deadlock otherwise)."""
+    from proteinbert_tpu.data.dataset import make_bucketed_iterator
+
+    rng = np.random.default_rng(1)
+    seqs = ["".join(rng.choice(list("ACDEFGHIKLMNPQRSTVWY"),
+                               size=int(rng.integers(1, 120))))
+            for _ in range(128)]
+    ds = InMemoryPretrainingDataset(seqs, np.zeros((128, 8)), seq_len=128)
+    shapes = []
+    rows_seen = [set(), set()]
+    for p in range(2):
+        it = make_bucketed_iterator(ds, 4, buckets=(32, 64, 128), seed=3,
+                                    num_epochs=1, process_index=p,
+                                    process_count=2)
+        host_shapes = []
+        for b in it:
+            host_shapes.append(b["tokens"].shape)
+            assert b["tokens"].shape[0] == 4  # per-host batch size
+            for t in b["tokens"]:
+                rows_seen[p].add(t.tobytes())
+        shapes.append(host_shapes)
+    assert shapes[0] == shapes[1] and shapes[0]
+    # Hosts fetch DISJOINT halves of each global batch.
+    assert not (rows_seen[0] & rows_seen[1])
+
+
+def test_bucketed_iterator_skip_batches():
+    """skip_batches resumes the exact stream position without fetching."""
+    from proteinbert_tpu.data.dataset import make_bucketed_iterator
+
+    rng = np.random.default_rng(2)
+    seqs = ["".join(rng.choice(list("ACDEFGHIKLMNPQRSTVWY"),
+                               size=int(rng.integers(1, 120))))
+            for _ in range(96)]
+    ds = InMemoryPretrainingDataset(seqs, np.zeros((96, 8)), seq_len=128)
+    full = list(make_bucketed_iterator(ds, 4, (32, 64, 128), seed=5,
+                                       num_epochs=2))
+    skipped = list(make_bucketed_iterator(ds, 4, (32, 64, 128), seed=5,
+                                          num_epochs=2, skip_batches=3))
+    assert len(skipped) == len(full) - 3
+    for a, b in zip(full[3:], skipped):
+        np.testing.assert_array_equal(a["tokens"], b["tokens"])
+
+
+def test_bucketed_iterator_rejects_strings():
+    from proteinbert_tpu.data.dataset import make_bucketed_iterator
+
+    ds = InMemoryPretrainingDataset(["ACDE"] * 8, np.zeros((8, 4)), seq_len=64)
+    with pytest.raises(ValueError, match="sequence of ints"):
+        next(make_bucketed_iterator(ds, 2, "32,64", num_epochs=1))

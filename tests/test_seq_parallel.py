@@ -1,0 +1,156 @@
+"""Explicit sequence-parallel path vs the unsharded model (SURVEY §7
+stage 10): shard_map forward/gradients, distributed softmax, pre-haloed
+fused-track variants — all on the 8-device CPU mesh."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from proteinbert_tpu.configs import (
+    DataConfig, MeshConfig, ModelConfig, OptimizerConfig, PretrainConfig,
+    TrainConfig,
+)
+from proteinbert_tpu.kernels import (
+    fused_local_track_valid, local_track_reference,
+    local_track_valid_reference, track_halo,
+)
+from proteinbert_tpu.models import proteinbert
+from proteinbert_tpu.parallel import make_mesh
+from proteinbert_tpu.parallel.seq_parallel import (
+    make_seq_parallel_train_step, seq_parallel_apply,
+)
+
+requires_8 = pytest.mark.skipif(
+    jax.device_count() < 8, reason="needs 8 (virtual) devices"
+)
+
+MODEL = ModelConfig(local_dim=16, global_dim=32, key_dim=8, num_heads=4,
+                    num_blocks=2, num_annotations=64, dtype="float32")
+
+
+def _inputs(key, B=4, L=128, A=64):
+    kt, ka = jax.random.split(key)
+    tokens = np.array(jax.random.randint(kt, (B, L), 4, 26))
+    # Real padding tails so the distributed softmax's masking is exercised.
+    tokens[:, L - 16:] = 0
+    ann = np.asarray(
+        (jax.random.uniform(ka, (B, A)) < 0.1).astype(np.float32))
+    return jnp.asarray(tokens), jnp.asarray(ann)
+
+
+def test_valid_reference_matches_same_padding(key):
+    """Center rows of the pre-haloed VALID track == zero-padded track when
+    the halo rows really are zeros."""
+    kp, kx, kb = jax.random.split(key, 3)
+    block = proteinbert.block_init(kp, MODEL)
+    track = {k: block[k] for k in ("narrow_conv", "wide_conv", "local_ln1",
+                                   "local_dense", "local_ln2")}
+    x = jax.random.normal(kx, (2, 64, MODEL.local_dim))
+    b = jax.random.normal(kb, (2, MODEL.local_dim))
+    H = track_halo(track, 1, MODEL.wide_dilation)
+    xh = jnp.pad(x, ((0, 0), (H, H), (0, 0)))
+    got = local_track_valid_reference(track, xh, b, 1, MODEL.wide_dilation)
+    want = local_track_reference(track, x, b, 1, MODEL.wide_dilation)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_fused_valid_kernel_parity(key):
+    """Pallas pre-haloed kernel == VALID reference (with REAL halo rows)."""
+    C = 128
+    cfg = dataclasses.replace(MODEL, local_dim=C)
+    kp, kx, kb = jax.random.split(key, 3)
+    block = proteinbert.block_init(kp, cfg)
+    track = {k: block[k] for k in ("narrow_conv", "wide_conv", "local_ln1",
+                                   "local_dense", "local_ln2")}
+    H = track_halo(track, 1, cfg.wide_dilation)
+    xh = jax.random.normal(kx, (2, 64 + 2 * H, C))  # halos are real data
+    b = jax.random.normal(kb, (2, C))
+    got = fused_local_track_valid(track, xh, b, 1, cfg.wide_dilation, True)
+    want = local_track_valid_reference(track, xh, b, 1, cfg.wide_dilation)
+    assert got.shape == (2, 64, C)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+@requires_8
+@pytest.mark.parametrize("mesh_cfg", [
+    MeshConfig(data=2, seq=4),
+    MeshConfig(data=2, fsdp=2, seq=2),
+], ids=["dp-sp4", "dp-fsdp-sp2"])
+def test_seq_parallel_forward_parity(key, mesh_cfg):
+    mesh = make_mesh(mesh_cfg)
+    params = proteinbert.init(key, MODEL)
+    tokens, ann = _inputs(jax.random.fold_in(key, 1))
+    want_l, want_g = proteinbert.apply(params, tokens, ann, MODEL)
+    got_l, got_g = seq_parallel_apply(mesh, params, tokens, ann, MODEL)
+    np.testing.assert_allclose(np.asarray(got_l), np.asarray(want_l),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(got_g), np.asarray(want_g),
+                               rtol=2e-5, atol=2e-5)
+
+
+@requires_8
+def test_seq_parallel_gradient_parity(key):
+    mesh = make_mesh(MeshConfig(data=2, seq=4))
+    params = proteinbert.init(key, MODEL)
+    tokens, ann = _inputs(jax.random.fold_in(key, 1))
+
+    def loss_sharded(p):
+        l, g = seq_parallel_apply(mesh, p, tokens, ann, MODEL)
+        return jnp.sum(l ** 2) + jnp.sum(g ** 2)
+
+    def loss_plain(p):
+        l, g = proteinbert.apply(p, tokens, ann, MODEL)
+        return jnp.sum(l ** 2) + jnp.sum(g ** 2)
+
+    g_sharded = jax.grad(loss_sharded)(params)
+    g_plain = jax.grad(loss_plain)(params)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-4),
+        g_sharded, g_plain,
+    )
+
+
+@requires_8
+def test_seq_parallel_train_step(key):
+    """Full seq-parallel train step (with the fused Pallas local track in
+    interpret mode) matches the default train step's loss."""
+    from proteinbert_tpu.parallel import batch_sharding, shard_train_state
+    from proteinbert_tpu.train import create_train_state, train_step
+
+    model = dataclasses.replace(MODEL, local_dim=128, use_pallas=True)
+    mesh_cfg = MeshConfig(data=2, seq=4)
+    cfg = PretrainConfig(
+        model=model,
+        data=DataConfig(seq_len=128, batch_size=4),
+        optimizer=OptimizerConfig(warmup_steps=10),
+        mesh=mesh_cfg,
+        train=TrainConfig(max_steps=1),
+    )
+    tokens, ann = _inputs(jax.random.fold_in(key, 2), B=4, L=128,
+                          A=model.num_annotations)
+    batch = {"tokens": np.asarray(tokens), "annotations": np.asarray(ann)}
+
+    ref_state, ref_metrics = train_step(
+        create_train_state(jax.random.PRNGKey(0), cfg), dict(batch), cfg)
+
+    mesh = make_mesh(mesh_cfg)
+    state = shard_train_state(
+        create_train_state(jax.random.PRNGKey(0), cfg), mesh)
+    bsh = batch_sharding(mesh)
+    dbatch = {k: jax.device_put(v, bsh[k]) for k, v in batch.items()}
+    step = make_seq_parallel_train_step(mesh, cfg)
+    new_state, metrics = step(state, dbatch)
+
+    assert float(metrics["loss"]) == pytest.approx(
+        float(ref_metrics["loss"]), rel=1e-4)
+    assert int(jax.device_get(new_state.step)) == 1
+    for r, g in zip(jax.tree.leaves(ref_state.params),
+                    jax.tree.leaves(new_state.params)):
+        np.testing.assert_allclose(np.asarray(r),
+                                   np.asarray(jax.device_get(g)), atol=1e-4)

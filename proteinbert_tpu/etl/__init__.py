@@ -10,6 +10,7 @@ create_uniref_db.py / creare_uniref_h5_db.py):
 """
 
 from proteinbert_tpu.etl.fasta import FastaReader, build_index, iter_fasta
+from proteinbert_tpu.etl.genome import GenomeReader
 from proteinbert_tpu.etl.go_ontology import (
     GoOntology,
     GoTerm,
@@ -29,7 +30,7 @@ from proteinbert_tpu.etl.uniref_parser import (
 )
 
 __all__ = [
-    "FastaReader", "build_index", "iter_fasta",
+    "FastaReader", "GenomeReader", "build_index", "iter_fasta",
     "GoOntology", "GoTerm", "parse_obo", "save_meta_csv", "load_meta_csv",
     "create_h5_dataset", "load_seqs_and_annotations",
     "UnirefToSqliteParser", "merge_shard_dbs", "read_aggregates",

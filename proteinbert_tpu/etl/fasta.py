@@ -118,13 +118,25 @@ class FastaReader:
 
     def fetch(self, name: str) -> str:
         """Full sequence for `name` (KeyError if absent, like pyfaidx)."""
+        return self.fetch_range(name, 0, self.index[name][0])
+
+    def fetch_range(self, name: str, start: int, end: int) -> str:
+        """Bases [start, end) (0-based) of record `name`, via
+        coordinate→byte-offset arithmetic — one seek + one read, the
+        random-access primitive the reference's ChromosomeReader builds
+        its genome coordinates on (reference
+        shared_utils/reference_genome.py:67-99)."""
         rlen, off, line_bases, line_bytes = self.index[name]
-        if rlen == 0:
+        start = max(0, start)
+        end = min(rlen, end)
+        if end <= start:
             return ""
-        n_full = (rlen - 1) // line_bases if line_bases else 0
-        span = rlen + n_full * (line_bytes - line_bases)
-        self._f.seek(off)
-        raw = self._f.read(span)
+        newline = line_bytes - line_bases
+        byte_lo = off + start + (start // line_bases) * newline
+        last = end - 1
+        byte_hi = off + last + (last // line_bases) * newline + 1
+        self._f.seek(byte_lo)
+        raw = self._f.read(byte_hi - byte_lo)
         return raw.replace(b"\n", b"").replace(b"\r", b"").decode()
 
     def close(self) -> None:

@@ -1,8 +1,14 @@
-"""Host-side utilities: logging, profiling, ETL sharding, h5 helpers
-(reference C17/C20/C21, rebuilt — see each module's docstring)."""
+"""Host-side utilities: logging, profiling, ETL sharding, h5 helpers,
+stats (reference C17/C20/C21/C22, rebuilt — see each module's docstring)."""
 
 from proteinbert_tpu.utils.logging import log, start_log
 from proteinbert_tpu.utils.profiling import Profiler, TimeMeasure, device_trace
+from proteinbert_tpu.utils.stats import (
+    benjamini_hochberg,
+    drop_redundant_columns,
+    fisher_enrichment,
+    one_hot,
+)
 from proteinbert_tpu.utils.sharding import (
     all_shard_file_names,
     shard_file_name,
@@ -17,4 +23,6 @@ __all__ = [
     "Profiler", "TimeMeasure", "device_trace",
     "to_chunks", "shard_range", "shard_items", "task_identity",
     "shard_file_name", "all_shard_file_names",
+    "benjamini_hochberg", "drop_redundant_columns", "fisher_enrichment",
+    "one_hot",
 ]

@@ -1,0 +1,123 @@
+"""Statistics helpers (reference C22 parity, the parts worth keeping).
+
+The reference vendors a large stats/plot grab-bag (reference
+shared_utils/util.py:697-1105). The numeric pieces are reimplemented here
+with scipy/numpy; plotting wrappers are provided behind a lazy matplotlib
+import (matplotlib is optional in this image). The reference's
+`as_hot_encoding` forgets its return statement (reference
+shared_utils/util.py:538-551, SURVEY ledger #12) — `one_hot` here
+actually returns.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def one_hot(labels: Sequence, num_classes: Optional[int] = None) -> np.ndarray:
+    """(N, num_classes) 0/1 matrix (fixes reference ledger #12)."""
+    labels = np.asarray(labels, dtype=np.int64)
+    if labels.size and labels.min() < 0:
+        raise ValueError("labels must be non-negative")
+    k = num_classes if num_classes is not None else (int(labels.max()) + 1
+                                                    if labels.size else 0)
+    if labels.size and labels.max() >= k:
+        raise ValueError(
+            f"label {int(labels.max())} out of range for {k} classes")
+    out = np.zeros((len(labels), k), dtype=np.float32)
+    if labels.size:
+        out[np.arange(len(labels)), labels] = 1.0
+    return out
+
+
+def drop_redundant_columns(x: np.ndarray, tol: float = 1e-8) -> np.ndarray:
+    """Keep a maximal linearly-independent column subset — the dummy-
+    variable-trap / quasi-separation guard of the reference's regression
+    helpers (reference shared_utils/util.py:697-872), reduced to its
+    numeric core."""
+    from proteinbert_tpu.utils.h5 import find_linearly_independent_columns
+
+    return np.asarray(x)[:, find_linearly_independent_columns(x, tol)]
+
+
+def benjamini_hochberg(pvals: Sequence[float]) -> np.ndarray:
+    """FDR-adjusted q-values (reference shared_utils/util.py:888-898)."""
+    p = np.asarray(pvals, dtype=np.float64)
+    n = p.size
+    if n == 0:
+        return p
+    order = np.argsort(p)
+    ranked = p[order] * n / np.arange(1, n + 1)
+    # enforce monotonicity from the largest rank down
+    ranked = np.minimum.accumulate(ranked[::-1])[::-1]
+    out = np.empty(n)
+    out[order] = np.minimum(ranked, 1.0)
+    return out
+
+
+def fisher_enrichment(
+    n_overlap: int, n_set1: int, n_set2: int, n_total: int,
+) -> Tuple[float, float]:
+    """(odds_ratio, p_value) of the overlap of two sets under a universe
+    of n_total, one-sided greater — the reference's enrichment test
+    (reference shared_utils/util.py:901-937)."""
+    from scipy.stats import fisher_exact
+
+    a = n_overlap
+    b = n_set1 - n_overlap
+    c = n_set2 - n_overlap
+    d = n_total - n_set1 - n_set2 + n_overlap
+    if min(a, b, c, d) < 0:
+        raise ValueError(
+            f"inconsistent counts: overlap={n_overlap} set1={n_set1} "
+            f"set2={n_set2} total={n_total}")
+    odds, p = fisher_exact([[a, b], [c, d]], alternative="greater")
+    return float(odds), float(p)
+
+
+def _plt():
+    try:
+        import matplotlib
+        matplotlib.use("Agg", force=False)
+        import matplotlib.pyplot as plt
+        return plt
+    except ImportError as e:  # pragma: no cover - matplotlib is optional
+        raise ImportError(
+            "plot helpers need matplotlib, which is optional in this "
+            "environment") from e
+
+
+def qq_plot(pvals: Sequence[float], out_path: str) -> None:
+    """Observed vs expected -log10(p) (reference
+    shared_utils/util.py:968-1020), written to `out_path`."""
+    plt = _plt()
+    p = np.sort(np.asarray(pvals, dtype=np.float64))
+    p = np.clip(p, 1e-300, 1.0)
+    n = p.size
+    if n == 0:
+        raise ValueError("qq_plot needs at least one p-value")
+    exp = -np.log10((np.arange(1, n + 1) - 0.5) / n)
+    obs = -np.log10(p)
+    fig, ax = plt.subplots(figsize=(4, 4))
+    ax.plot(exp, obs, ".", ms=3)
+    lim = max(exp.max(), obs.max()) * 1.05
+    ax.plot([0, lim], [0, lim], "r--", lw=1)
+    ax.set_xlabel("expected -log10(p)")
+    ax.set_ylabel("observed -log10(p)")
+    fig.tight_layout()
+    fig.savefig(out_path)
+    plt.close(fig)
+
+
+def scatter_plot(x, y, out_path: str, xlabel: str = "", ylabel: str = "") -> None:
+    """Basic labeled scatter (reference shared_utils/util.py:1023-1105)."""
+    plt = _plt()
+    fig, ax = plt.subplots(figsize=(4, 4))
+    ax.plot(np.asarray(x), np.asarray(y), ".", ms=3)
+    ax.set_xlabel(xlabel)
+    ax.set_ylabel(ylabel)
+    fig.tight_layout()
+    fig.savefig(out_path)
+    plt.close(fig)

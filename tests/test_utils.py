@@ -89,3 +89,103 @@ def test_numpy_helpers():
     x = np.column_stack([a[:, 0], a[:, 1], a[:, 0] + a[:, 1], rng.random(10)])
     idx = find_linearly_independent_columns(x)
     assert len(idx) == 3
+
+
+# ---------------------------------------------------------------- stats (C22)
+
+def test_one_hot():
+    from proteinbert_tpu.utils.stats import one_hot
+
+    out = one_hot([0, 2, 1], 4)
+    assert out.shape == (3, 4)
+    np.testing.assert_array_equal(out.argmax(1), [0, 2, 1])
+    assert out.sum() == 3
+    # Inferred class count + empty input (the reference's version returns
+    # None always — SURVEY ledger #12).
+    assert one_hot([1, 1]).shape == (2, 2)
+    assert one_hot([]).shape == (0, 0)
+    with pytest.raises(ValueError):
+        one_hot([-1])
+
+
+def test_benjamini_hochberg():
+    from proteinbert_tpu.utils.stats import benjamini_hochberg
+
+    p = np.array([0.01, 0.04, 0.03, 0.005])
+    q = benjamini_hochberg(p)
+    # BH: sorted p * n/rank with monotone enforcement
+    np.testing.assert_allclose(q, [0.02, 0.04, 0.04, 0.02])
+    assert benjamini_hochberg([]).size == 0
+    assert (benjamini_hochberg(np.ones(5)) == 1.0).all()
+
+
+def test_fisher_enrichment():
+    from proteinbert_tpu.utils.stats import fisher_enrichment
+
+    # Strong overlap → small p; no overlap → p ~= 1.
+    odds, p = fisher_enrichment(18, 20, 20, 1000)
+    assert p < 1e-10 and odds > 1
+    _, p_null = fisher_enrichment(0, 20, 20, 1000)
+    assert p_null > 0.5
+    with pytest.raises(ValueError, match="inconsistent"):
+        fisher_enrichment(30, 20, 20, 1000)
+
+
+def test_drop_redundant_columns():
+    from proteinbert_tpu.utils.stats import drop_redundant_columns
+
+    rng = np.random.default_rng(0)
+    a = rng.normal(size=(20, 3))
+    x = np.c_[a, a[:, 0] + a[:, 1]]  # 4th col dependent
+    out = drop_redundant_columns(x)
+    assert out.shape == (20, 3)
+    assert np.linalg.matrix_rank(out) == 3
+
+
+# --------------------------------------------------------------- genome (C23)
+
+def test_genome_reader(tmp_path):
+    from proteinbert_tpu.etl.genome import GenomeReader
+
+    fa = tmp_path / "genome.fasta"
+    fa.write_text(
+        ">chr1\nACGTACGTAC\nGTACGTACGT\nACGT\n"
+        ">chrX\nTTTTGGGG\n"
+        ">MT\nCCCCAAAA\n"
+    )
+    with GenomeReader(str(fa)) as g:
+        assert g.length("1") == 24
+        assert g.length("chr1") == 24
+        # 1-based inclusive (genomics convention)
+        assert g.fetch("1", 1, 4) == "ACGT"
+        assert g.fetch(1, 9, 12) == "ACGT"       # crosses a line wrap
+        assert g.fetch0("chr1", 0, 4) == "ACGT"  # 0-based half-open
+        # synonyms: 23=X, M/MT/25/26
+        assert g.fetch("X", 1, 4) == "TTTT"
+        assert g.fetch("23", 1, 4) == "TTTT"
+        assert g.fetch("M", 5, 8) == "AAAA"
+        assert g.fetch("chrMT", 1, 4) == "CCCC"
+        assert g.fetch("26", 1, 4) == "CCCC"
+        assert "chr2" not in g and "1" in g
+        with pytest.raises(KeyError):
+            g.fetch("nope", 1, 2)
+
+
+def test_fetch_range(tmp_path):
+    from proteinbert_tpu.etl.fasta import FastaReader
+
+    fa = tmp_path / "p.fasta"
+    fa.write_text(">A\nABCDEFGHIJ\nKLMNOPQRST\nUVWXY\n")
+    with FastaReader(str(fa)) as r:
+        assert r.fetch_range("A", 0, 10) == "ABCDEFGHIJ"
+        assert r.fetch_range("A", 8, 12) == "IJKL"      # crosses wrap
+        assert r.fetch_range("A", 19, 25) == "TUVWXY"   # into last line
+        assert r.fetch_range("A", 0, 999) == r.fetch("A")  # clamped
+        assert r.fetch_range("A", 5, 5) == ""
+
+
+def test_one_hot_out_of_range():
+    from proteinbert_tpu.utils.stats import one_hot
+
+    with pytest.raises(ValueError, match="out of range"):
+        one_hot([3], num_classes=2)

@@ -51,10 +51,31 @@ def tokenize(seq: str, seq_len: int, rng: np.random.Generator | None = None) -> 
     return out
 
 
+_NATIVE_MIN_BATCH = 8  # below this the ctypes call overhead wins
+
+
 def tokenize_batch(
-    seqs: Sequence[str], seq_len: int, rng: np.random.Generator | None = None
+    seqs: Sequence[str],
+    seq_len: int,
+    rng: np.random.Generator | None = None,
+    use_native: bool | None = None,
 ) -> np.ndarray:
-    """Tokenize a list of sequences to a dense (B, seq_len) int32 batch."""
+    """Tokenize a list of sequences to a dense (B, seq_len) int32 batch.
+
+    Real batches dispatch to the C++ kernel (native/tokenizer.cpp) when it
+    is available — same output contract, parity-tested; pass
+    use_native=False to force the numpy path. Crop windows are drawn from
+    the path's own stream (both uniform, both seeded from `rng`), so the
+    two paths are each reproducible but not window-identical.
+    """
+    if use_native is None:
+        use_native = len(seqs) >= _NATIVE_MIN_BATCH
+    if use_native:
+        from proteinbert_tpu.native import tokenize_batch_native
+
+        out = tokenize_batch_native(seqs, seq_len, rng)
+        if out is not None:
+            return out
     out = np.full((len(seqs), seq_len), PAD_ID, dtype=np.int32)
     for i, s in enumerate(seqs):
         out[i] = tokenize(s, seq_len, rng)

@@ -1,0 +1,15 @@
+"""Native (C++) host runtime components, compiled on first use.
+
+The reference has no native code at all (SURVEY §2 native-code census);
+this package supplies the TPU build's host-side native pieces. Components
+are built from the sources in this directory with the system toolchain on
+first import, cached by source hash under `_build/`, and loaded via
+ctypes — if no compiler is available everything falls back to the numpy
+implementations transparently (`native_available()` reports which path is
+live).
+"""
+
+from proteinbert_tpu.native.build import load_library, native_available
+from proteinbert_tpu.native.tokenizer import tokenize_batch_native
+
+__all__ = ["load_library", "native_available", "tokenize_batch_native"]

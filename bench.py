@@ -7,12 +7,48 @@ d=512, seq_len 512) denoising pretrain, synthetic data (the reference has
 no published numbers to compare against — BASELINE.md; vs_baseline is
 therefore measured MFU / the 0.40 north-star MFU target, so 1.0 means
 "hit the ≥40% MFU goal").
+
+A small sweep of execution variants is timed and the best reported:
+- xla+remat at large batch (rematerialisation removes the fp32 LayerNorm
+  saves that otherwise cap batch at 64 on a 16G chip and make the
+  non-remat step HBM-bound);
+- the Pallas fused local-track kernel (kernels/fused_block.py) at the
+  batch its VMEM plan likes — its custom VJP already rematerialises, so
+  it runs WITHOUT cfg.remat (pairing them recomputes twice).
+A variant that fails to compile is skipped (the bench must always emit
+its line). Timing syncs by fetching the loss scalar to host — on the
+tunneled single-chip setup `block_until_ready` alone does not await
+remote execution, which silently under- or over-reports.
 """
 
+import dataclasses
 import json
+import sys
 import time
 
 import numpy as np
+
+
+def time_step(cfg, batch_np, steps):
+    """ms/step with a device→host scalar fetch as the hard sync."""
+    import jax
+
+    from proteinbert_tpu.train import create_train_state, train_step
+
+    state = create_train_state(jax.random.PRNGKey(0), cfg)
+    dbatch = jax.device_put(batch_np)
+
+    state, m = train_step(state, dbatch, cfg)  # compile
+    float(m["loss"])
+    for _ in range(3):  # settle caches / power state
+        state, m = train_step(state, dbatch, cfg)
+    float(m["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, m = train_step(state, dbatch, cfg)
+    float(m["loss"])
+    return (time.perf_counter() - t0) / steps
 
 
 def main():
@@ -21,56 +57,63 @@ def main():
     from proteinbert_tpu.configs import (
         DataConfig, ModelConfig, OptimizerConfig, PretrainConfig, TrainConfig,
     )
-    from proteinbert_tpu.train import create_train_state, train_step
     from proteinbert_tpu.train.metrics import (
         peak_flops_per_chip, train_flops,
     )
 
+    # Strictly TPU: on any other accelerator the MFU table has no peak
+    # entry and vs_baseline would be nonsense — run the CPU-sized config.
     on_tpu = jax.devices()[0].platform == "tpu"
-    # Base config per BASELINE.json configs[1]; batch sized for one chip.
+    seq_len = 512
     if on_tpu:
-        model = ModelConfig(local_dim=512, global_dim=512, key_dim=64,
-                            num_heads=8, num_blocks=6, dtype="bfloat16")
-        batch, seq_len, steps = 64, 512, 30
+        base = ModelConfig(local_dim=512, global_dim=512, key_dim=64,
+                           num_heads=8, num_blocks=6, dtype="bfloat16")
+        variants = [  # (name, model, batch)
+            ("xla-remat", dataclasses.replace(base, remat=True), 256),
+            ("pallas", dataclasses.replace(base, use_pallas=True), 64),
+        ]
+        steps = 15
     else:  # CPU fallback so the script always emits its line
-        model = ModelConfig(local_dim=64, global_dim=128, key_dim=16,
-                            num_heads=4, num_blocks=2, num_annotations=512,
-                            dtype="float32")
-        batch, seq_len, steps = 8, 128, 5
-
-    cfg = PretrainConfig(
-        model=model,
-        data=DataConfig(seq_len=seq_len, batch_size=batch),
-        optimizer=OptimizerConfig(warmup_steps=100),
-        train=TrainConfig(max_steps=steps),
-    )
+        base = ModelConfig(local_dim=64, global_dim=128, key_dim=16,
+                           num_heads=4, num_blocks=2, num_annotations=512,
+                           dtype="float32")
+        variants = [("xla", base, 8)]
+        seq_len, steps = 128, 5
 
     rng = np.random.default_rng(0)
-    batch_np = {
-        "tokens": rng.integers(4, 26, size=(batch, seq_len)).astype(np.int32),
-        "annotations": (rng.random((batch, model.num_annotations)) < 0.01
-                        ).astype(np.float32),
-    }
-    state = create_train_state(jax.random.PRNGKey(0), cfg)
-    dbatch = jax.device_put(batch_np)
+    best = None
+    for name, model, batch in variants:
+        cfg = PretrainConfig(
+            model=model,
+            data=DataConfig(seq_len=seq_len, batch_size=batch),
+            optimizer=OptimizerConfig(warmup_steps=100),
+            train=TrainConfig(max_steps=steps),
+        )
+        batch_np = {
+            "tokens": rng.integers(4, 26, size=(batch, seq_len)
+                                   ).astype(np.int32),
+            "annotations": (rng.random((batch, model.num_annotations)) < 0.01
+                            ).astype(np.float32),
+        }
+        try:
+            dt = time_step(cfg, batch_np, steps)
+        except Exception as e:  # OOM/Mosaic rejection must not kill the bench
+            print(f"variant {name} failed ({type(e).__name__}); skipped",
+                  file=sys.stderr)
+            continue
+        res_per_sec = batch * seq_len / dt
+        mfu = train_flops(model, batch, seq_len) / dt / peak_flops_per_chip()
+        print(f"variant={name} batch={batch}: {dt * 1e3:.1f} ms/step "
+              f"res/s={res_per_sec:,.0f} MFU={mfu:.3f}", file=sys.stderr)
+        if best is None or res_per_sec > best[0]:
+            best = (res_per_sec, mfu, name)
 
-    # Warmup/compile.
-    state, m = train_step(state, dbatch, cfg)
-    jax.block_until_ready(m["loss"])
-
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        state, m = train_step(state, dbatch, cfg)
-    jax.block_until_ready(m["loss"])
-    dt = time.perf_counter() - t0
-
-    steps_per_sec = steps / dt
-    residues_per_sec = steps_per_sec * batch * seq_len
-    mfu = steps_per_sec * train_flops(model, batch, seq_len) / peak_flops_per_chip()
-
+    if best is None:
+        raise SystemExit("all bench variants failed")
+    res_per_sec, mfu, name = best
     print(json.dumps({
         "metric": "residues_per_sec_per_chip",
-        "value": round(residues_per_sec, 1),
+        "value": round(res_per_sec, 1),
         "unit": "residues/s",
         "vs_baseline": round(mfu / 0.40, 4),
     }))

@@ -201,6 +201,32 @@ def cmd_pretrain(args) -> int:
         ds = InMemoryPretrainingDataset(seqs, ann, cfg.data.seq_len)
         log("no --data given: pretraining on synthetic random proteins")
 
+    eval_batches = None
+    if args.eval_frac:
+        from proteinbert_tpu.data.dataset import train_eval_split
+
+        ds, eval_ds = train_eval_split(ds, args.eval_frac,
+                                       seed=cfg.train.seed)
+        if cfg.train.eval_every == 0:
+            cfg = cfg.replace(train=dataclasses.replace(
+                cfg.train, eval_every=max(cfg.checkpoint.every_steps, 100)))
+        # A small holdout evals at its own (smaller) batch size rather
+        # than crashing the run at the first eval; zero per-host rows is
+        # a config error surfaced NOW, not at step eval_every.
+        eval_bs = min(cfg.data.batch_size,
+                      len(eval_ds) // jax.process_count())
+        if eval_bs == 0:
+            raise SystemExit(
+                f"--eval-frac {args.eval_frac} holds out {len(eval_ds)} "
+                f"rows across {jax.process_count()} hosts — not enough "
+                "for one eval batch; raise --eval-frac or the dataset size")
+        eval_batches = lambda: make_pretrain_iterator(  # noqa: E731
+            eval_ds, eval_bs, shuffle=False, num_epochs=1,
+            process_index=jax.process_index(),
+            process_count=jax.process_count())
+        log(f"held-out eval: {len(eval_ds)} rows (batch {eval_bs}), every "
+            f"{cfg.train.eval_every} steps")
+
     mesh = None
     if cfg.mesh.num_devices > 1:
         mesh = make_mesh(cfg.mesh)
@@ -223,7 +249,8 @@ def cmd_pretrain(args) -> int:
     ck = Checkpointer(cfg.checkpoint.directory,
                       max_to_keep=cfg.checkpoint.max_to_keep,
                       async_save=cfg.checkpoint.async_save)
-    out = pretrain(cfg, factory, checkpointer=ck, mesh=mesh)
+    out = pretrain(cfg, factory, checkpointer=ck, mesh=mesh,
+                   eval_batches=eval_batches)
     ck.close()
     perf = out["perf"]
     if perf:
@@ -394,6 +421,9 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("--data", type=existing_file,
                         help="HDF5 dataset from create-h5 (default: synthetic)")
         sp.add_argument("--max-steps", type=int)
+        sp.add_argument("--eval-frac", type=float, default=0.0,
+                        help="hold out this fraction for periodic eval "
+                             "(reference's unused train/test split, C8)")
         sp.add_argument("--checkpoint-dir")
         sp.add_argument("--history-json", type=creatable_path)
         sp.add_argument("--set", action="append", metavar="PATH=VALUE",

@@ -25,6 +25,8 @@ from proteinbert_tpu.data.dataset import (
     HDF5PretrainingDataset,
     make_bucketed_iterator,
     make_pretrain_iterator,
+    Subset,
+    train_eval_split,
 )
 
 __all__ = [
@@ -35,4 +37,5 @@ __all__ = [
     "pretrain_weights",
     "InMemoryPretrainingDataset", "HDF5PretrainingDataset",
     "make_bucketed_iterator", "make_pretrain_iterator",
+    "Subset", "train_eval_split",
 ]

@@ -371,3 +371,53 @@ def make_bucketed_iterator(
             batch["tokens"] = batch["tokens"][:, : buckets[b]]
             yield batch
         epoch += 1
+
+
+class Subset:
+    """Row-index view over a dataset — the train/test split primitive
+    (reference C8's create_pretrain_dataloaders random_split, reference
+    utils.py:71-107). Proxies the iterator-facing surface (get_batch,
+    row_lengths, seq_len, shuffle_block) onto the parent."""
+
+    def __init__(self, dataset, indices: np.ndarray):
+        self._ds = dataset
+        self._idx = np.asarray(indices, dtype=np.int64)
+        self.seq_len = dataset.seq_len
+        self._fetch = _make_fetch(dataset)
+
+    def __len__(self) -> int:
+        return len(self._idx)
+
+    def __getitem__(self, i: int):
+        return self._ds[int(self._idx[i])]
+
+    def get_batch(self, idx: np.ndarray):
+        return self._fetch(self._idx[np.asarray(idx)])
+
+    def row_lengths(self) -> np.ndarray:
+        return self._ds.row_lengths()[self._idx]
+
+    @property
+    def shuffle_block(self):
+        # When the view's indices are sorted (train_eval_split sorts its
+        # slices), consecutive view positions map to nearby parent rows,
+        # so the parent's block-local access pattern survives the
+        # indirection approximately; unsorted views lose it.
+        if np.all(np.diff(self._idx) > 0):
+            return getattr(self._ds, "shuffle_block", None)
+        return None
+
+
+def train_eval_split(dataset, eval_frac: float, seed: int = 0):
+    """(train_view, eval_view) with a deterministic shuffled split
+    (reference random_split parity, reference utils.py:93-97)."""
+    if not 0.0 < eval_frac < 1.0:
+        raise ValueError(f"eval_frac must be in (0, 1), got {eval_frac}")
+    n = len(dataset)
+    order = np.random.default_rng(seed).permutation(n)
+    n_eval = max(1, int(n * eval_frac))
+    # Sorted slices: the split stays random (membership came from the
+    # permutation) while each view walks its parent monotonically, which
+    # preserves HDF5 block locality (see Subset.shuffle_block).
+    return (Subset(dataset, np.sort(order[n_eval:])),
+            Subset(dataset, np.sort(order[:n_eval])))

@@ -166,7 +166,10 @@ def _fused_kernel(
     wide = _tap_matmuls(window, wk_ref[:], wide_taps, wide_dilation, halo, tile)
     wide = _gelu(wide + wb_ref[0].astype(jnp.float32))
 
-    h = x_center + narrow + wide + bcast_ref[0].astype(jnp.float32)[None, :]
+    # bcast is shaped (B, 1, C) outside so this program's (1, 1, C) block
+    # satisfies Mosaic's last-two-dims tiling rule (a (1, C) slice of a
+    # (B, C) array does not, nor does a dynamic row-select).
+    h = x_center + narrow + wide + bcast_ref[0, 0].astype(jnp.float32)[None, :]
     x1 = _layer_norm_f32(h, s1_ref[0], b1_ref[0]).astype(dtype)
 
     d = lax.dot_general(
@@ -209,7 +212,7 @@ def _pallas_forward(
     ln1, ln2, dn = params["local_ln1"], params["local_ln2"], params["local_dense"]
     inputs = (
         x_padded,
-        broadcast.astype(dtype),
+        broadcast.astype(dtype).reshape(B, 1, C),
         nk.astype(dtype), vec(params["narrow_conv"]["bias"]),
         wk.astype(dtype), vec(params["wide_conv"]["bias"]),
         vec(ln1["scale"]), vec(ln1["bias"]),
@@ -219,12 +222,13 @@ def _pallas_forward(
 
     row_spec = pl.BlockSpec((1, Lp, C), lambda b, j: (b, 0, 0),
                             memory_space=pltpu.VMEM)
-    bcast_spec = pl.BlockSpec((1, C), lambda b, j: (b, 0),
-                              memory_space=pltpu.VMEM)
 
     def whole(a):
         return pl.BlockSpec(a.shape, lambda b, j: (0,) * a.ndim,
                             memory_space=pltpu.VMEM)
+
+    bcast_spec = pl.BlockSpec((1, 1, C), lambda b, j: (b, 0, 0),
+                              memory_space=pltpu.VMEM)
 
     kernel = functools.partial(
         _fused_kernel, tile=tile, halo=halo,

@@ -97,6 +97,12 @@ class StepTimer:
         self._t_last = None
         self._steps_timed = 0
 
+    def discount(self, seconds: float) -> None:
+        """Remove non-training wall time (an eval pass, a blocking save)
+        from the measured interval so throughput/MFU stay honest."""
+        if self._t0 is not None:
+            self._t0 += seconds
+
     def update(self) -> None:
         self._count += 1
         if self._count == self.warmup_steps:

@@ -17,6 +17,7 @@ What changed vs the reference `pretrain()`:
 from __future__ import annotations
 
 import logging
+import time
 from typing import Any, Dict, Optional
 
 import jax
@@ -36,6 +37,7 @@ def pretrain(
     state: Optional[ts.TrainState] = None,
     checkpointer: Optional[Checkpointer] = None,
     mesh: Optional[jax.sharding.Mesh] = None,
+    eval_batches=None,
     log_fn=None,
 ) -> Dict[str, Any]:
     """Run the pretraining loop; returns {"state", "history", "perf"}.
@@ -55,6 +57,12 @@ def pretrain(
         cfg.checkpoint.every_steps cadence (reference utils.py:227,324).
       mesh: optional device mesh; batches are sharded over its 'data'
         axis (and train state per parallel/sharding.py rules).
+      eval_batches: optional callable() -> iterator of held-out CLEAN
+        batches; every cfg.train.eval_every steps they are scored with
+        eval_step under a step-derived (deterministic) corruption key and
+        the averaged metrics land in the history as eval_* (the held-out
+        loop the reference's train/test dataloader split was built for
+        but never ran, reference utils.py:71-107).
       log_fn: optional callable(step, metrics_dict) for external loggers.
     """
     batches_consumed = 0
@@ -125,6 +133,23 @@ def pretrain(
                 log_fn(step + 1, m)
 
         if (
+            eval_batches is not None
+            and cfg.train.eval_every
+            and (step + 1) % cfg.train.eval_every == 0
+        ):
+            t_eval = time.perf_counter()
+            em = _evaluate(state, eval_batches(), put, cfg, step)
+            timer.discount(time.perf_counter() - t_eval)
+            history.append({"step": step + 1, **em})
+            logger.info(
+                "step %d eval loss %.4f (local %.4f global %.4f) acc %.3f",
+                step + 1, em["eval_loss"], em["eval_local_loss"],
+                em["eval_global_loss"], em["eval_local_acc"],
+            )
+            if log_fn is not None:
+                log_fn(step + 1, em)
+
+        if (
             checkpointer is not None
             and cfg.checkpoint.every_steps
             and (step + 1) % cfg.checkpoint.every_steps == 0
@@ -137,6 +162,20 @@ def pretrain(
         checkpointer.wait()
 
     return {"state": state, "history": history, "perf": timer.summary()}
+
+
+def _evaluate(state, batches, put, cfg, step) -> Dict[str, float]:
+    """Mean eval_step metrics over a held-out split; corruption key is
+    derived from the step so evals are reproducible run-to-run."""
+    key = jax.random.fold_in(jax.random.PRNGKey(cfg.train.seed + 1), step)
+    sums: Dict[str, float] = {}
+    n = 0
+    for batch in batches:
+        m = ts.eval_step(state, put(batch), jax.random.fold_in(key, n), cfg)
+        for k, v in m.items():
+            sums[k] = sums.get(k, 0.0) + float(v)
+        n += 1
+    return {f"eval_{k}": v / max(n, 1) for k, v in sums.items()}
 
 
 def _make_batch_put(mesh: Optional[jax.sharding.Mesh]):

@@ -219,3 +219,17 @@ def test_bucketed_iterator_rejects_strings():
     ds = InMemoryPretrainingDataset(["ACDE"] * 8, np.zeros((8, 4)), seq_len=64)
     with pytest.raises(ValueError, match="sequence of ints"):
         next(make_bucketed_iterator(ds, 2, "32,64", num_epochs=1))
+
+
+def test_train_eval_split_sorted_and_disjoint():
+    ds = InMemoryPretrainingDataset(["ACDE"] * 40, np.zeros((40, 4)), 16)
+    tr, ev = InMemoryPretrainingDataset, None
+    from proteinbert_tpu.data import train_eval_split
+
+    tr, ev = train_eval_split(ds, 0.25, seed=0)
+    assert len(tr) == 30 and len(ev) == 10
+    assert (np.diff(tr._idx) > 0).all() and (np.diff(ev._idx) > 0).all()
+    assert not set(tr._idx.tolist()) & set(ev._idx.tolist())
+    # Sorted views forward the parent's block preference (None here, but
+    # the attribute path must not raise).
+    _ = tr.shuffle_block

@@ -227,3 +227,47 @@ def test_flops_model_positive_and_monotone():
     f1 = forward_flops(cfg, batch=8, seq_len=32)
     f2 = forward_flops(cfg, batch=8, seq_len=64)
     assert 0 < f1 < f2
+
+
+def test_pretrain_with_eval_split():
+    """Held-out eval wired through the trainer (reference C8's train/test
+    split, completed): eval_* records appear at eval_every cadence and
+    are deterministic run-to-run."""
+    from proteinbert_tpu.configs import (
+        DataConfig, ModelConfig, OptimizerConfig, PretrainConfig, TrainConfig,
+    )
+    from proteinbert_tpu.data import (
+        InMemoryPretrainingDataset, make_pretrain_iterator, train_eval_split,
+    )
+    from proteinbert_tpu.data.synthetic import make_random_proteins
+    from proteinbert_tpu.train.trainer import pretrain
+
+    rng = np.random.default_rng(0)
+    seqs, ann = make_random_proteins(96, rng, num_annotations=64)
+    ds = InMemoryPretrainingDataset(seqs, ann, 64)
+    train_ds, eval_ds = train_eval_split(ds, 0.25, seed=0)
+    assert len(train_ds) + len(eval_ds) == 96 and len(eval_ds) == 24
+
+    cfg = PretrainConfig(
+        model=ModelConfig(local_dim=16, global_dim=32, key_dim=8,
+                          num_heads=4, num_blocks=1, num_annotations=64,
+                          dtype="float32"),
+        data=DataConfig(seq_len=64, batch_size=8),
+        optimizer=OptimizerConfig(warmup_steps=4),
+        train=TrainConfig(max_steps=6, log_every=0, eval_every=3),
+    )
+
+    def run():
+        return pretrain(
+            cfg,
+            make_pretrain_iterator(train_ds, 8, seed=0),
+            eval_batches=lambda: make_pretrain_iterator(
+                eval_ds, 8, shuffle=False, num_epochs=1),
+        )
+
+    hist = run()["history"]
+    evals = [h for h in hist if "eval_loss" in h]
+    assert [h["step"] for h in evals] == [3, 6]
+    assert all(np.isfinite(h["eval_loss"]) for h in evals)
+    evals2 = [h for h in run()["history"] if "eval_loss" in h]
+    assert evals[0]["eval_loss"] == evals2[0]["eval_loss"]  # deterministic

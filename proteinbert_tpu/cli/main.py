@@ -178,8 +178,13 @@ def cmd_pretrain(args) -> int:
         HDF5PretrainingDataset, InMemoryPretrainingDataset,
         make_pretrain_iterator,
     )
-    from proteinbert_tpu.parallel import make_mesh
+    from proteinbert_tpu.parallel import (
+        make_mesh, maybe_initialize_distributed,
+    )
     from proteinbert_tpu.train import Checkpointer, pretrain
+
+    if getattr(args, "multihost", False):
+        maybe_initialize_distributed(required=True)
 
     cfg = _build_config(args)
 
@@ -421,6 +426,9 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("--data", type=existing_file,
                         help="HDF5 dataset from create-h5 (default: synthetic)")
         sp.add_argument("--max-steps", type=int)
+        sp.add_argument("--multihost", action="store_true",
+                        help="jax.distributed.initialize from env/TPU-pod "
+                             "metadata before building the mesh")
         sp.add_argument("--eval-frac", type=float, default=0.0,
                         help="hold out this fraction for periodic eval "
                              "(reference's unused train/test split, C8)")

@@ -137,6 +137,9 @@ class TrainConfig:
     max_steps: int = 250                    # dummy_tests.py:141 smoke default
     log_every: int = 10
     eval_every: int = 0                     # 0 = no eval
+    on_nan: str = "halt"                    # "halt" | "warn" | "off" — NaN/Inf
+                                            # watch on logged loss/grad_norm
+                                            # (train/resilience.py)
     seed: int = 0
 
 

@@ -5,6 +5,7 @@ from proteinbert_tpu.parallel.sharding import (
 from proteinbert_tpu.parallel.halo import (
     halo_exchange, conv1d_halo, seq_parallel_conv1d,
 )
+from proteinbert_tpu.parallel.multihost import maybe_initialize_distributed
 from proteinbert_tpu.parallel.seq_parallel import (
     make_seq_parallel_train_step, seq_parallel_apply, sharded_global_attention,
 )
@@ -14,5 +15,5 @@ __all__ = [
     "batch_sharding", "state_sharding", "shard_train_state",
     "halo_exchange", "conv1d_halo", "seq_parallel_conv1d",
     "make_seq_parallel_train_step", "seq_parallel_apply",
-    "sharded_global_attention",
+    "sharded_global_attention", "maybe_initialize_distributed",
 ]

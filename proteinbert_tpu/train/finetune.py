@@ -165,14 +165,32 @@ def finetune(
     model-selection design of the reference's sketch (reference
     utils.py:442-458).
     """
+    start_epoch = 0
+    history: list = []
+    best: Dict[str, Any] = {"epoch": -1, "score": -float("inf")}
     if state is None:
         state = create_finetune_state(
             jax.random.PRNGKey(cfg.train.seed), cfg, pretrained_trunk
         )
+        if checkpointer is not None and checkpointer.latest_step() is not None:
+            # Resume an interrupted fine-tune: the saved step IS the
+            # number of completed epochs, and the saved data carries the
+            # pre-resume history + best so model selection still spans
+            # the WHOLE run.
+            start_epoch = checkpointer.latest_step()
+            if start_epoch >= cfg.task.epochs:
+                raise ValueError(
+                    f"checkpoint dir {checkpointer.directory} already holds "
+                    f"{start_epoch} completed epochs >= task.epochs="
+                    f"{cfg.task.epochs}; use a fresh directory or raise "
+                    "task.epochs to continue training")
+            state, data = checkpointer.restore(state)
+            data = data or {}
+            history = list(data.get("history", []))
+            best = dict(data.get("best", best))
+            logger.info("resumed fine-tune after epoch %d", start_epoch)
 
-    history = []
-    best: Dict[str, Any] = {"epoch": -1, "score": -float("inf")}
-    for epoch in range(cfg.task.epochs):
+    for epoch in range(start_epoch, cfg.task.epochs):
         train_sums: Dict[str, float] = {}
         n = 0
         for batch in train_batches(epoch):
@@ -200,7 +218,8 @@ def finetune(
         if log_fn is not None:
             log_fn(epoch, record)
         if checkpointer is not None:
-            checkpointer.save(epoch + 1, state, {"record": record})
+            checkpointer.save(epoch + 1, state,
+                              {"history": history, "best": best})
 
     if checkpointer is not None:
         checkpointer.wait()

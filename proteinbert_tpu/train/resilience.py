@@ -1,0 +1,92 @@
+"""Training-loop failure detection + graceful preemption (SURVEY §5).
+
+The reference has NO failure handling at training level — its only
+resilience is checkpoint-resume and ETL-side counters (SURVEY §5 "Failure
+detection / elastic recovery: None"). On TPU this matters: preemptible
+capacity gets SIGTERM'd, and a bfloat16 run can NaN long before a human
+looks at the logs. Two mechanisms, both wired into train/trainer.py:
+
+- `GracefulShutdown`: installs SIGTERM/SIGINT handlers that set a flag;
+  the trainer finishes the in-flight step, saves a checkpoint, and
+  returns with `preempted=True` instead of dying mid-save. The second
+  signal falls through to the previous handler (so a double Ctrl-C still
+  kills a hung run).
+- `check_finite`: host-side NaN/Inf detection on the (already fetched)
+  logged metrics; on trigger the trainer saves a diagnostic checkpoint
+  and raises `NonFiniteLossError` (cfg.train.on_nan="halt", default) or
+  logs and continues ("warn").
+"""
+
+from __future__ import annotations
+
+import math
+import signal
+from typing import Dict, Optional
+
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+class NonFiniteLossError(RuntimeError):
+    """Loss or grad norm went NaN/Inf; a diagnostic checkpoint was saved."""
+
+
+class GracefulShutdown:
+    """Flag-setting SIGTERM/SIGINT trap, usable as a context manager.
+
+    >>> with GracefulShutdown() as stop:
+    ...     for step in range(n):
+    ...         if stop.requested: break
+    """
+
+    def __init__(self, signals=(signal.SIGTERM, signal.SIGINT)):
+        self._signals = signals
+        self._previous: Dict[int, object] = {}
+        self.requested = False
+        self.signum: Optional[int] = None
+
+    def _handler(self, signum, frame):
+        if self.requested:
+            # Second signal: restore + re-raise through the old handler so
+            # an operator can still force-kill a wedged run.
+            prev = self._previous.get(signum, signal.SIG_DFL)
+            signal.signal(signum, prev)
+            raise KeyboardInterrupt(f"second signal {signum}")
+        self.requested = True
+        self.signum = signum
+        logger.warning(
+            "signal %s received: finishing current step, then "
+            "checkpoint + clean exit", signum)
+
+    def __enter__(self):
+        for s in self._signals:
+            try:
+                self._previous[s] = signal.signal(s, self._handler)
+            except ValueError:
+                # Not the main thread (e.g. a test runner worker): degrade
+                # to a never-triggered flag rather than crash.
+                logger.debug("cannot trap signal %s off the main thread", s)
+        return self
+
+    def __exit__(self, *exc):
+        for s, prev in self._previous.items():
+            signal.signal(s, prev)
+        self._previous.clear()
+        return False
+
+
+def check_finite(metrics: Dict[str, float], step: int, mode: str = "halt",
+                 keys=("loss", "grad_norm")) -> bool:
+    """True if the watched metrics are finite; on failure either raises
+    NonFiniteLossError (mode='halt') or warns (mode='warn'). The caller
+    saves its diagnostic checkpoint BEFORE calling with mode='halt'."""
+    bad = [k for k in keys if k in metrics and not math.isfinite(metrics[k])]
+    if not bad:
+        return True
+    msg = (f"non-finite {'/'.join(bad)} at step {step}: "
+           f"{ {k: metrics[k] for k in bad} }")
+    if mode == "halt":
+        raise NonFiniteLossError(msg)
+    logger.warning("%s (on_nan=warn: continuing)", msg)
+    return False

@@ -27,6 +27,7 @@ from proteinbert_tpu.configs import PretrainConfig
 from proteinbert_tpu.train import train_state as ts
 from proteinbert_tpu.train.checkpoint import Checkpointer
 from proteinbert_tpu.train.metrics import StepTimer
+from proteinbert_tpu.train.resilience import GracefulShutdown, check_finite
 
 logger = logging.getLogger(__name__)
 
@@ -112,14 +113,38 @@ def pretrain(
         n_chips=n_chips,
     )
     history: list = []
+    preempted = False
+    diagnostic_saved = False
 
-    for step in range(start_step, cfg.train.max_steps):
+    with GracefulShutdown() as stop:
+      for step in range(start_step, cfg.train.max_steps):
         batch = next(batch_iterator)
         state, metrics = step_fn(state, put(batch), cfg)
         timer.update()
 
         if cfg.train.log_every and (step + 1) % cfg.train.log_every == 0:
             m = {k: float(v) for k, v in metrics.items()}
+            if cfg.train.on_nan != "off" and not check_finite(
+                m, step + 1, mode="warn"
+            ):
+                # Preserve the state BEFORE halting so the blow-up is
+                # debuggable (reference: no failure handling at all,
+                # SURVEY §5). Saved to a SIBLING directory, once: the
+                # NaN state must never become the checkpoint a restart
+                # resumes from, nor churn the retention window.
+                if checkpointer is not None and not diagnostic_saved:
+                    diag = Checkpointer(
+                        checkpointer.directory + "-diagnostic",
+                        max_to_keep=1, async_save=False)
+                    diag.save(step + 1, state,
+                              {"batches_consumed": step + 1,
+                               "non_finite": True})
+                    diag.close()
+                    diagnostic_saved = True
+                    logger.warning("non-finite state preserved in %s",
+                                   checkpointer.directory + "-diagnostic")
+                if cfg.train.on_nan == "halt":
+                    check_finite(m, step + 1, mode="halt")
             m.update(timer.summary())
             history.append({"step": step + 1, **m})
             logger.info(
@@ -131,6 +156,18 @@ def pretrain(
             )
             if log_fn is not None:
                 log_fn(step + 1, m)
+
+        if stop.requested:
+            # Preemption (SIGTERM) / operator interrupt: checkpoint at the
+            # completed step and exit cleanly; resume picks up exactly here.
+            if checkpointer is not None:
+                checkpointer.save(step + 1, state,
+                                  {"batches_consumed": step + 1})
+                checkpointer.wait()
+            logger.warning("preempted at step %d: state saved, exiting",
+                           step + 1)
+            preempted = True
+            break
 
         if (
             eval_batches is not None
@@ -156,12 +193,13 @@ def pretrain(
         ):
             checkpointer.save(step + 1, state, {"batches_consumed": step + 1})
 
-    if checkpointer is not None:
+    if checkpointer is not None and not preempted:
         checkpointer.save(cfg.train.max_steps, state,
                           {"batches_consumed": cfg.train.max_steps})
         checkpointer.wait()
 
-    return {"state": state, "history": history, "perf": timer.summary()}
+    return {"state": state, "history": history, "perf": timer.summary(),
+            "preempted": preempted}
 
 
 def _evaluate(state, batches, put, cfg, step) -> Dict[str, float]:

@@ -1,0 +1,152 @@
+"""Failure detection + graceful preemption (SURVEY §5 — the reference has
+none): NaN halt with diagnostic checkpoint, SIGTERM checkpoint-and-exit,
+fine-tune epoch resume, multihost no-op."""
+
+import os
+import signal
+
+import jax
+import numpy as np
+import pytest
+
+from proteinbert_tpu.configs import (
+    DataConfig, FinetuneConfig, ModelConfig, OptimizerConfig, PretrainConfig,
+    TaskConfig, TrainConfig,
+)
+from proteinbert_tpu.data import InMemoryPretrainingDataset, make_pretrain_iterator
+from proteinbert_tpu.data.synthetic import make_random_proteins, make_task_batches
+from proteinbert_tpu.train import Checkpointer
+from proteinbert_tpu.train.resilience import (
+    GracefulShutdown, NonFiniteLossError, check_finite,
+)
+from proteinbert_tpu.train.trainer import pretrain
+
+MODEL = ModelConfig(local_dim=16, global_dim=32, key_dim=8, num_heads=4,
+                    num_blocks=1, num_annotations=64, dtype="float32")
+
+
+def _cfg(**train_kw):
+    return PretrainConfig(
+        model=MODEL,
+        data=DataConfig(seq_len=64, batch_size=8),
+        optimizer=OptimizerConfig(warmup_steps=4),
+        train=TrainConfig(max_steps=10, log_every=2, **train_kw),
+    )
+
+
+def _iterator(seed=0):
+    rng = np.random.default_rng(seed)
+    seqs, ann = make_random_proteins(64, rng, num_annotations=64)
+    ds = InMemoryPretrainingDataset(seqs, ann, 64)
+    return make_pretrain_iterator(ds, 8, seed=seed)
+
+
+def test_check_finite():
+    assert check_finite({"loss": 1.0, "grad_norm": 2.0}, 1)
+    assert not check_finite({"loss": float("nan")}, 1, mode="warn")
+    with pytest.raises(NonFiniteLossError, match="step 7"):
+        check_finite({"loss": float("inf")}, 7, mode="halt")
+
+
+def test_nan_halt_saves_diagnostic_checkpoint(tmp_path):
+    # An absurd LR blows the tiny model up within a few steps.
+    cfg = _cfg()
+    cfg = cfg.replace(optimizer=OptimizerConfig(learning_rate=1e18,
+                                                warmup_steps=1,
+                                                grad_clip_norm=1e18))
+    ck = Checkpointer(str(tmp_path / "ck"), async_save=False)
+    with pytest.raises(NonFiniteLossError):
+        pretrain(cfg, _iterator(), checkpointer=ck)
+    # Diagnostic state lands in the SIBLING dir; the resume chain stays
+    # clean (a restart must not restore NaN weights).
+    assert ck.latest_step() is None
+    diag = Checkpointer(str(tmp_path / "ck") + "-diagnostic",
+                        async_save=False)
+    assert diag.latest_step() is not None
+    diag.close()
+    ck.close()
+
+
+def test_nan_warn_mode_continues():
+    cfg = _cfg(on_nan="warn")
+    cfg = cfg.replace(optimizer=OptimizerConfig(learning_rate=1e18,
+                                                warmup_steps=1,
+                                                grad_clip_norm=1e18))
+    out = pretrain(cfg, _iterator())
+    assert len(out["history"]) == 5  # ran to completion despite NaNs
+
+
+def test_sigterm_checkpoints_and_exits(tmp_path):
+    cfg = _cfg()
+    ck = Checkpointer(str(tmp_path / "ck"), async_save=False)
+    fired = []
+
+    def send_signal(step, m):
+        if step == 4 and not fired:
+            fired.append(step)
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    out = pretrain(cfg, _iterator(), checkpointer=ck, log_fn=send_signal)
+    assert out["preempted"] is True
+    assert ck.latest_step() == 4  # saved at the interrupted step, not max
+    ck.close()
+
+    # And the resume continues from there.
+    ck2 = Checkpointer(str(tmp_path / "ck"), async_save=False)
+    out2 = pretrain(cfg, lambda skip: _iterator(), checkpointer=ck2)
+    assert out2["preempted"] is False
+    assert int(out2["state"].step) == cfg.train.max_steps
+    ck2.close()
+
+
+def test_finetune_resume(tmp_path, rng):
+    cfg = FinetuneConfig(
+        model=MODEL,
+        task=TaskConfig(kind="sequence_classification", num_outputs=3,
+                        epochs=3),
+        data=DataConfig(seq_len=64, batch_size=8),
+        optimizer=OptimizerConfig(learning_rate=1e-3, warmup_steps=2,
+                                  schedule="warmup_cosine", total_steps=100),
+    )
+    from proteinbert_tpu.train.finetune import finetune
+
+    batches = make_task_batches(32, rng, "sequence_classification", 3, 64, 8)
+
+    ck = Checkpointer(str(tmp_path / "ck"), async_save=False)
+    two = cfg.replace(task=TaskConfig(kind="sequence_classification",
+                                      num_outputs=3, epochs=2))
+    out1 = finetune(two, lambda e: iter(batches), checkpointer=ck)
+    ck.close()
+
+    ck2 = Checkpointer(str(tmp_path / "ck"), async_save=False)
+    out2 = finetune(cfg, lambda e: iter(batches), checkpointer=ck2)
+    ck2.close()
+    # Only the third epoch RAN, but history spans the whole run (the
+    # pre-resume records come back from the checkpoint data).
+    assert [r["epoch"] for r in out2["history"]] == [0, 1, 2]
+    assert int(out2["state"].step) == 3 * len(batches)
+
+    # A directory that already holds >= task.epochs completed epochs is
+    # an error, not a silent zero-epoch "run".
+    ck3 = Checkpointer(str(tmp_path / "ck"), async_save=False)
+    with pytest.raises(ValueError, match="completed epochs"):
+        finetune(cfg, lambda e: iter(batches), checkpointer=ck3)
+    ck3.close()
+
+
+def test_multihost_noop_single_host():
+    from proteinbert_tpu.parallel import maybe_initialize_distributed
+
+    # On the CPU test rig there is no cluster env: must return False
+    # without touching jax state, and jax must keep working after.
+    assert maybe_initialize_distributed() is False
+    assert jax.device_count() >= 1
+
+
+def test_graceful_shutdown_restores_handlers():
+    before = signal.getsignal(signal.SIGTERM)
+    with GracefulShutdown() as stop:
+        assert not stop.requested
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert stop.requested and stop.signum == signal.SIGTERM
+    assert signal.getsignal(signal.SIGTERM) is before

@@ -23,6 +23,7 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import json
+import math
 import os
 import sys
 from typing import List, Optional
@@ -254,9 +255,37 @@ def cmd_pretrain(args) -> int:
     ck = Checkpointer(cfg.checkpoint.directory,
                       max_to_keep=cfg.checkpoint.max_to_keep,
                       async_save=cfg.checkpoint.async_save)
-    out = pretrain(cfg, factory, checkpointer=ck, mesh=mesh,
-                   eval_batches=eval_batches)
-    ck.close()
+    log_fn = None
+    mf = None
+    # Only host 0 writes (every process would append duplicate, possibly
+    # torn, lines to a shared file under --multihost).
+    if args.metrics_jsonl and jax.process_index() == 0:
+        mf = open(args.metrics_jsonl, "a", buffering=1)
+
+        def log_fn(step, metrics):
+            clean = {k: (v if isinstance(v, str) or math.isfinite(v)
+                         else None)
+                     for k, v in metrics.items()}
+            mf.write(json.dumps({"step": step, **clean}) + "\n")
+
+    try:
+        if args.profile_dir:
+            from proteinbert_tpu.utils.profiling import device_trace
+
+            with device_trace(args.profile_dir):
+                out = pretrain(cfg, factory, checkpointer=ck, mesh=mesh,
+                               eval_batches=eval_batches, log_fn=log_fn)
+            log(f"jax profiler trace → {args.profile_dir} "
+                "(view in TensorBoard/Perfetto)")
+        else:
+            out = pretrain(cfg, factory, checkpointer=ck, mesh=mesh,
+                           eval_batches=eval_batches, log_fn=log_fn)
+    finally:
+        # Always await in-flight async checkpoint saves — a halt (e.g.
+        # NonFiniteLossError) must not abandon a half-written checkpoint.
+        ck.close()
+        if mf is not None:
+            mf.close()
     perf = out["perf"]
     if perf:
         log(f"done: {perf.get('residues_per_sec_per_chip', 0):.0f} "
@@ -264,6 +293,10 @@ def cmd_pretrain(args) -> int:
     if args.history_json:
         with open(args.history_json, "w") as f:
             json.dump(out["history"], f, indent=2)
+    if out.get("preempted"):
+        # EX_TEMPFAIL: tells orchestrators "not done — requeue me".
+        log("run was preempted; exiting 75 so a supervisor requeues it")
+        return 75
     return 0
 
 
@@ -434,6 +467,10 @@ def build_parser() -> argparse.ArgumentParser:
                              "(reference's unused train/test split, C8)")
         sp.add_argument("--checkpoint-dir")
         sp.add_argument("--history-json", type=creatable_path)
+        sp.add_argument("--metrics-jsonl", type=creatable_path,
+                        help="append one JSON line per logged/eval step")
+        sp.add_argument("--profile-dir",
+                        help="capture a jax.profiler device trace here")
         sp.add_argument("--set", action="append", metavar="PATH=VALUE",
                         help="config override, e.g. --set model.local_dim=256")
 

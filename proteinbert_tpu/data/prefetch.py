@@ -1,0 +1,95 @@
+"""Background batch prefetching (host↔device overlap).
+
+The reference overlaps data loading with compute via torch DataLoader
+worker processes (reference utils.py:99-105). The TPU-native equivalent
+is simpler: the jitted step is dispatched asynchronously, so the host is
+free during device compute — all that is needed is to hide the HOST cost
+of producing the next batch (HDF5 reads, tokenization, numpy gathers)
+behind the in-flight step. One daemon thread fills a small queue;
+`prefetch()` wraps any batch iterator.
+
+Exceptions raised by the source iterator are re-raised at the consuming
+`next()` (not lost on the thread), and `close()` / generator GC stops the
+thread promptly.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator
+
+_SENTINEL = object()
+
+
+class PrefetchIterator:
+    """Iterator view over `source` with `depth` batches produced ahead."""
+
+    def __init__(self, source: Iterator, depth: int = 2):
+        if depth < 1:
+            raise ValueError(f"depth must be >= 1, got {depth}")
+        self._q: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._error = None
+        self._done = False
+        self._source = source
+        self._thread = threading.Thread(target=self._fill, daemon=True)
+        self._thread.start()
+
+    def _fill(self):
+        try:
+            for item in self._source:
+                while not self._stop.is_set():
+                    try:
+                        self._q.put(item, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if self._stop.is_set():
+                    return
+        except BaseException as e:  # re-raised on the consumer side
+            self._error = e
+        while not self._stop.is_set():
+            try:
+                self._q.put(_SENTINEL, timeout=0.1)
+                return
+            except queue.Full:
+                continue
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._done:
+            raise StopIteration
+        while True:
+            try:
+                item = self._q.get(timeout=0.5)
+                break
+            except queue.Empty:
+                # The fill thread can only be gone after delivering the
+                # sentinel OR after close(); either way nothing more is
+                # coming — never block a training loop forever.
+                if self._stop.is_set() or not self._thread.is_alive():
+                    self._done = True
+                    raise StopIteration from None
+        if item is _SENTINEL:
+            self._done = True
+            if self._error is not None:
+                err, self._error = self._error, None
+                raise err
+            raise StopIteration
+        return item
+
+    def close(self):
+        self._stop.set()
+
+    def __del__(self):
+        self.close()
+
+
+def prefetch(source: Iterator, depth: int = 2) -> PrefetchIterator:
+    """Wrap `source` so its batches are produced `depth` ahead on a
+    background thread. depth=0 semantics (no-op) are the caller's choice —
+    pass the source through unwrapped."""
+    return PrefetchIterator(source, depth)

@@ -43,6 +43,12 @@ def maybe_initialize_distributed(
     global _initialized
     if _initialized:
         return True
+    already = getattr(jax.distributed, "is_initialized", lambda: False)()
+    if already:
+        # A launcher or earlier library call formed the group; that IS the
+        # requested state, not a failure.
+        _initialized = True
+        return True
 
     coordinator_address = coordinator_address or os.environ.get(
         "COORDINATOR_ADDRESS")

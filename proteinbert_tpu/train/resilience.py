@@ -78,12 +78,15 @@ class GracefulShutdown:
 
 def check_finite(metrics: Dict[str, float], step: int, mode: str = "halt",
                  keys=("loss", "grad_norm")) -> bool:
-    """True if the watched metrics are finite; on failure either raises
-    NonFiniteLossError (mode='halt') or warns (mode='warn'). The caller
-    saves its diagnostic checkpoint BEFORE calling with mode='halt'."""
+    """True if the watched metrics are finite. On failure: raises
+    NonFiniteLossError (mode='halt'), warns (mode='warn'), or just
+    returns False (mode='quiet' — the caller decides, e.g. to save a
+    diagnostic checkpoint before re-calling with 'halt')."""
     bad = [k for k in keys if k in metrics and not math.isfinite(metrics[k])]
     if not bad:
         return True
+    if mode == "quiet":
+        return False
     msg = (f"non-finite {'/'.join(bad)} at step {step}: "
            f"{ {k: metrics[k] for k in bad} }")
     if mode == "halt":

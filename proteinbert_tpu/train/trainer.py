@@ -87,6 +87,13 @@ def pretrain(
         for _ in range(batches_consumed):
             next(batch_iterator)
 
+    if cfg.data.prefetch_depth > 0:
+        # Hide host-side batch production (HDF5 reads, tokenization)
+        # behind the asynchronously-dispatched device step.
+        from proteinbert_tpu.data.prefetch import prefetch
+
+        batch_iterator = prefetch(batch_iterator, cfg.data.prefetch_depth)
+
     put = _make_batch_put(mesh)
 
     # The implicit-SPMD jit handles every sharding EXCEPT the Pallas fused
@@ -125,7 +132,7 @@ def pretrain(
         if cfg.train.log_every and (step + 1) % cfg.train.log_every == 0:
             m = {k: float(v) for k, v in metrics.items()}
             if cfg.train.on_nan != "off" and not check_finite(
-                m, step + 1, mode="warn"
+                m, step + 1, mode="quiet"
             ):
                 # Preserve the state BEFORE halting so the blow-up is
                 # debuggable (reference: no failure handling at all,
@@ -143,8 +150,8 @@ def pretrain(
                     diagnostic_saved = True
                     logger.warning("non-finite state preserved in %s",
                                    checkpointer.directory + "-diagnostic")
-                if cfg.train.on_nan == "halt":
-                    check_finite(m, step + 1, mode="halt")
+                # Raises in halt mode; logs the warning in warn mode.
+                check_finite(m, step + 1, mode=cfg.train.on_nan)
             m.update(timer.summary())
             history.append({"step": step + 1, **m})
             logger.info(

@@ -206,3 +206,15 @@ def test_smoke_cli(tmp_path):
         "--set", "model.num_annotations=32", "--set", "data.seq_len=32",
         "--set", "checkpoint.every_steps=0",
     ]) == 0
+
+
+def test_metrics_jsonl_flag(tmp_path):
+    mj = tmp_path / "metrics.jsonl"
+    assert main([
+        "smoke", "--max-steps", "4", "--metrics-jsonl", str(mj),
+        "--checkpoint-dir", str(tmp_path / "ck"), *TINY_SETS,
+        "--set", "train.log_every=2", "--set", "checkpoint.every_steps=0",
+    ]) == 0
+    lines = [json.loads(x) for x in mj.read_text().splitlines()]
+    assert [r["step"] for r in lines] == [2, 4]
+    assert all(np.isfinite(r["loss"]) for r in lines)

@@ -233,3 +233,63 @@ def test_train_eval_split_sorted_and_disjoint():
     # Sorted views forward the parent's block preference (None here, but
     # the attribute path must not raise).
     _ = tr.shuffle_block
+
+
+# ---------------------------------------------------------------- prefetch
+
+def test_prefetch_preserves_stream():
+    from proteinbert_tpu.data.prefetch import prefetch
+
+    src = [{"tokens": np.full((2, 4), i)} for i in range(20)]
+    out = list(prefetch(iter(src), depth=3))
+    assert len(out) == 20
+    for i, b in enumerate(out):
+        np.testing.assert_array_equal(b["tokens"], src[i]["tokens"])
+
+
+def test_prefetch_propagates_errors():
+    from proteinbert_tpu.data.prefetch import prefetch
+
+    def bad():
+        yield 1
+        yield 2
+        raise RuntimeError("source blew up")
+
+    it = prefetch(bad(), depth=2)
+    assert next(it) == 1 and next(it) == 2
+    with pytest.raises(RuntimeError, match="source blew up"):
+        next(it)
+
+
+def test_prefetch_close_stops_thread():
+    import itertools
+
+    from proteinbert_tpu.data.prefetch import prefetch
+
+    it = prefetch(itertools.count(), depth=2)  # infinite source
+    assert next(it) == 0
+    it.close()
+    it._thread.join(timeout=5)
+    assert not it._thread.is_alive()
+
+
+def test_prefetch_exhaustion_then_next_raises_stopiteration():
+    """Review fix: repeated next() after the stream ends (or errors) must
+    raise StopIteration, never block forever on a dead fill thread."""
+    from proteinbert_tpu.data.prefetch import prefetch
+
+    it = prefetch(iter([1, 2]), depth=2)
+    assert list(it) == [1, 2]
+    with pytest.raises(StopIteration):
+        next(it)
+
+    def bad():
+        yield 1
+        raise RuntimeError("boom")
+
+    it2 = prefetch(bad(), depth=2)
+    assert next(it2) == 1
+    with pytest.raises(RuntimeError):
+        next(it2)
+    with pytest.raises(StopIteration):  # exhausted, not hung
+        next(it2)

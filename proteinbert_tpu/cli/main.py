@@ -245,11 +245,13 @@ def cmd_pretrain(args) -> int:
 
         factory = lambda skip: make_bucketed_iterator(  # noqa: E731
             ds, cfg.data.batch_size, cfg.data.buckets, seed=cfg.train.seed,
+            num_epochs=cfg.data.num_epochs,
             process_index=jax.process_index(),
             process_count=jax.process_count(), skip_batches=skip)
     else:
         factory = lambda skip: make_pretrain_iterator(  # noqa: E731
             ds, cfg.data.batch_size, seed=cfg.train.seed,
+            num_epochs=cfg.data.num_epochs,
             process_index=jax.process_index(),
             process_count=jax.process_count(), skip_batches=skip)
     ck = Checkpointer(cfg.checkpoint.directory,

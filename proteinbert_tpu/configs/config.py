@@ -65,10 +65,11 @@ class DataConfig:
     annotation_drop_prob: float = 0.25      # drop positives (data_processing.py:116)
     annotation_add_prob: float = 1e-4       # add false positives (:117)
     batch_size: int = 32
-    shuffle_buffer: int = 10_000
     prefetch_depth: int = 2                 # host batches produced ahead on a
                                             # background thread (0 = off)
-    num_epochs: Optional[int] = None        # None = loop forever (iteration-based)
+    num_epochs: Optional[int] = None        # bound the data stream; None =
+                                            # loop forever (iteration-based,
+                                            # like the reference)
 
 
 @dataclasses.dataclass(frozen=True)

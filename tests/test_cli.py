@@ -218,3 +218,32 @@ def test_metrics_jsonl_flag(tmp_path):
     lines = [json.loads(x) for x in mj.read_text().splitlines()]
     assert [r["step"] for r in lines] == [2, 4]
     assert all(np.isfinite(r["loss"]) for r in lines)
+
+
+def test_bucketed_pretrain_on_h5_with_resume(etl_inputs, tmp_path):
+    """ETL → bucketed pretrain on the real HDF5 file → preempt-free
+    checkpoint resume continues the bucketed stream (index-only skip)."""
+    db, csv, h5 = tmp_path / "a.db", tmp_path / "m.csv", tmp_path / "d.h5"
+    main(["create-uniref-db", "--uniref-xml", str(etl_inputs / "uniref.xml.gz"),
+          "--go-meta", str(etl_inputs / "go.txt"), "--output-db", str(db),
+          "--go-meta-csv", str(csv)])
+    main(["create-h5", "--db", str(db), "--fasta", str(etl_inputs / "uniref.fasta"),
+          "--go-meta-csv", str(csv), "--output", str(h5), "--min-records", "2"])
+    ck = tmp_path / "ck"
+    sets = ["--set", "data.batch_size=2", "--set", "model.num_blocks=1",
+            "--set", "model.local_dim=8", "--set", "model.global_dim=16",
+            "--set", "model.key_dim=4", "--set", "data.seq_len=32",
+            "--set", "data.buckets=[16,32]", "--set", "train.log_every=0",
+            "--set", "checkpoint.every_steps=2",
+            "--set", "checkpoint.async_save=false",
+            "--set", "optimizer.warmup_steps=2"]
+    assert main(["pretrain", "--preset", "tiny", "--data", str(h5),
+                 "--max-steps", "2", "--checkpoint-dir", str(ck), *sets]) == 0
+    # Resume extends the same run two more steps.
+    assert main(["pretrain", "--preset", "tiny", "--data", str(h5),
+                 "--max-steps", "4", "--checkpoint-dir", str(ck), *sets]) == 0
+    from proteinbert_tpu.train import Checkpointer
+
+    c = Checkpointer(str(ck), async_save=False)
+    assert c.latest_step() == 4
+    c.close()

@@ -12,6 +12,10 @@ is one console with subcommands:
   pretrain           denoising pretrain from an HDF5 file or synthetic data
   smoke              the dummy_tests-equivalent end-to-end sanity run
   finetune           supervised task head on a (pretrained) trunk
+  convert-torch      reference torch checkpoint → orbax run dir (migration)
+  embed              trunk representations for sequences → HDF5/NPZ
+  predict-go         GO-annotation probabilities from sequence alone
+  predict-residues   fill '?'-masked residues, report per-position probs
 
 Cluster sharding (reference C17 parity): create-uniref-db reads
 --task-index/--task-count or SLURM array env vars (utils/sharding.py) and
@@ -417,6 +421,157 @@ def cmd_smoke(args) -> int:
     return rc
 
 
+def _read_named_seqs(args) -> tuple:
+    """(ids, seqs) from --fasta, --seqs-file (id<TAB>seq or bare seq per
+    line), or positional sequences — shared by the inference commands."""
+    if getattr(args, "fasta", None):
+        from proteinbert_tpu.etl.fasta import iter_fasta
+
+        pairs = list(iter_fasta(args.fasta))  # name = first header word
+        return [name for name, _ in pairs], [s for _, s in pairs]
+    if getattr(args, "seqs_file", None):
+        ids, seqs = [], []
+        with open(args.seqs_file) as f:
+            for i, line in enumerate(f):
+                line = line.strip()
+                if not line:
+                    continue
+                if "\t" in line:
+                    name, seq = line.split("\t", 1)
+                else:
+                    name, seq = f"seq{i}", line
+                ids.append(name)
+                seqs.append(seq)
+        return ids, seqs
+    if getattr(args, "seqs", None):
+        return [f"seq{i}" for i in range(len(args.seqs))], list(args.seqs)
+    raise SystemExit("provide --fasta, --seqs-file, or positional sequences")
+
+
+def _load_inference_trunk(args):
+    """(params, cfg) for the inference commands: rebuild the pretrain-run
+    config (--preset + --pretrained-set, same contract as finetune's
+    trunk restore) and load the latest checkpoint."""
+    from proteinbert_tpu import inference
+    from proteinbert_tpu.configs import get_preset
+
+    cfg = apply_overrides(get_preset(args.preset), args.pretrained_set or [])
+    params, step = inference.load_trunk(args.pretrained, cfg)
+    log(f"loaded trunk from {args.pretrained} (step {step})")
+    return params, cfg
+
+
+def cmd_convert_torch(args) -> int:
+    """Reference torch checkpoint → an orbax run directory this
+    framework's --pretrained / resume flags consume (interop.py). The
+    optimizer state starts fresh: the reference's Adam moments live in
+    torch layout and its attention params were never trained anyway
+    (SURVEY ledger #1)."""
+    import jax
+
+    from proteinbert_tpu import interop
+    from proteinbert_tpu.configs import get_preset
+    from proteinbert_tpu.train import Checkpointer, create_train_state
+
+    cfg = apply_overrides(get_preset(args.preset), args.set or [])
+    params, ckpt_step = interop.load_reference_checkpoint(
+        args.torch_ckpt, cfg.model,
+        init_key=jax.random.PRNGKey(cfg.train.seed))
+    step = args.step if args.step is not None else ckpt_step
+    state = create_train_state(jax.random.PRNGKey(cfg.train.seed), cfg)
+    state = state.replace(
+        params=params, step=jax.numpy.asarray(step, jax.numpy.int32))
+    ck = Checkpointer(args.output, async_save=False)
+    ck.save(step, state, {"batches_consumed": step})
+    ck.close()
+    log(f"converted {args.torch_ckpt} → {args.output} (step {step})")
+    return 0
+
+
+def cmd_embed(args) -> int:
+    """Write trunk representations for downstream models — the pretrained
+    encoder's raison d'être per the paper the reference replicates
+    (reference README.md:9), absent there because no inference path
+    exists (reference README.md:5-6)."""
+    import numpy as np
+
+    from proteinbert_tpu import inference
+
+    params, cfg = _load_inference_trunk(args)
+    ids, seqs = _read_named_seqs(args)
+    out = inference.embed(params, cfg, seqs, batch_size=args.batch_size,
+                          per_residue=args.per_residue)
+    log(f"embedded {len(seqs)} sequences: global {out['global'].shape}, "
+        f"local_mean {out['local_mean'].shape}")
+    if args.output.endswith(".npz"):
+        np.savez(args.output, ids=np.array(ids), **out)
+    else:
+        import h5py
+
+        with h5py.File(args.output, "w") as h5f:
+            h5f.create_dataset("ids", data=[i.encode() for i in ids],
+                               dtype=h5py.string_dtype())
+            for k, v in out.items():
+                h5f.create_dataset(k, data=v)
+    log(f"wrote {args.output}")
+    return 0
+
+
+def cmd_predict_go(args) -> int:
+    """Predict GO annotations from sequence alone (TSV to --output or
+    stdout: id, annotation column index, GO id if known, name if known,
+    probability)."""
+    from proteinbert_tpu import inference
+
+    params, cfg = _load_inference_trunk(args)
+    ids, seqs = _read_named_seqs(args)
+
+    go_ids = None
+    if args.data:  # annotation column → GO id, from the training dataset
+        import h5py
+
+        with h5py.File(args.data, "r") as h5f:
+            go_ids = [g.decode() if isinstance(g, bytes) else g
+                      for g in h5f["included_annotations"][:]]
+    names = {}
+    if args.go_meta_csv:
+        from proteinbert_tpu.etl.go_ontology import load_meta_csv
+
+        names = {r["id"]: r["name"] for r in load_meta_csv(args.go_meta_csv)}
+
+    top = inference.predict_go(params, cfg, seqs,
+                               batch_size=args.batch_size, top_k=args.top_k)
+    sink = open(args.output, "w") if args.output else sys.stdout
+    try:
+        for name, row in zip(ids, top):
+            for col, prob in row:
+                gid = go_ids[col] if go_ids and col < len(go_ids) else ""
+                sink.write(f"{name}\t{col}\t{gid}\t{names.get(gid, '')}\t"
+                           f"{prob:.4f}\n")
+    finally:
+        if sink is not sys.stdout:
+            sink.close()
+    return 0
+
+
+def cmd_predict_residues(args) -> int:
+    """Fill '?'-masked residues (the denoising task run as inference)."""
+    from proteinbert_tpu import inference
+
+    params, cfg = _load_inference_trunk(args)
+    ids, seqs = _read_named_seqs(args)
+    filled, _ = inference.predict_residues(params, cfg, seqs,
+                                           batch_size=args.batch_size)
+    sink = open(args.output, "w") if args.output else sys.stdout
+    try:
+        for name, seq in zip(ids, filled):
+            sink.write(f"{name}\t{seq}\n")
+    finally:
+        if sink is not sys.stdout:
+            sink.close()
+    return 0
+
+
 # ------------------------------------------------------------------ parser
 
 def build_parser() -> argparse.ArgumentParser:
@@ -506,6 +661,58 @@ def build_parser() -> argparse.ArgumentParser:
     ftp.add_argument("--history-json", type=creatable_path)
     ftp.add_argument("--set", action="append", metavar="PATH=VALUE")
     ftp.set_defaults(fn=cmd_finetune)
+
+    def add_infer_args(sp, output_required=False):
+        sp.add_argument("--pretrained", required=True,
+                        help="pretrain checkpoint dir for the trunk")
+        sp.add_argument("--preset", default="tiny",
+                        choices=["tiny", "base", "long", "large"])
+        sp.add_argument("--pretrained-set", action="append",
+                        metavar="PATH=VALUE",
+                        help="config override the pretrain run was made with")
+        sp.add_argument("--fasta", type=existing_file)
+        sp.add_argument("--seqs-file", type=existing_file,
+                        help="one sequence per line, optionally id<TAB>seq")
+        sp.add_argument("seqs", nargs="*", help="literal AA sequences")
+        sp.add_argument("--batch-size", type=int, default=32)
+        sp.add_argument("--output", type=creatable_path,
+                        required=output_required)
+
+    cv = sub.add_parser("convert-torch",
+                        help="reference torch checkpoint → orbax run dir")
+    cv.add_argument("--torch-ckpt", type=existing_file, required=True,
+                    help="reference checkpoint .pt (periodic dict, bare "
+                         "state_dict, or pickled module)")
+    cv.add_argument("--output", type=creatable_path, required=True,
+                    help="orbax run dir to create")
+    cv.add_argument("--preset", default="tiny",
+                    choices=["tiny", "base", "long", "large"])
+    cv.add_argument("--step", type=int,
+                    help="override the recorded iteration counter")
+    cv.add_argument("--set", action="append", metavar="PATH=VALUE",
+                    help="config matching the torch model's geometry")
+    cv.set_defaults(fn=cmd_convert_torch)
+
+    em = sub.add_parser("embed", help="trunk representations → HDF5/NPZ")
+    add_infer_args(em, output_required=True)
+    em.add_argument("--per-residue", action="store_true",
+                    help="also write per-residue local track (N, L, C)")
+    em.set_defaults(fn=cmd_embed)
+
+    pg = sub.add_parser("predict-go",
+                        help="GO annotation probabilities from sequence")
+    add_infer_args(pg)
+    pg.add_argument("--top-k", type=int, default=10)
+    pg.add_argument("--data", type=existing_file,
+                    help="training HDF5: maps annotation columns → GO ids")
+    pg.add_argument("--go-meta-csv", type=existing_file,
+                    help="GO meta CSV: adds term names to the output")
+    pg.set_defaults(fn=cmd_predict_go)
+
+    pr = sub.add_parser("predict-residues",
+                        help="fill '?'-masked residues via the local head")
+    add_infer_args(pr)
+    pr.set_defaults(fn=cmd_predict_residues)
 
     return p
 

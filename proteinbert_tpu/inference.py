@@ -1,0 +1,221 @@
+"""Inference on a pretrained trunk: embeddings, GO prediction, residue filling.
+
+The reference repo's end goal — per the ProteinBERT paper it replicates
+(reference README.md:9) — is a pretrained encoder whose representations
+feed downstream protein tasks, but it ships no inference path at all (the
+README defers even the pretrained model to "Soon(TM)", reference
+README.md:5-6; the only forward passes live inside the training loop,
+reference utils.py:291). This module supplies that missing surface,
+TPU-style: one jitted batched forward reused across every entry point,
+static shapes (pad to the config seq_len, fixed batch), host code doing
+only string work.
+
+Entry points:
+- `load_trunk`       — restore pretrained params from an orbax run dir.
+- `embed`            — (N, G) global + length-masked mean (N, C) local
+                       representations (the fine-tune features of
+                       models/finetune.py, exposed for external use).
+- `predict_go`       — sigmoid GO-annotation probabilities / top-k.
+- `predict_residues` — per-position amino-acid distributions; fills
+                       '?'-masked positions with the argmax residue.
+
+Annotations default to the all-zero vector: the corruption pipeline
+explicitly trains this "no annotations known" input via its p=0.5
+hide-all branch (reference data_processing.py:127-128, kept as a feature
+— SURVEY ledger #5), so it is the principled query input for a sequence
+whose GO terms are unknown.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from proteinbert_tpu.configs import ModelConfig, PretrainConfig
+from proteinbert_tpu.data.vocab import EOS_ID, PAD_ID, SOS_ID, UNK_ID, get_vocab
+from proteinbert_tpu.models import proteinbert
+
+MASK_CHAR = "?"  # maps to <unk>: the "residue unknown, predict it" input
+
+
+def load_trunk(checkpoint_dir: str, cfg: PretrainConfig):
+    """Restore pretrained params (and step) from a pretrain run directory.
+
+    `cfg` must describe the pretrain run (preset + overrides) so the
+    restore template matches the saved pytree — same contract as the
+    finetune CLI's --pretrained flag (cli/main.py).
+    """
+    from proteinbert_tpu.train import Checkpointer, create_train_state
+
+    template = create_train_state(jax.random.PRNGKey(cfg.train.seed), cfg)
+    ck = Checkpointer(checkpoint_dir, async_save=False)
+    try:
+        state, _ = ck.restore(template)
+    finally:
+        ck.close()
+    if state is None:
+        raise FileNotFoundError(f"no checkpoint found in {checkpoint_dir}")
+    return state.params, int(state.step)
+
+
+@partial(jax.jit, static_argnames=("cfg", "per_residue"))
+def _encode_batch(params, tokens, annotations, cfg: ModelConfig,
+                  per_residue: bool = False):
+    local, global_ = proteinbert.encode(params, tokens, annotations, cfg)
+    mask = (tokens != PAD_ID).astype(jnp.float32)[:, :, None]
+    local = local.astype(jnp.float32)
+    out = {
+        "local_mean": (local * mask).sum(1) / jnp.maximum(mask.sum(1), 1.0),
+        "global": global_.astype(jnp.float32),
+    }
+    if per_residue:  # only ship the big (B, L, C) track when asked
+        out["local"] = local
+    return out
+
+
+@partial(jax.jit, static_argnames="cfg")
+def _go_probs_batch(params, tokens, annotations, cfg: ModelConfig):
+    _, global_logits = proteinbert.apply(params, tokens, annotations, cfg)
+    return jax.nn.sigmoid(global_logits)
+
+
+@partial(jax.jit, static_argnames="cfg")
+def _residue_probs_batch(params, tokens, annotations, cfg: ModelConfig):
+    local_logits, _ = proteinbert.apply(params, tokens, annotations, cfg)
+    return jax.nn.softmax(local_logits, -1)
+
+
+def _tokenize_masked(seqs: Sequence[str], seq_len: int) -> np.ndarray:
+    """Tokenize with MASK_CHAR → <unk> (no random crop: inference is
+    deterministic; over-length sequences keep their first seq_len-2
+    residues)."""
+    vocab = get_vocab()
+    out = np.full((len(seqs), seq_len), PAD_ID, dtype=np.int32)
+    for i, seq in enumerate(seqs):
+        seq = seq[: seq_len - 2]
+        ids = vocab.encode(seq)  # MASK_CHAR is outside the alphabet → <unk>
+        out[i, 0] = SOS_ID
+        out[i, 1 : 1 + len(ids)] = ids
+        out[i, 1 + len(ids)] = EOS_ID
+    return out
+
+
+def _batched(
+    params, cfg: PretrainConfig, tokens: np.ndarray,
+    annotations: Optional[np.ndarray], batch_size: int, fn,
+) -> List:
+    """Run `fn(params, tokens, annotations, model_cfg)` over fixed-size
+    batches (last one padded so every call hits the same compiled shape);
+    returns the per-batch outputs trimmed back to the true row count.
+    `fn` must return only what the caller keeps — every leaf is copied to
+    host and retained across the whole run."""
+    n = tokens.shape[0]
+    if n == 0:
+        raise ValueError("no sequences given")
+    if annotations is None:
+        annotations = np.zeros((n, cfg.model.num_annotations), np.float32)
+    annotations = np.asarray(annotations, np.float32)
+    if annotations.shape != (n, cfg.model.num_annotations):
+        raise ValueError(
+            f"annotations shape {annotations.shape} != "
+            f"({n}, {cfg.model.num_annotations})"
+        )
+    outs = []
+    for start in range(0, n, batch_size):
+        tb = tokens[start : start + batch_size]
+        ab = annotations[start : start + batch_size]
+        rows = tb.shape[0]
+        if rows < batch_size:  # pad the tail batch to the compiled shape
+            tb = np.pad(tb, ((0, batch_size - rows), (0, 0)))
+            ab = np.pad(ab, ((0, batch_size - rows), (0, 0)))
+        res = fn(params, jnp.asarray(tb), jnp.asarray(ab), cfg.model)
+        outs.append(jax.tree.map(lambda a: np.asarray(a)[:rows], res))
+    return outs
+
+
+def embed(
+    params, cfg: PretrainConfig, seqs: Sequence[str],
+    annotations: Optional[np.ndarray] = None, batch_size: int = 32,
+    per_residue: bool = False,
+) -> Dict[str, np.ndarray]:
+    """Trunk representations for downstream use.
+
+    Returns {"global": (N, G), "local_mean": (N, C)} float32 — and, with
+    `per_residue=True`, "local": (N, seq_len, C) plus "tokens":
+    (N, seq_len) int32 so callers can mask pad positions themselves.
+    """
+    tokens = _tokenize_masked(seqs, cfg.data.seq_len)
+    outs = _batched(params, cfg, tokens, annotations, batch_size,
+                    partial(_encode_batch, per_residue=per_residue))
+    result = {k: np.concatenate([o[k] for o in outs]) for k in outs[0]}
+    if per_residue:
+        result["tokens"] = tokens
+    return result
+
+
+def predict_go(
+    params, cfg: PretrainConfig, seqs: Sequence[str],
+    batch_size: int = 32, top_k: Optional[int] = None,
+):
+    """GO-annotation probabilities from sequence alone.
+
+    Returns (N, A) sigmoid probabilities; with `top_k`, instead a list of
+    N descending [(annotation_index, prob), ...] lists. The indices are
+    rows of the HDF5 builder's `included_annotations` mapping
+    (etl/h5_builder.py) — join against the GO-meta CSV for names.
+    """
+    tokens = _tokenize_masked(seqs, cfg.data.seq_len)
+    outs = _batched(params, cfg, tokens, None, batch_size, _go_probs_batch)
+    probs = np.concatenate(outs)
+    if top_k is None:
+        return probs
+    k = min(top_k, probs.shape[1])
+    idx = np.argsort(-probs, axis=1)[:, :k]
+    return [
+        [(int(j), float(p)) for j, p in zip(row, prob_row[row])]
+        for row, prob_row in zip(idx, probs)
+    ]
+
+
+def predict_residues(
+    params, cfg: PretrainConfig, seqs: Sequence[str], batch_size: int = 32,
+) -> Tuple[List[str], np.ndarray]:
+    """Per-position amino-acid prediction; '?' marks residues to fill.
+
+    '?' positions enter the model as <unk> — the same "identity lost"
+    condition the denoising pretraining's token randomization teaches the
+    model to repair (reference data_processing.py:86-105). Returns
+    (filled_seqs, probs (N, seq_len, V) softmax over the full vocab).
+
+    Sequences longer than cfg.data.seq_len - 2 with a '?' in the
+    truncated tail are rejected (the model never sees those positions,
+    so "filling" them would silently return the mask unchanged).
+    """
+    window = cfg.data.seq_len - 2
+    for i, seq in enumerate(seqs):
+        if MASK_CHAR in seq[window:]:
+            raise ValueError(
+                f"sequence {i} has a {MASK_CHAR!r} beyond position "
+                f"{window} — outside the model's seq_len window; raise "
+                "data.seq_len (--pretrained-set data.seq_len=...) or "
+                "split the sequence")
+    tokens = _tokenize_masked(seqs, cfg.data.seq_len)
+    outs = _batched(params, cfg, tokens, None, batch_size,
+                    _residue_probs_batch)
+    probs = np.concatenate(outs)
+    vocab = get_vocab()
+    # Only amino-acid tokens are valid fills (never pad/sos/eos/unk).
+    aa_probs = probs.copy()
+    aa_probs[:, :, : UNK_ID + 1] = 0.0
+    filled = []
+    for i, seq in enumerate(seqs):
+        chars = list(seq[:window])
+        for pos, ch in enumerate(chars):
+            if ch == MASK_CHAR:
+                chars[pos] = vocab.itos[int(aa_probs[i, pos + 1].argmax())]
+        filled.append("".join(chars) + seq[window:])
+    return filled, probs

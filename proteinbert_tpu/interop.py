@@ -1,0 +1,194 @@
+"""Migrate reference (PyTorch) checkpoints into this framework.
+
+A user of the reference repo holds `torch.save`d artifacts in one of two
+forms: the periodic pretraining checkpoint dict (key `model_state_dict`,
+reference utils.py:326-337) or the final pickled `nn.Module` (reference
+utils.py:339-343). This module converts either into this framework's
+parameter pytree so pretraining can be resumed — or fine-tuning/inference
+run — on TPU.
+
+Layout translation (torch state_dict key → pytree path), per the
+reference's module tree (reference modules.py:234-304):
+
+  local_embedding.weight                  → embedding.embedding      (V, C)
+  global_linear_layer.0.{weight,bias}     → global_in                (A→G, .T)
+  proteinBERT_blocks.{i}.
+    local_narrow_conv_layer.0.*           → blocks.narrow_conv       (Cout,Cin,K)→(K,Cin,Cout)
+    local_wide_conv_layer.0.*             → blocks.wide_conv         ditto
+    global_to_local_linear_layer.0.*      → blocks.global_to_local   (.T)
+    local_linear_layer.0.*                → blocks.local_dense       (.T)
+    global_linear_layer_1.0.*             → blocks.global_dense1     (.T)
+    global_linear_layer_2.0.*             → blocks.global_dense2     (.T)
+    local_norm_{1,2}.*                    → blocks.local_ln{1,2}     (see below)
+    global_norm_{1,2}.*                   → blocks.global_ln{1,2}
+  pretraining_local_output.0.*            → local_head               (.T)
+  pretraining_global_output.0.*           → global_head              (.T)
+
+Two reference quirks force documented conversion decisions:
+
+1. The reference's local LayerNorms normalize jointly over (seq_len,
+   local_dim) with a per-(position, feature) affine (reference
+   modules.py:148-151,161-164); this framework uses per-feature LN so the
+   model is shape-parametric in L (SURVEY ledger #4). The (L, C) affine
+   is reduced to (C,) by averaging over positions — exact when the torch
+   affine is position-independent (e.g. still at its ones/zeros init),
+   the closest L2 projection otherwise.
+2. The reference's attention-head projections are invisible to
+   `state_dict` (plain Python list, never trained OR saved — reference
+   modules.py:73-81, SURVEY ledger #1), so there is nothing to convert:
+   converted models keep this framework's fresh attention init, which is
+   also exactly what a resumed reference run would have done.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping
+
+import jax
+import numpy as np
+
+from proteinbert_tpu.configs import ModelConfig
+from proteinbert_tpu.models import proteinbert
+
+Params = Dict[str, Any]
+
+_BLOCK_PREFIX = "proteinBERT_blocks."  # reference modules.py:264
+
+# torch Sequential submodule → our block param name, with the transform
+# each weight needs ("linear" transposes, "conv" goes (Cout,Cin,K)→(K,Cin,Cout)).
+_BLOCK_MAP = {
+    "local_narrow_conv_layer.0": ("narrow_conv", "conv"),
+    "local_wide_conv_layer.0": ("wide_conv", "conv"),
+    "global_to_local_linear_layer.0": ("global_to_local", "linear"),
+    "local_linear_layer.0": ("local_dense", "linear"),
+    "global_linear_layer_1.0": ("global_dense1", "linear"),
+    "global_linear_layer_2.0": ("global_dense2", "linear"),
+    "local_norm_1": ("local_ln1", "norm"),
+    "local_norm_2": ("local_ln2", "norm"),
+    "global_norm_1": ("global_ln1", "norm"),
+    "global_norm_2": ("global_ln2", "norm"),
+}
+
+
+def _to_numpy(t) -> np.ndarray:
+    if hasattr(t, "detach"):  # torch tensor without importing torch here
+        t = t.detach().cpu().numpy()
+    return np.asarray(t, dtype=np.float32)
+
+
+def _weight(kind: str, w: np.ndarray) -> np.ndarray:
+    if kind == "linear":  # torch (out, in) → (in, out)
+        return w.T
+    if kind == "conv":  # torch (Cout, Cin, K) → (K, Cin, Cout)
+        return w.transpose(2, 1, 0)
+    return w
+
+
+def _norm_affine(w: np.ndarray) -> np.ndarray:
+    """(L, C) joint-LN affine → per-feature (C,) (module docstring #1)."""
+    return w.mean(axis=0) if w.ndim == 2 else w
+
+
+def convert_reference_state_dict(
+    state_dict: Mapping[str, Any], cfg: ModelConfig,
+    init_key: jax.Array | None = None,
+) -> Params:
+    """Reference `model_state_dict` → this framework's param pytree.
+
+    `cfg` must match the torch model's geometry (local/global dims,
+    blocks, annotations); mismatched shapes raise. Parameters the
+    reference never saved (attention heads, docstring #2) keep the fresh
+    init from `init_key`.
+    """
+    params = jax.tree.map(
+        np.asarray,
+        proteinbert.init(init_key if init_key is not None else
+                         jax.random.PRNGKey(0), cfg),
+    )
+    sd = {k: _to_numpy(v) for k, v in state_dict.items()}
+    consumed = set()
+
+    def take(key: str, target: np.ndarray, transform=lambda w: w) -> np.ndarray:
+        if key not in sd:
+            raise ValueError(
+                f"torch state_dict is missing {key!r} (config mismatch? "
+                f"e.g. more blocks configured than the checkpoint has)")
+        w = transform(sd[key])
+        if w.shape != target.shape:
+            raise ValueError(
+                f"{key}: converted shape {w.shape} != expected {target.shape} "
+                f"(config mismatch?)")
+        consumed.add(key)
+        return w.astype(np.float32)
+
+    params["embedding"]["embedding"] = take(
+        "local_embedding.weight", params["embedding"]["embedding"])
+    for name, tkey in (("global_in", "global_linear_layer.0"),
+                       ("local_head", "pretraining_local_output.0"),
+                       ("global_head", "pretraining_global_output.0")):
+        params[name]["kernel"] = take(
+            f"{tkey}.weight", params[name]["kernel"], lambda w: w.T)
+        params[name]["bias"] = take(f"{tkey}.bias", params[name]["bias"])
+
+    blocks = [dict() for _ in range(cfg.num_blocks)]
+    stacked = params["blocks"]
+    for i in range(cfg.num_blocks):
+        if cfg.scan_blocks:
+            tmpl = jax.tree.map(lambda a: a[i], stacked)
+        else:
+            tmpl = stacked[i]
+        blk = jax.tree.map(np.asarray, tmpl)
+        for sub, (ours, kind) in _BLOCK_MAP.items():
+            wkey = f"{_BLOCK_PREFIX}{i}.{sub}.weight"
+            bkey = f"{_BLOCK_PREFIX}{i}.{sub}.bias"
+            if kind == "norm":
+                blk[ours]["scale"] = take(wkey, blk[ours]["scale"], _norm_affine)
+                blk[ours]["bias"] = take(bkey, blk[ours]["bias"], _norm_affine)
+            else:
+                blk[ours]["kernel"] = take(
+                    wkey, blk[ours]["kernel"], lambda w, k=kind: _weight(k, w))
+                blk[ours]["bias"] = take(bkey, blk[ours]["bias"])
+        # global_attention_layer.W_parameter is the reference's learned
+        # k-dim contraction for its tiled-query scheme (reference
+        # modules.py:82-92); this architecture has one query per head
+        # (ops/attention.py) so there is no counterpart — skip it.
+        consumed.add(f"{_BLOCK_PREFIX}{i}.global_attention_layer.W_parameter")
+        blocks[i] = blk
+
+    if cfg.scan_blocks:
+        params["blocks"] = jax.tree.map(
+            lambda *xs: np.stack(xs), *blocks)
+    else:
+        params["blocks"] = blocks
+
+    leftover = set(sd) - consumed
+    if leftover:
+        raise ValueError(
+            f"unrecognized torch keys (wrong architecture?): "
+            f"{sorted(leftover)[:5]}{'...' if len(leftover) > 5 else ''}")
+    return jax.tree.map(lambda a: np.asarray(a, np.float32), params)
+
+
+def load_reference_checkpoint(
+    path: str, cfg: ModelConfig, init_key: jax.Array | None = None,
+) -> tuple[Params, int]:
+    """Load a reference torch artifact (checkpoint dict, bare state_dict,
+    or pickled module — all three forms the reference produces) and
+    convert it. Returns (params, step) where step is the periodic
+    checkpoint's iteration counter (`current_batch_iteration`, reference
+    utils.py:326-337) or 0 for the other forms. Requires torch (CPU ok).
+    """
+    import torch
+
+    obj = torch.load(path, map_location="cpu", weights_only=False)
+    step = 0
+    if hasattr(obj, "state_dict"):  # final whole-module save (utils.py:339-343)
+        sd = obj.state_dict()
+    elif isinstance(obj, Mapping) and "model_state_dict" in obj:
+        sd = obj["model_state_dict"]  # periodic checkpoint (utils.py:326-337)
+        step = int(obj.get("current_batch_iteration", 0))
+    elif isinstance(obj, Mapping):
+        sd = obj
+    else:
+        raise ValueError(f"unrecognized torch artifact in {path}: {type(obj)}")
+    return convert_reference_state_dict(sd, cfg, init_key), step

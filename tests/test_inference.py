@@ -1,0 +1,199 @@
+"""Inference surface tests: embeddings, GO prediction, residue filling.
+
+The reference has no inference path at all (its README defers even the
+pretrained model, reference README.md:5-6); these tests cover the
+capability this framework adds on top (proteinbert_tpu/inference.py) and
+its CLI commands.
+"""
+
+import numpy as np
+import pytest
+
+from proteinbert_tpu import inference
+from proteinbert_tpu.configs import (
+    CheckpointConfig, DataConfig, ModelConfig, OptimizerConfig,
+    PretrainConfig, TrainConfig,
+)
+from proteinbert_tpu.data.vocab import ALPHABET, VOCAB_SIZE
+from proteinbert_tpu.train import Checkpointer, create_train_state
+
+import jax
+
+
+def _cfg():
+    return PretrainConfig(
+        model=ModelConfig(local_dim=32, global_dim=64, key_dim=16,
+                          num_heads=4, num_blocks=2, num_annotations=64,
+                          dtype="float32"),
+        data=DataConfig(seq_len=48, batch_size=4),
+        optimizer=OptimizerConfig(warmup_steps=5),
+        train=TrainConfig(seed=0, max_steps=1),
+        checkpoint=CheckpointConfig(),
+    )
+
+
+@pytest.fixture(scope="module")
+def trunk(tmp_path_factory):
+    """A saved (untrained) state + its restore via load_trunk."""
+    cfg = _cfg()
+    d = str(tmp_path_factory.mktemp("ck"))
+    state = create_train_state(jax.random.PRNGKey(cfg.train.seed), cfg)
+    state = state.replace(step=jax.numpy.asarray(3, jax.numpy.int32))
+    ck = Checkpointer(d, async_save=False)
+    ck.save(3, state, {"batches_consumed": 3})
+    ck.close()
+    params, step = inference.load_trunk(d, cfg)
+    assert step == 3
+    return params, cfg, d
+
+
+SEQS = ["MKTAYIAKQR", "ACDEFGHIKLMNPQRSTVWY" * 3, "GG"]
+
+
+def test_embed_shapes_and_determinism(trunk):
+    params, cfg, _ = trunk
+    out = inference.embed(params, cfg, SEQS, batch_size=2)
+    assert out["global"].shape == (3, cfg.model.global_dim)
+    assert out["local_mean"].shape == (3, cfg.model.local_dim)
+    assert all(np.isfinite(v).all() for v in out.values())
+    again = inference.embed(params, cfg, SEQS, batch_size=2)
+    np.testing.assert_array_equal(out["global"], again["global"])
+
+
+def test_embed_batch_padding_invariance(trunk):
+    """A sequence's embedding must not depend on which batch it rode in
+    (the tail batch is padded to the compiled batch shape)."""
+    params, cfg, _ = trunk
+    solo = inference.embed(params, cfg, [SEQS[2]], batch_size=4)
+    batched = inference.embed(params, cfg, SEQS, batch_size=4)
+    np.testing.assert_allclose(
+        solo["global"][0], batched["global"][2], rtol=2e-5, atol=2e-5)
+
+
+def test_embed_per_residue(trunk):
+    params, cfg, _ = trunk
+    out = inference.embed(params, cfg, SEQS[:1], per_residue=True)
+    assert out["local"].shape == (1, cfg.data.seq_len, cfg.model.local_dim)
+    assert out["tokens"].shape == (1, cfg.data.seq_len)
+    # local_mean is the pad-masked mean of the per-residue track.
+    mask = out["tokens"][0] != 0
+    np.testing.assert_allclose(
+        out["local"][0][mask].mean(0), out["local_mean"][0],
+        rtol=1e-4, atol=1e-5)
+
+
+def test_embed_annotations_shape_checked(trunk):
+    params, cfg, _ = trunk
+    with pytest.raises(ValueError, match="annotations shape"):
+        inference.embed(params, cfg, SEQS, annotations=np.zeros((3, 5)))
+
+
+def test_predict_go_probs_and_topk(trunk):
+    params, cfg, _ = trunk
+    probs = inference.predict_go(params, cfg, SEQS)
+    assert probs.shape == (3, cfg.model.num_annotations)
+    assert ((probs >= 0) & (probs <= 1)).all()
+    top = inference.predict_go(params, cfg, SEQS, top_k=5)
+    assert len(top) == 3 and all(len(row) == 5 for row in top)
+    for row in top:
+        ps = [p for _, p in row]
+        assert ps == sorted(ps, reverse=True)
+    # top-1 matches the dense argmax
+    assert top[0][0][0] == int(probs[0].argmax())
+
+
+def test_predict_residues_fills_masks(trunk):
+    params, cfg, _ = trunk
+    masked = "MKTA?IAK?R"
+    filled, probs = inference.predict_residues(params, cfg, [masked])
+    assert probs.shape == (1, cfg.data.seq_len, VOCAB_SIZE)
+    assert len(filled[0]) == len(masked)
+    for i, ch in enumerate(masked):
+        if ch == inference.MASK_CHAR:
+            assert filled[0][i] in ALPHABET  # never pad/sos/eos/unk
+        else:
+            assert filled[0][i] == ch
+
+
+def test_predict_residues_rejects_mask_beyond_window(trunk):
+    """A '?' the crop window would silently drop must be an error."""
+    params, cfg, _ = trunk
+    long_seq = "A" * (cfg.data.seq_len + 5) + "?"
+    with pytest.raises(ValueError, match="beyond position"):
+        inference.predict_residues(params, cfg, [long_seq])
+
+
+def test_empty_input_rejected(trunk):
+    params, cfg, _ = trunk
+    with pytest.raises(ValueError, match="no sequences"):
+        inference.embed(params, cfg, [])
+
+
+def test_load_trunk_missing_dir(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        inference.load_trunk(str(tmp_path / "nope"), _cfg())
+
+
+def test_embed_cli_roundtrip(trunk, tmp_path):
+    """embed → HDF5 with ids aligned to inputs; predict-residues → TSV.
+    In-process main() like the rest of the CLI suite (tests/test_cli.py)."""
+    import h5py
+
+    from proteinbert_tpu.cli.main import main
+
+    _, cfg, ckdir = trunk
+    fasta = tmp_path / "q.fasta"
+    fasta.write_text(">p1 desc\nMKTAYIAKQR\n>p2\nGGAC\nDEFG\n")
+    out_h5 = tmp_path / "emb.h5"
+    overrides = [
+        f"--pretrained-set=model.{f}={getattr(cfg.model, f)}"
+        for f in ("local_dim", "global_dim", "key_dim", "num_heads",
+                  "num_blocks", "num_annotations")
+    ] + ["--pretrained-set=model.dtype=float32",
+         f"--pretrained-set=data.seq_len={cfg.data.seq_len}"]
+    assert main(["embed", "--pretrained", ckdir, "--preset", "tiny",
+                 *overrides, "--fasta", str(fasta),
+                 "--output", str(out_h5), "--batch-size", "2"]) == 0
+    with h5py.File(out_h5) as h5f:
+        ids = [x.decode() for x in h5f["ids"][:]]
+        assert ids == ["p1", "p2"]
+        assert h5f["global"].shape == (2, cfg.model.global_dim)
+
+    out_tsv = tmp_path / "filled.tsv"
+    assert main(["predict-residues", "--pretrained", ckdir,
+                 "--preset", "tiny", *overrides,
+                 "--output", str(out_tsv), "MK?AYI"]) == 0
+    name, seq = out_tsv.read_text().strip().split("\t")
+    assert name == "seq0" and len(seq) == 6 and "?" not in seq
+
+
+def test_predict_go_cli_with_go_ids(trunk, tmp_path):
+    """predict-go TSV output joins annotation columns to GO ids from a
+    training-format HDF5 file."""
+    import h5py
+
+    from proteinbert_tpu.cli.main import main
+
+    _, cfg, ckdir = trunk
+    data_h5 = tmp_path / "train.h5"
+    go_ids = [f"GO:{i:07d}" for i in range(cfg.model.num_annotations)]
+    with h5py.File(data_h5, "w") as h5f:
+        h5f.create_dataset("included_annotations",
+                           data=[g.encode() for g in go_ids],
+                           dtype=h5py.string_dtype())
+    overrides = [
+        f"--pretrained-set=model.{f}={getattr(cfg.model, f)}"
+        for f in ("local_dim", "global_dim", "key_dim", "num_heads",
+                  "num_blocks", "num_annotations")
+    ] + ["--pretrained-set=model.dtype=float32",
+         f"--pretrained-set=data.seq_len={cfg.data.seq_len}"]
+    out = tmp_path / "go.tsv"
+    assert main(["predict-go", "--pretrained", ckdir, "--preset", "tiny",
+                 *overrides, "--data", str(data_h5), "--top-k", "3",
+                 "--output", str(out), "MKTAYIAKQR"]) == 0
+    rows = [ln.split("\t") for ln in out.read_text().strip().split("\n")]
+    assert len(rows) == 3
+    for name, col, gid, _gname, prob in rows:
+        assert name == "seq0"
+        assert gid == go_ids[int(col)]
+        assert 0.0 <= float(prob) <= 1.0

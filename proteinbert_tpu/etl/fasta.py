@@ -39,9 +39,37 @@ def iter_fasta(path: str) -> Iterator[Tuple[str, str]]:
             yield name, "".join(parts)
 
 
-def build_index(fasta_path: str, index_path: str | None = None) -> str:
-    """Write a samtools-style .fai index; returns its path."""
+def build_index(fasta_path: str, index_path: str | None = None,
+                use_native: bool = True) -> str:
+    """Write a samtools-style .fai index; returns its path.
+
+    Dispatches to the C++ scanner (native/fasta_index.cpp) when available
+    — UniRef90's FASTA is tens of GB and this loop is the index-build
+    bottleneck; the pure-Python path below is the semantic ground truth
+    (parity-tested in tests/test_native.py) and the automatic fallback.
+
+    The index is written to a temp path and renamed into place only on
+    success: FastaReader trusts any existing .fai, so a build that raises
+    (ragged input) must not leave a truncated index behind.
+    """
     index_path = index_path or fasta_path + ".fai"
+    tmp_path = f"{index_path}.tmp{os.getpid()}"
+    try:
+        _build_index_impl(fasta_path, tmp_path, use_native)
+        os.replace(tmp_path, index_path)
+    finally:
+        if os.path.exists(tmp_path):
+            os.unlink(tmp_path)
+    return index_path
+
+
+def _build_index_impl(fasta_path: str, index_path: str,
+                      use_native: bool) -> None:
+    if use_native:
+        from proteinbert_tpu.native.fasta_index import build_fai_native
+
+        if build_fai_native(fasta_path, index_path) is not None:
+            return
     with open(fasta_path, "rb") as f, open(index_path, "w") as out:
         name = None
         rlen = 0
@@ -85,7 +113,6 @@ def build_index(fasta_path: str, index_path: str | None = None) -> str:
             offset += len(raw)
         if name is not None:
             out.write(f"{name}\t{rlen}\t{seq_offset}\t{line_bases}\t{line_bytes}\n")
-    return index_path
 
 
 class FastaReader:

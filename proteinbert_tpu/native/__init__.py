@@ -10,6 +10,8 @@ live).
 """
 
 from proteinbert_tpu.native.build import load_library, native_available
+from proteinbert_tpu.native.fasta_index import build_fai_native
 from proteinbert_tpu.native.tokenizer import tokenize_batch_native
 
-__all__ = ["load_library", "native_available", "tokenize_batch_native"]
+__all__ = ["build_fai_native", "load_library", "native_available",
+           "tokenize_batch_native"]

@@ -70,7 +70,9 @@ def main():
                            num_heads=8, num_blocks=6, dtype="bfloat16")
         variants = [  # (name, model, batch)
             ("xla-remat", dataclasses.replace(base, remat=True), 256),
+            ("xla-remat", dataclasses.replace(base, remat=True), 512),
             ("pallas", dataclasses.replace(base, use_pallas=True), 64),
+            ("pallas", dataclasses.replace(base, use_pallas=True), 128),
         ]
         steps = 15
     else:  # CPU fallback so the script always emits its line

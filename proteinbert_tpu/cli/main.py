@@ -13,6 +13,7 @@ is one console with subcommands:
   smoke              the dummy_tests-equivalent end-to-end sanity run
   finetune           supervised task head on a (pretrained) trunk
   convert-torch      reference torch checkpoint → orbax run dir (migration)
+  export-weights     orbax run dir → flat NPZ of named arrays (portability)
   embed              trunk representations for sequences → HDF5/NPZ
   predict-go         GO-annotation probabilities from sequence alone
   predict-residues   fill '?'-masked residues, report per-position probs
@@ -488,6 +489,19 @@ def cmd_convert_torch(args) -> int:
     return 0
 
 
+def cmd_export_weights(args) -> int:
+    """Trained params → flat NPZ (export.py): slash-joined pytree paths,
+    per-block entries, fp32 — readable by any numpy consumer with no
+    dependency on this codebase (unlike the reference's pickled-module
+    save, reference utils.py:339-343)."""
+    from proteinbert_tpu import export
+
+    params, cfg = _load_inference_trunk(args)
+    n = export.export_params(params, args.output)
+    log(f"wrote {n} arrays → {args.output}")
+    return 0
+
+
 def cmd_embed(args) -> int:
     """Write trunk representations for downstream models — the pretrained
     encoder's raison d'être per the paper the reference replicates
@@ -692,6 +706,18 @@ def build_parser() -> argparse.ArgumentParser:
     cv.add_argument("--set", action="append", metavar="PATH=VALUE",
                     help="config matching the torch model's geometry")
     cv.set_defaults(fn=cmd_convert_torch)
+
+    ex = sub.add_parser("export-weights",
+                        help="trained params → flat NPZ of named arrays")
+    ex.add_argument("--pretrained", required=True,
+                    help="pretrain checkpoint dir")
+    ex.add_argument("--preset", default="tiny",
+                    choices=["tiny", "base", "long", "large"])
+    ex.add_argument("--pretrained-set", action="append",
+                    metavar="PATH=VALUE",
+                    help="config override the pretrain run was made with")
+    ex.add_argument("--output", type=creatable_path, required=True)
+    ex.set_defaults(fn=cmd_export_weights)
 
     em = sub.add_parser("embed", help="trunk representations → HDF5/NPZ")
     add_infer_args(em, output_required=True)

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import gzip
 import os
+import tempfile
 from typing import Dict, Iterator, Tuple
 
 
@@ -53,9 +54,15 @@ def build_index(fasta_path: str, index_path: str | None = None,
     (ragged input) must not leave a truncated index behind.
     """
     index_path = index_path or fasta_path + ".fai"
-    tmp_path = f"{index_path}.tmp{os.getpid()}"
+    # mkstemp (not a pid suffix): concurrent builders in the SAME process
+    # (reader threads racing to index) must not share a temp file.
+    fd, tmp_path = tempfile.mkstemp(
+        prefix=os.path.basename(index_path) + ".tmp",
+        dir=os.path.dirname(os.path.abspath(index_path)))
+    os.close(fd)
     try:
         _build_index_impl(fasta_path, tmp_path, use_native)
+        os.chmod(tmp_path, 0o644)  # mkstemp is 0600
         os.replace(tmp_path, index_path)
     finally:
         if os.path.exists(tmp_path):

@@ -1,0 +1,94 @@
+"""Export trained parameters to portable flat files (NPZ).
+
+The inverse direction of `interop.py`: that module brings reference torch
+checkpoints IN; this one gets trained weights OUT of the orbax run
+directory into a dependency-free format (a flat NPZ of slash-joined
+pytree paths) that any numpy-speaking consumer — including a PyTorch
+user going back the other way — can read. The reference's only export is
+a pickled `nn.Module` (reference utils.py:339-343), unreadable without
+the exact class code on the unpickling side; a flat array map has no such
+coupling.
+
+Round-trip: `export_params` → `import_params` reproduces the pytree
+exactly (tests/test_export.py), and the stacked scan-blocks layout is
+unstacked to per-block entries (`blocks/0/...`) so the file is
+self-describing regardless of cfg.scan_blocks.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import numpy as np
+
+Params = Dict[str, Any]
+
+
+def flatten_params(params: Params, unstack_blocks: bool = True) -> Dict[str, np.ndarray]:
+    """Pytree → {"embedding/embedding": array, "blocks/0/narrow_conv/kernel":
+    array, ...} with fp32 numpy leaves."""
+    flat: Dict[str, np.ndarray] = {}
+
+    def walk(node, path):
+        if isinstance(node, dict):
+            for k in sorted(node):
+                walk(node[k], path + (str(k),))
+        elif isinstance(node, (list, tuple)):
+            for i, v in enumerate(node):
+                walk(v, path + (str(i),))
+        else:
+            flat["/".join(path)] = np.asarray(node)
+
+    p = dict(params)
+    blocks = p.pop("blocks", None)
+    walk(p, ())
+    if blocks is None:
+        return flat
+    if isinstance(blocks, dict) and unstack_blocks:
+        # Stacked scan layout: every leaf has a leading num_blocks axis.
+        # One device→host transfer of the whole stack, then host slicing.
+        blocks_np = jax.tree.map(np.asarray, blocks)
+        n = jax.tree.leaves(blocks_np)[0].shape[0]
+        for i in range(n):
+            walk(jax.tree.map(lambda a: a[i], blocks_np),
+                 ("blocks", str(i)))
+    else:
+        walk(blocks, ("blocks",))
+    return flat
+
+
+def unflatten_params(flat: Dict[str, np.ndarray],
+                     scan_blocks: bool = True) -> Params:
+    """Inverse of flatten_params; restacks `blocks/<i>/...` entries when
+    `scan_blocks` (the framework's default layout)."""
+    tree: Dict[str, Any] = {}
+    for key, value in flat.items():
+        parts = key.split("/")
+        node = tree
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = np.asarray(value)
+
+    blocks = tree.pop("blocks", None)
+    if blocks is not None:
+        per_block = [blocks[k] for k in sorted(blocks, key=int)]
+        if scan_blocks:
+            tree["blocks"] = jax.tree.map(
+                lambda *xs: np.stack(xs), *per_block)
+        else:
+            tree["blocks"] = per_block
+    return tree
+
+
+def export_params(params: Params, path: str) -> int:
+    """Write the pytree as a flat NPZ; returns the number of arrays."""
+    flat = flatten_params(params)
+    np.savez(path, **flat)
+    return len(flat)
+
+
+def import_params(path: str, scan_blocks: bool = True) -> Params:
+    with np.load(path) as z:
+        flat = {k: z[k] for k in z.files}
+    return unflatten_params(flat, scan_blocks=scan_blocks)

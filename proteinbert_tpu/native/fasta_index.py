@@ -3,6 +3,7 @@
 from __future__ import annotations
 
 import ctypes
+import os
 from typing import Optional
 
 from proteinbert_tpu.native.build import load_library
@@ -49,8 +50,8 @@ def build_fai_native(fasta_path: str, fai_path: str) -> Optional[int]:
     had_header = ctypes.c_int32(0)
     err_name = ctypes.create_string_buffer(_NAME_CAP)
     rc = lib.pbt_build_fai(
-        fasta_path.encode(), fai_path.encode(), ctypes.byref(had_header),
-        err_name, _NAME_CAP)
+        os.fsencode(fasta_path), os.fsencode(fai_path),
+        ctypes.byref(had_header), err_name, _NAME_CAP)
     if rc == _ERR_NON_UNIFORM:
         name = err_name.value.decode(errors="replace") \
             if had_header.value else None

@@ -47,6 +47,33 @@ def make_mesh(
             f"mesh {cfg.shape} wants {cfg.num_devices} devices, have {n}"
         )
     if devices[0].platform == "tpu":
+        n_slices = len({getattr(d, "slice_index", 0) for d in devices})
+        if n_slices > 1:
+            # Multi-slice pod: the slower DCN hop must carry only the
+            # outermost 'data' axis (its gradient psum is the one
+            # collective that tolerates DCN latency — module docstring);
+            # fsdp/model/seq collectives stay on intra-slice ICI.
+            if cfg.data % n_slices:
+                raise ValueError(
+                    f"mesh data axis {cfg.data} must be a multiple of the "
+                    f"{n_slices} slices so DCN carries only data "
+                    "parallelism")
+            from jax.experimental import mesh_utils
+
+            per_slice = (cfg.data // n_slices, cfg.fsdp, cfg.model, cfg.seq)
+            try:
+                dev_array = mesh_utils.create_hybrid_device_mesh(
+                    per_slice, (n_slices, 1, 1, 1), devices=devices)
+                return Mesh(dev_array, cfg.axis_names)
+            except Exception:  # pragma: no cover - picky topology helpers:
+                # a reshape mesh is suboptimal (DCN placement not
+                # guaranteed) but runs; don't crash training at startup.
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "create_hybrid_device_mesh failed for %s over %d "
+                    "slices; falling back to reshape ordering",
+                    cfg.shape, n_slices)
         try:
             from jax.experimental import mesh_utils
 

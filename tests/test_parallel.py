@@ -151,3 +151,48 @@ def test_halo_conv_single_shard_degenerates():
     ref = conv1d_apply(params, x, dilation=2)
     got = seq_parallel_conv1d(mesh, params, x, dilation=2)
     np.testing.assert_allclose(np.asarray(ref), np.asarray(got), atol=1e-5)
+
+
+# ------------------------------------------------------ multi-slice mesh
+
+class _FakeTpuDev:
+    """Stub with the attributes mesh_utils consults (id, process_index,
+    slice_index, coords, core_on_chip, device_kind, platform)."""
+
+    def __init__(self, i, slice_index):
+        self.id = i
+        self.process_index = slice_index
+        self.slice_index = slice_index
+        self.platform = "tpu"
+        self.device_kind = "faketpu"
+        j = i % 4
+        self.coords = (j % 2, j // 2, 0)
+        self.core_on_chip = 0
+
+    def __repr__(self):
+        return f"fake{self.id}@slice{self.slice_index}"
+
+
+def test_multislice_mesh_puts_data_axis_on_dcn():
+    """2 slices x 4 chips: the data axis must span slices (outer DCN hop)
+    while fsdp/model stay within a slice's ICI."""
+    from proteinbert_tpu.configs import MeshConfig
+    from proteinbert_tpu.parallel.mesh import make_mesh
+
+    devs = [_FakeTpuDev(i, i // 4) for i in range(8)]
+    mesh = make_mesh(MeshConfig(data=2, fsdp=2, model=2, seq=1), devs)
+    assert dict(mesh.shape) == {"data": 2, "fsdp": 2, "model": 2, "seq": 1}
+    arr = mesh.devices
+    # Each data-axis row is one slice; every other axis stays intra-slice.
+    for d in range(2):
+        slices = {dev.slice_index for dev in arr[d].flatten()}
+        assert slices == {d}, f"data row {d} spans slices {slices}"
+
+
+def test_multislice_mesh_rejects_indivisible_data_axis():
+    from proteinbert_tpu.configs import MeshConfig
+    from proteinbert_tpu.parallel.mesh import make_mesh
+
+    devs = [_FakeTpuDev(i, i // 4) for i in range(8)]
+    with pytest.raises(ValueError, match="multiple of the 2 slices"):
+        make_mesh(MeshConfig(data=1, fsdp=2, model=2, seq=2), devs)

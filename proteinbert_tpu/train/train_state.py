@@ -30,7 +30,7 @@ import optax
 from proteinbert_tpu.configs import PretrainConfig
 from proteinbert_tpu.models import proteinbert
 from proteinbert_tpu.data.corruption import corrupt_batch
-from proteinbert_tpu.train.loss import pretrain_loss
+from proteinbert_tpu.train.loss import global_ranking_metrics, pretrain_loss
 from proteinbert_tpu.train.schedule import make_optimizer, needs_loss_value
 
 
@@ -125,4 +125,8 @@ def eval_step(
         state.params, X["local"], X["global"], cfg.model, pad_mask
     )
     _, metrics = pretrain_loss(local_logits, global_logits, Y, W)
+    # Ranking quality of the GO head — eval-only (kept out of the hot
+    # train step; the trainer prefixes these with eval_).
+    metrics.update(global_ranking_metrics(
+        global_logits, Y["global"], W["global"]))
     return metrics

@@ -271,3 +271,108 @@ def test_pretrain_with_eval_split():
     assert all(np.isfinite(h["eval_loss"]) for h in evals)
     evals2 = [h for h in run()["history"] if "eval_loss" in h]
     assert evals[0]["eval_loss"] == evals2[0]["eval_loss"]  # deterministic
+
+
+# ------------------------------------------------- GO ranking eval metrics
+
+def _brute_force_auroc(scores, labels, valid):
+    """O(n^2) pairwise AUROC over valid elements (test oracle)."""
+    s = scores[valid]
+    y = labels[valid]
+    pos, neg = s[y], s[~y]
+    if len(pos) == 0 or len(neg) == 0:
+        return 0.5
+    wins = (pos[:, None] > neg[None, :]).sum()
+    ties = (pos[:, None] == neg[None, :]).sum()
+    return (wins + 0.5 * ties) / (len(pos) * len(neg))
+
+
+def test_global_auroc_matches_brute_force():
+    from proteinbert_tpu.train.loss import global_ranking_metrics
+
+    rng = np.random.default_rng(0)
+    for trial in range(5):
+        logits = rng.normal(size=(6, 40)).astype(np.float32)
+        targets = (rng.random((6, 40)) < 0.15).astype(np.float32)
+        # weight rows like the pretrain contract: 1 iff any positive
+        w = np.repeat(targets.any(-1, keepdims=True), 40, 1).astype(np.float32)
+        m = global_ranking_metrics(jnp.asarray(logits), jnp.asarray(targets),
+                                   jnp.asarray(w))
+        want = _brute_force_auroc(logits.ravel(),
+                                  (targets > 0).ravel() & (w > 0).ravel(),
+                                  (w > 0).ravel())
+        np.testing.assert_allclose(float(m["global_auroc"]), want, atol=1e-5)
+
+
+def test_global_auroc_perfect_and_inverted():
+    from proteinbert_tpu.train.loss import global_ranking_metrics
+
+    targets = np.zeros((2, 8), np.float32)
+    targets[:, :2] = 1.0
+    w = np.ones((2, 8), np.float32)
+    perfect = jnp.asarray(np.where(targets > 0, 5.0, -5.0)
+                          + np.random.default_rng(0).normal(size=(2, 8)) * .1)
+    m = global_ranking_metrics(perfect, jnp.asarray(targets), jnp.asarray(w))
+    assert float(m["global_auroc"]) == pytest.approx(1.0)
+    assert float(m["global_p_at_k"]) == pytest.approx(2 / 8)  # k=8 here
+    m = global_ranking_metrics(-perfect, jnp.asarray(targets), jnp.asarray(w))
+    assert float(m["global_auroc"]) == pytest.approx(0.0)
+
+
+def test_global_auroc_degenerate_cases():
+    from proteinbert_tpu.train.loss import global_ranking_metrics
+
+    logits = jnp.ones((2, 8))
+    # no positives at all / everything weighted out → neutral 0.5
+    m = global_ranking_metrics(logits, jnp.zeros((2, 8)), jnp.ones((2, 8)))
+    assert float(m["global_auroc"]) == pytest.approx(0.5)
+    m = global_ranking_metrics(logits, jnp.ones((2, 8)), jnp.zeros((2, 8)))
+    assert float(m["global_auroc"]) == pytest.approx(0.5)
+
+
+def test_eval_step_reports_ranking_metrics():
+    cfg = smoke_cfg()
+    state = create_train_state(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(0)
+    batch = {
+        "tokens": rng.integers(4, 26, size=(cfg.data.batch_size,
+                                            cfg.data.seq_len)).astype(np.int32),
+        "annotations": (rng.random((cfg.data.batch_size,
+                                    cfg.model.num_annotations)) < 0.1
+                        ).astype(np.float32),
+    }
+    from proteinbert_tpu.train.train_state import eval_step
+
+    m = eval_step(state, batch, jax.random.PRNGKey(1), cfg)
+    assert 0.0 <= float(m["global_auroc"]) <= 1.0
+    assert 0.0 <= float(m["global_p_at_k"]) <= 1.0
+
+
+def test_global_auroc_no_overflow_at_real_shapes():
+    """B=256 x A=8943: n_pos*n_neg ~ 4e9 overflows int32; the metric must
+    stay exact (float32 rank arithmetic) — checked against an int64 numpy
+    rank-based oracle."""
+    from proteinbert_tpu.train.loss import global_ranking_metrics
+
+    rng = np.random.default_rng(0)
+    B, A = 256, 8943
+    logits = rng.normal(size=(B, A)).astype(np.float32)
+    targets = (rng.random((B, A)) < 0.003).astype(np.float32)
+    w = np.repeat(targets.any(-1, keepdims=True), A, 1).astype(np.float32)
+
+    m = global_ranking_metrics(jnp.asarray(logits), jnp.asarray(targets),
+                               jnp.asarray(w))
+    got = float(m["global_auroc"])
+
+    flat = logits.ravel()
+    pos = (targets > 0).ravel() & (w > 0).ravel()
+    val = (w > 0).ravel()
+    order = np.argsort(np.where(val, flat, -np.inf))
+    ranks = np.empty(len(flat), np.int64)
+    ranks[order] = np.arange(len(flat), dtype=np.int64)
+    n_pos = int(pos.sum()); n_val = int(val.sum())
+    n_inv = len(flat) - n_val; n_neg = n_val - n_pos
+    u = int(ranks[pos].sum()) - n_pos * (n_pos - 1) // 2 - n_pos * n_inv
+    want = u / (n_pos * n_neg)
+    assert 0.0 <= got <= 1.0
+    np.testing.assert_allclose(got, want, atol=1e-4)

@@ -14,6 +14,7 @@ is one console with subcommands:
   finetune           supervised task head on a (pretrained) trunk
   convert-torch      reference torch checkpoint → orbax run dir (migration)
   export-weights     orbax run dir → flat NPZ of named arrays (portability)
+  evaluate           score a checkpoint on a dataset (loss/acc/AUROC/p@k)
   embed              trunk representations for sequences → HDF5/NPZ
   predict-go         GO-annotation probabilities from sequence alone
   predict-residues   fill '?'-masked residues, report per-position probs
@@ -489,6 +490,75 @@ def cmd_convert_torch(args) -> int:
     return 0
 
 
+def cmd_evaluate(args) -> int:
+    """Standalone held-out evaluation on any checkpoint + dataset —
+    shares train/trainer.evaluate_batches with the pretrain loop's
+    periodic eval, covers EVERY row (smaller tail batch, row-weighted
+    mean), and with --like-step reproduces the training run's eval_*
+    history keys exactly. Prints one JSON object (loss, local/global
+    terms, accuracy, GO ranking metrics)."""
+    import jax
+    import numpy as np
+
+    from proteinbert_tpu import inference
+    from proteinbert_tpu.configs import get_preset
+    from proteinbert_tpu.train.trainer import eval_base_key, evaluate_batches
+
+    cfg = apply_overrides(get_preset(args.preset), args.pretrained_set or [])
+
+    if args.data:
+        from proteinbert_tpu.data.dataset import HDF5PretrainingDataset
+
+        ds = HDF5PretrainingDataset(args.data, cfg.data.seq_len)
+        n_ann = ds.num_annotations
+        if n_ann != cfg.model.num_annotations:
+            explicit = any("num_annotations" in ov
+                           for ov in (args.pretrained_set or []))
+            if explicit:
+                raise SystemExit(
+                    f"{args.data} has {n_ann} annotation columns but "
+                    f"--pretrained-set says the checkpoint was trained "
+                    f"with {cfg.model.num_annotations} — these must match")
+            log(f"setting model.num_annotations={n_ann} from {args.data}")
+            cfg = cfg.replace(model=dataclasses.replace(
+                cfg.model, num_annotations=n_ann))
+    else:
+        from proteinbert_tpu.data.dataset import InMemoryPretrainingDataset
+        from proteinbert_tpu.data.synthetic import make_random_proteins
+
+        rng = np.random.default_rng(cfg.train.seed)
+        seqs, ann = make_random_proteins(
+            max(4 * cfg.data.batch_size, 128), rng,
+            num_annotations=cfg.model.num_annotations)
+        ds = InMemoryPretrainingDataset(seqs, ann, cfg.data.seq_len)
+        log("no --data given: evaluating on synthetic random proteins")
+
+    state, step = inference.load_state(args.pretrained, cfg)
+    log(f"loaded checkpoint from {args.pretrained} (step {step})")
+
+    bs = min(cfg.data.batch_size, len(ds))
+
+    def batches():  # ordered, exact coverage; the tail batch is smaller
+        for start in range(0, len(ds), bs):
+            yield ds.get_batch(np.arange(start, min(start + bs, len(ds))))
+
+    base_key = (eval_base_key(cfg, args.like_step)
+                if args.like_step is not None
+                else jax.random.PRNGKey(args.seed))
+    metrics, n, rows = evaluate_batches(
+        state, batches(), lambda b: b, cfg, base_key, prefix="",
+        max_batches=args.max_batches)
+    if n == 0:
+        raise SystemExit("dataset is empty")
+    result = {"step": step, "batches": n, "rows": rows,
+              **{k: round(v, 6) for k, v in metrics.items()}}
+    print(json.dumps(result))
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(result, f, indent=2)
+    return 0
+
+
 def cmd_export_weights(args) -> int:
     """Trained params → flat NPZ (export.py): slash-joined pytree paths,
     per-block entries, fp32 — readable by any numpy consumer with no
@@ -706,6 +776,29 @@ def build_parser() -> argparse.ArgumentParser:
     cv.add_argument("--set", action="append", metavar="PATH=VALUE",
                     help="config matching the torch model's geometry")
     cv.set_defaults(fn=cmd_convert_torch)
+
+    ev = sub.add_parser("evaluate",
+                        help="score a checkpoint on a dataset")
+    ev.add_argument("--pretrained", required=True,
+                    help="pretrain checkpoint dir")
+    ev.add_argument("--preset", default="tiny",
+                    choices=["tiny", "base", "long", "large"])
+    ev.add_argument("--pretrained-set", action="append",
+                    metavar="PATH=VALUE",
+                    help="config override the pretrain run was made with")
+    ev.add_argument("--data", type=existing_file,
+                    help="HDF5 dataset (default: synthetic)")
+    ev.add_argument("--max-batches", type=int, default=0,
+                    help="cap evaluated batches (0 = whole dataset)")
+    ev.add_argument("--seed", type=int, default=1,
+                    help="corruption key seed (fixed → reproducible)")
+    ev.add_argument("--like-step", type=int,
+                    help="derive the corruption key exactly as the "
+                         "training run's periodic eval at this step did "
+                         "(reproduces its eval_* history values)")
+    ev.add_argument("--output", type=creatable_path,
+                    help="also write the JSON result here")
+    ev.set_defaults(fn=cmd_evaluate)
 
     ex = sub.add_parser("export-weights",
                         help="trained params → flat NPZ of named arrays")

@@ -42,8 +42,8 @@ from proteinbert_tpu.models import proteinbert
 MASK_CHAR = "?"  # maps to <unk>: the "residue unknown, predict it" input
 
 
-def load_trunk(checkpoint_dir: str, cfg: PretrainConfig):
-    """Restore pretrained params (and step) from a pretrain run directory.
+def load_state(checkpoint_dir: str, cfg: PretrainConfig):
+    """Restore the full TrainState (and step) from a pretrain run dir.
 
     `cfg` must describe the pretrain run (preset + overrides) so the
     restore template matches the saved pytree — same contract as the
@@ -59,7 +59,14 @@ def load_trunk(checkpoint_dir: str, cfg: PretrainConfig):
         ck.close()
     if state is None:
         raise FileNotFoundError(f"no checkpoint found in {checkpoint_dir}")
-    return state.params, int(state.step)
+    return state, int(state.step)
+
+
+def load_trunk(checkpoint_dir: str, cfg: PretrainConfig):
+    """Restore pretrained params (and step) — load_state for callers that
+    only need the model weights."""
+    state, step = load_state(checkpoint_dir, cfg)
+    return state.params, step
 
 
 @partial(jax.jit, static_argnames=("cfg", "per_residue"))

@@ -182,7 +182,9 @@ def pretrain(
             and (step + 1) % cfg.train.eval_every == 0
         ):
             t_eval = time.perf_counter()
-            em = _evaluate(state, eval_batches(), put, cfg, step)
+            # Key the eval by the 1-based step recorded in history, so
+            # `evaluate --like-step <history step>` reproduces it.
+            em = _evaluate(state, eval_batches(), put, cfg, step + 1)
             timer.discount(time.perf_counter() - t_eval)
             history.append({"step": step + 1, **em})
             logger.info(
@@ -209,18 +211,45 @@ def pretrain(
             "preempted": preempted}
 
 
+def eval_base_key(cfg: PretrainConfig, step: int) -> jax.Array:
+    """The corruption base key the periodic eval uses at `step` — public
+    so the standalone `evaluate` CLI can reproduce a training run's
+    eval_* history exactly (--like-step)."""
+    return jax.random.fold_in(jax.random.PRNGKey(cfg.train.seed + 1), step)
+
+
+def evaluate_batches(
+    state, batches, put, cfg: PretrainConfig, base_key: jax.Array,
+    prefix: str = "eval_", max_batches: int = 0,
+):
+    """Row-weighted mean of eval_step metrics over `batches` (each batch
+    keyed by fold_in(base_key, batch_index) → reproducible). Returns
+    (metrics dict, n_batches, n_rows). Row weighting matters only when
+    batch sizes differ (the standalone CLI's tail batch); for the
+    uniform batches of the in-training eval it equals the plain mean."""
+    sums: Dict[str, float] = {}
+    n = 0
+    rows = 0
+    for batch in batches:
+        if max_batches and n >= max_batches:
+            break
+        b_rows = len(next(iter(batch.values())))
+        m = ts.eval_step(state, put(batch),
+                         jax.random.fold_in(base_key, n), cfg)
+        for k, v in m.items():
+            sums[k] = sums.get(k, 0.0) + float(v) * b_rows
+        n += 1
+        rows += b_rows
+    metrics = {f"{prefix}{k}": v / max(rows, 1) for k, v in sums.items()}
+    return metrics, n, rows
+
+
 def _evaluate(state, batches, put, cfg, step) -> Dict[str, float]:
     """Mean eval_step metrics over a held-out split; corruption key is
     derived from the step so evals are reproducible run-to-run."""
-    key = jax.random.fold_in(jax.random.PRNGKey(cfg.train.seed + 1), step)
-    sums: Dict[str, float] = {}
-    n = 0
-    for batch in batches:
-        m = ts.eval_step(state, put(batch), jax.random.fold_in(key, n), cfg)
-        for k, v in m.items():
-            sums[k] = sums.get(k, 0.0) + float(v)
-        n += 1
-    return {f"eval_{k}": v / max(n, 1) for k, v in sums.items()}
+    metrics, _, _ = evaluate_batches(
+        state, batches, put, cfg, eval_base_key(cfg, step))
+    return metrics
 
 
 def _make_batch_put(mesh: Optional[jax.sharding.Mesh]):

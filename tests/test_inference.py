@@ -197,3 +197,137 @@ def test_predict_go_cli_with_go_ids(trunk, tmp_path):
         assert name == "seq0"
         assert gid == go_ids[int(col)]
         assert 0.0 <= float(prob) <= 1.0
+
+
+def test_evaluate_cli(trunk, tmp_path, capsys):
+    """Standalone evaluate: JSON metrics incl. ranking, deterministic
+    given the same seed."""
+    import json
+
+    from proteinbert_tpu.cli.main import main
+
+    _, cfg, ckdir = trunk
+    overrides = [
+        f"--pretrained-set=model.{f}={getattr(cfg.model, f)}"
+        for f in ("local_dim", "global_dim", "key_dim", "num_heads",
+                  "num_blocks", "num_annotations")
+    ] + ["--pretrained-set=model.dtype=float32",
+         f"--pretrained-set=data.seq_len={cfg.data.seq_len}",
+         "--pretrained-set=data.batch_size=4"]
+    out = tmp_path / "eval.json"
+    assert main(["evaluate", "--pretrained", ckdir, "--preset", "tiny",
+                 *overrides, "--max-batches", "3",
+                 "--output", str(out)]) == 0
+    r1 = json.load(open(out))
+    assert r1["step"] == 3 and r1["batches"] == 3 and r1["rows"] == 12
+    for k in ("loss", "local_acc", "global_auroc", "global_p_at_k"):
+        assert k in r1 and np.isfinite(r1[k])
+    assert 0.0 <= r1["global_auroc"] <= 1.0
+    assert main(["evaluate", "--pretrained", ckdir, "--preset", "tiny",
+                 *overrides, "--max-batches", "3",
+                 "--output", str(out)]) == 0
+    r2 = json.load(open(out))
+    assert r1 == r2  # fixed seed → reproducible
+
+
+def _write_h5(path, n, num_annotations, rng):
+    import h5py
+
+    seqs = ["".join(rng.choice(list("ACDEFGHIKLMNPQRSTVWY"),
+                               size=int(rng.integers(5, 30))))
+            for _ in range(n)]
+    with h5py.File(path, "w") as f:
+        dt = h5py.string_dtype()
+        f.create_dataset("seqs", data=[s.encode() for s in seqs], dtype=dt)
+        f.create_dataset("uniprot_ids",
+                         data=[f"id{i}".encode() for i in range(n)], dtype=dt)
+        f.create_dataset("seq_lengths",
+                         data=np.array([len(s) for s in seqs], np.int32))
+        f.create_dataset("annotation_masks",
+                         data=rng.random((n, num_annotations)) < 0.2)
+        f.create_dataset(
+            "included_annotations",
+            data=[f"GO:{i:07d}".encode() for i in range(num_annotations)],
+            dtype=dt)
+
+
+def test_evaluate_cli_covers_tail_rows(trunk, tmp_path):
+    """10 rows at batch 4 → 3 batches, ALL 10 rows scored (the tail batch
+    is smaller, not dropped)."""
+    import json
+
+    from proteinbert_tpu.cli.main import main
+
+    _, cfg, ckdir = trunk
+    rng = np.random.default_rng(0)
+    data = tmp_path / "eval.h5"
+    _write_h5(str(data), 10, cfg.model.num_annotations, rng)
+    overrides = [
+        f"--pretrained-set=model.{f}={getattr(cfg.model, f)}"
+        for f in ("local_dim", "global_dim", "key_dim", "num_heads",
+                  "num_blocks", "num_annotations")
+    ] + ["--pretrained-set=model.dtype=float32",
+         f"--pretrained-set=data.seq_len={cfg.data.seq_len}",
+         "--pretrained-set=data.batch_size=4"]
+    out = tmp_path / "eval.json"
+    assert main(["evaluate", "--pretrained", ckdir, "--preset", "tiny",
+                 *overrides, "--data", str(data),
+                 "--output", str(out)]) == 0
+    r = json.load(open(out))
+    assert r["rows"] == 10 and r["batches"] == 3
+
+
+def test_evaluate_cli_rejects_annotation_mismatch(trunk, tmp_path):
+    from proteinbert_tpu.cli.main import main
+
+    _, cfg, ckdir = trunk
+    rng = np.random.default_rng(0)
+    data = tmp_path / "wrong.h5"
+    _write_h5(str(data), 8, cfg.model.num_annotations + 3, rng)
+    overrides = [
+        f"--pretrained-set=model.num_annotations={cfg.model.num_annotations}",
+        "--pretrained-set=model.dtype=float32"]
+    with pytest.raises(SystemExit, match="must match"):
+        main(["evaluate", "--pretrained", ckdir, "--preset", "tiny",
+              *overrides, "--data", str(data)])
+
+
+def test_evaluate_like_step_matches_training_eval(tmp_path):
+    """--like-step reproduces the pretrain loop's eval_* history values
+    on the same held-out batches."""
+    import dataclasses as dc
+
+    from proteinbert_tpu.configs import (
+        DataConfig as DC, ModelConfig as MC, OptimizerConfig as OC,
+        PretrainConfig as PC, TrainConfig as TC,
+    )
+    from proteinbert_tpu.data.dataset import InMemoryPretrainingDataset
+    from proteinbert_tpu.data.synthetic import make_random_proteins
+    from proteinbert_tpu.train import pretrain
+    from proteinbert_tpu.train.trainer import eval_base_key, evaluate_batches
+
+    cfg = PC(model=MC(local_dim=16, global_dim=32, key_dim=8, num_heads=4,
+                      num_blocks=2, num_annotations=32, dtype="float32"),
+             data=DC(seq_len=32, batch_size=8),
+             optimizer=OC(learning_rate=1e-3, warmup_steps=5),
+             train=TC(max_steps=10, log_every=10, eval_every=10))
+    rng = np.random.default_rng(0)
+    seqs, ann = make_random_proteins(48, rng, num_annotations=32, max_len=30)
+    train_ds = InMemoryPretrainingDataset(seqs, ann, 32)
+    ev_seqs, ev_ann = make_random_proteins(16, rng, num_annotations=32,
+                                           max_len=30)
+    ev_ds = InMemoryPretrainingDataset(ev_seqs, ev_ann, 32)
+
+    from proteinbert_tpu.data.dataset import make_pretrain_iterator
+
+    eval_batches = lambda: make_pretrain_iterator(  # noqa: E731
+        ev_ds, 8, shuffle=False, num_epochs=1)
+    out = pretrain(cfg, make_pretrain_iterator(train_ds, 8, seed=0),
+                   eval_batches=eval_batches)
+    hist_eval = [h for h in out["history"] if "eval_loss" in h][-1]
+
+    # Standalone: same state, same batches, --like-step key derivation.
+    m, _, _ = evaluate_batches(out["state"], eval_batches(), lambda b: b,
+                               cfg, eval_base_key(cfg, hist_eval["step"]))
+    np.testing.assert_allclose(m["eval_loss"], hist_eval["eval_loss"],
+                               rtol=1e-6)

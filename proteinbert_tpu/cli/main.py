@@ -493,10 +493,16 @@ def cmd_convert_torch(args) -> int:
 def cmd_evaluate(args) -> int:
     """Standalone held-out evaluation on any checkpoint + dataset —
     shares train/trainer.evaluate_batches with the pretrain loop's
-    periodic eval, covers EVERY row (smaller tail batch, row-weighted
-    mean), and with --like-step reproduces the training run's eval_*
-    history keys exactly. Prints one JSON object (loss, local/global
-    terms, accuracy, GO ranking metrics)."""
+    periodic eval and covers EVERY row (smaller tail batch, row-weighted
+    mean). Prints one JSON object (loss, local/global terms, accuracy,
+    GO ranking metrics).
+
+    --like-step derives the corruption keys the way the training run's
+    eval at that history step did. The values match exactly when the
+    batches match — holdout divisible by the eval batch size (training's
+    iterator drops tail batches; this command keeps them) and no
+    sequence over seq_len-2 (training re-crops long rows from a shared
+    RNG stream; this command head-truncates deterministically)."""
     import jax
     import numpy as np
 
@@ -533,6 +539,9 @@ def cmd_evaluate(args) -> int:
         ds = InMemoryPretrainingDataset(seqs, ann, cfg.data.seq_len)
         log("no --data given: evaluating on synthetic random proteins")
 
+    if len(ds) == 0:
+        raise SystemExit("dataset is empty")
+
     state, step = inference.load_state(args.pretrained, cfg)
     log(f"loaded checkpoint from {args.pretrained} (step {step})")
 
@@ -548,10 +557,11 @@ def cmd_evaluate(args) -> int:
     metrics, n, rows = evaluate_batches(
         state, batches(), lambda b: b, cfg, base_key, prefix="",
         max_batches=args.max_batches)
-    if n == 0:
-        raise SystemExit("dataset is empty")
+    # Valid JSON even for degenerate inputs: non-finite → null (same
+    # sanitation as the pretrain --metrics-jsonl path).
     result = {"step": step, "batches": n, "rows": rows,
-              **{k: round(v, 6) for k, v in metrics.items()}}
+              **{k: (round(v, 6) if math.isfinite(v) else None)
+                 for k, v in metrics.items()}}
     print(json.dumps(result))
     if args.output:
         with open(args.output, "w") as f:
@@ -583,21 +593,36 @@ def cmd_embed(args) -> int:
 
     params, cfg = _load_inference_trunk(args)
     ids, seqs = _read_named_seqs(args)
-    out = inference.embed(params, cfg, seqs, batch_size=args.batch_size,
-                          per_residue=args.per_residue)
-    log(f"embedded {len(seqs)} sequences: global {out['global'].shape}, "
-        f"local_mean {out['local_mean'].shape}")
     if args.output.endswith(".npz"):
+        # NPZ cannot be appended to — in-memory path (fine for small N).
+        out = inference.embed(params, cfg, seqs, batch_size=args.batch_size,
+                              per_residue=args.per_residue)
         np.savez(args.output, ids=np.array(ids), **out)
     else:
+        # HDF5 streams batch-by-batch: host memory stays O(batch) no
+        # matter how many sequences the FASTA holds.
         import h5py
 
         with h5py.File(args.output, "w") as h5f:
             h5f.create_dataset("ids", data=[i.encode() for i in ids],
                                dtype=h5py.string_dtype())
-            for k, v in out.items():
-                h5f.create_dataset(k, data=v)
-    log(f"wrote {args.output}")
+            dsets = {}
+            n = 0
+            for out in inference.embed_batches(
+                params, cfg, seqs, batch_size=args.batch_size,
+                per_residue=args.per_residue,
+            ):
+                rows = len(next(iter(out.values())))
+                for k, v in out.items():
+                    if k not in dsets:
+                        dsets[k] = h5f.create_dataset(
+                            k, shape=(0,) + v.shape[1:],
+                            maxshape=(None,) + v.shape[1:], dtype=v.dtype,
+                            chunks=(max(args.batch_size, 1),) + v.shape[1:])
+                    dsets[k].resize(n + rows, axis=0)
+                    dsets[k][n : n + rows] = v
+                n += rows
+    log(f"embedded {len(seqs)} sequences → {args.output}")
     return 0
 
 
@@ -793,9 +818,10 @@ def build_parser() -> argparse.ArgumentParser:
     ev.add_argument("--seed", type=int, default=1,
                     help="corruption key seed (fixed → reproducible)")
     ev.add_argument("--like-step", type=int,
-                    help="derive the corruption key exactly as the "
-                         "training run's periodic eval at this step did "
-                         "(reproduces its eval_* history values)")
+                    help="derive corruption keys as the training run's "
+                         "eval at this history step did (matches its "
+                         "eval_* values when the holdout divides the "
+                         "batch size and no row exceeds the crop window)")
     ev.add_argument("--output", type=creatable_path,
                     help="also write the JSON result here")
     ev.set_defaults(fn=cmd_evaluate)

@@ -144,6 +144,38 @@ def _batched(
     return outs
 
 
+def embed_batches(
+    params, cfg: PretrainConfig, seqs: Sequence[str],
+    annotations: Optional[np.ndarray] = None, batch_size: int = 32,
+    per_residue: bool = False,
+):
+    """Yield per-batch representation dicts — the streaming form of
+    `embed` (host memory stays O(batch), so million-sequence FASTA runs
+    can write each batch straight to disk; the embed CLI does exactly
+    that for HDF5 output).
+
+    Each yielded dict holds float32 "global" (b, G) and "local_mean"
+    (b, C) — plus "local" (b, seq_len, C) and int32 "tokens"
+    (b, seq_len) with `per_residue=True` — where b ≤ batch_size is the
+    batch's true row count.
+    """
+    n = len(seqs)
+    if n == 0:
+        raise ValueError("no sequences given")
+    for start in range(0, n, batch_size):
+        # Tokenize per chunk — this is what keeps host memory O(batch).
+        chunk_tokens = _tokenize_masked(seqs[start : start + batch_size],
+                                        cfg.data.seq_len)
+        chunk_ann = (annotations[start : start + batch_size]
+                     if annotations is not None else None)
+        out = _batched(
+            params, cfg, chunk_tokens, chunk_ann, batch_size,
+            partial(_encode_batch, per_residue=per_residue))[0]
+        if per_residue:
+            out["tokens"] = chunk_tokens
+        yield out
+
+
 def embed(
     params, cfg: PretrainConfig, seqs: Sequence[str],
     annotations: Optional[np.ndarray] = None, batch_size: int = 32,
@@ -154,14 +186,11 @@ def embed(
     Returns {"global": (N, G), "local_mean": (N, C)} float32 — and, with
     `per_residue=True`, "local": (N, seq_len, C) plus "tokens":
     (N, seq_len) int32 so callers can mask pad positions themselves.
+    Holds all N rows in memory; for large N use `embed_batches`.
     """
-    tokens = _tokenize_masked(seqs, cfg.data.seq_len)
-    outs = _batched(params, cfg, tokens, annotations, batch_size,
-                    partial(_encode_batch, per_residue=per_residue))
-    result = {k: np.concatenate([o[k] for o in outs]) for k in outs[0]}
-    if per_residue:
-        result["tokens"] = tokens
-    return result
+    outs = list(embed_batches(params, cfg, seqs, annotations, batch_size,
+                              per_residue))
+    return {k: np.concatenate([o[k] for o in outs]) for k in outs[0]}
 
 
 def predict_go(

@@ -227,12 +227,16 @@ def evaluate_batches(
     (metrics dict, n_batches, n_rows). Row weighting matters only when
     batch sizes differ (the standalone CLI's tail batch); for the
     uniform batches of the in-training eval it equals the plain mean."""
+    if max_batches:
+        # Cap BEFORE pulling: the for-loop must not fetch (and discard)
+        # one extra batch's worth of HDF5 reads + tokenization.
+        import itertools
+
+        batches = itertools.islice(batches, max_batches)
     sums: Dict[str, float] = {}
     n = 0
     rows = 0
     for batch in batches:
-        if max_batches and n >= max_batches:
-            break
         b_rows = len(next(iter(batch.values())))
         m = ts.eval_step(state, put(batch),
                          jax.random.fold_in(base_key, n), cfg)

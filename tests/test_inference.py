@@ -331,3 +331,65 @@ def test_evaluate_like_step_matches_training_eval(tmp_path):
                                cfg, eval_base_key(cfg, hist_eval["step"]))
     np.testing.assert_allclose(m["eval_loss"], hist_eval["eval_loss"],
                                rtol=1e-6)
+
+
+def test_embed_batches_streaming_matches_embed(trunk):
+    """The streaming generator concatenates to exactly embed()'s output."""
+    params, cfg, _ = trunk
+    whole = inference.embed(params, cfg, SEQS, batch_size=2,
+                            per_residue=True)
+    parts = list(inference.embed_batches(params, cfg, SEQS, batch_size=2,
+                                         per_residue=True))
+    assert [len(p["global"]) for p in parts] == [2, 1]
+    for k in whole:
+        np.testing.assert_array_equal(
+            whole[k], np.concatenate([p[k] for p in parts]))
+
+
+def test_evaluate_cli_empty_dataset(trunk, tmp_path):
+    from proteinbert_tpu.cli.main import main
+
+    _, cfg, ckdir = trunk
+    rng = np.random.default_rng(0)
+    data = tmp_path / "empty.h5"
+    _write_h5(str(data), 0, cfg.model.num_annotations, rng)
+    overrides = [
+        f"--pretrained-set=model.{f}={getattr(cfg.model, f)}"
+        for f in ("local_dim", "global_dim", "key_dim", "num_heads",
+                  "num_blocks", "num_annotations")
+    ] + ["--pretrained-set=model.dtype=float32"]
+    with pytest.raises(SystemExit, match="dataset is empty"):
+        main(["evaluate", "--pretrained", ckdir, "--preset", "tiny",
+              *overrides, "--data", str(data)])
+
+
+def test_evaluate_batches_max_batches_does_not_overfetch():
+    """The cap must prevent fetching batch N+1, not fetch-and-discard."""
+    import jax as _jax
+
+    from proteinbert_tpu.configs import (
+        DataConfig as DC, ModelConfig as MC, OptimizerConfig as OC,
+        PretrainConfig as PC, TrainConfig as TC,
+    )
+    from proteinbert_tpu.train import create_train_state
+    from proteinbert_tpu.train.trainer import evaluate_batches
+
+    cfg = PC(model=MC(local_dim=16, global_dim=32, key_dim=8, num_heads=4,
+                      num_blocks=2, num_annotations=32, dtype="float32"),
+             data=DC(seq_len=32, batch_size=4),
+             optimizer=OC(warmup_steps=5), train=TC())
+    state = create_train_state(_jax.random.PRNGKey(0), cfg)
+    fetched = []
+
+    def batches():
+        rng = np.random.default_rng(0)
+        for i in range(10):
+            fetched.append(i)
+            yield {"tokens": rng.integers(4, 26, (4, 32)).astype(np.int32),
+                   "annotations": (rng.random((4, 32)) < 0.2
+                                   ).astype(np.float32)}
+
+    _, n, rows = evaluate_batches(state, batches(), lambda b: b, cfg,
+                                  _jax.random.PRNGKey(0), max_batches=2)
+    assert n == 2 and rows == 8
+    assert fetched == [0, 1]

@@ -14,6 +14,7 @@ is one console with subcommands:
   finetune           supervised task head on a (pretrained) trunk
   convert-torch      reference torch checkpoint → orbax run dir (migration)
   export-weights     orbax run dir → flat NPZ of named arrays (portability)
+  import-weights     flat NPZ → orbax run dir (the export round trip)
   evaluate           score a checkpoint on a dataset (loss/acc/AUROC/p@k)
   embed              trunk representations for sequences → HDF5/NPZ
   predict-go         GO-annotation probabilities from sequence alone
@@ -463,6 +464,22 @@ def _load_inference_trunk(args):
     return params, cfg
 
 
+def _write_run_dir(cfg, params, step: int, output: str) -> None:
+    """Seed an orbax run directory from imported params (shared by
+    convert-torch and import-weights): fresh TrainState carrying the
+    given params and iteration counter, saved synchronously."""
+    import jax
+
+    from proteinbert_tpu.train import Checkpointer, create_train_state
+
+    state = create_train_state(jax.random.PRNGKey(cfg.train.seed), cfg)
+    state = state.replace(
+        params=params, step=jax.numpy.asarray(step, jax.numpy.int32))
+    ck = Checkpointer(output, async_save=False)
+    ck.save(step, state, {"batches_consumed": step})
+    ck.close()
+
+
 def cmd_convert_torch(args) -> int:
     """Reference torch checkpoint → an orbax run directory this
     framework's --pretrained / resume flags consume (interop.py). The
@@ -473,19 +490,13 @@ def cmd_convert_torch(args) -> int:
 
     from proteinbert_tpu import interop
     from proteinbert_tpu.configs import get_preset
-    from proteinbert_tpu.train import Checkpointer, create_train_state
 
     cfg = apply_overrides(get_preset(args.preset), args.set or [])
     params, ckpt_step = interop.load_reference_checkpoint(
         args.torch_ckpt, cfg.model,
         init_key=jax.random.PRNGKey(cfg.train.seed))
     step = args.step if args.step is not None else ckpt_step
-    state = create_train_state(jax.random.PRNGKey(cfg.train.seed), cfg)
-    state = state.replace(
-        params=params, step=jax.numpy.asarray(step, jax.numpy.int32))
-    ck = Checkpointer(args.output, async_save=False)
-    ck.save(step, state, {"batches_consumed": step})
-    ck.close()
+    _write_run_dir(cfg, params, step, args.output)
     log(f"converted {args.torch_ckpt} → {args.output} (step {step})")
     return 0
 
@@ -579,6 +590,38 @@ def cmd_export_weights(args) -> int:
     params, cfg = _load_inference_trunk(args)
     n = export.export_params(params, args.output)
     log(f"wrote {n} arrays → {args.output}")
+    return 0
+
+
+def cmd_import_weights(args) -> int:
+    """Flat NPZ (export-weights format, or produced by any numpy-speaking
+    tool) → an orbax run directory the --pretrained / resume flags
+    consume. Optimizer state starts fresh, like convert-torch."""
+    import jax
+
+    from proteinbert_tpu import export
+    from proteinbert_tpu.configs import get_preset
+    from proteinbert_tpu.train import create_train_state
+
+    cfg = apply_overrides(get_preset(args.preset), args.set or [])
+    try:
+        params = export.import_params(args.weights,
+                                      scan_blocks=cfg.model.scan_blocks)
+    except ValueError as e:
+        # Inconsistent block subtrees / ragged shapes / non-integer block
+        # keys all surface as ValueError from the tree rebuild.
+        raise SystemExit(
+            f"{args.weights} is not a well-formed export-weights NPZ: {e}")
+    template = create_train_state(jax.random.PRNGKey(cfg.train.seed), cfg)
+    want = jax.tree.map(lambda a: (a.shape, str(a.dtype)), template.params)
+    got = jax.tree.map(lambda a: (a.shape, str(a.dtype)), params)
+    if want != got:
+        raise SystemExit(
+            f"{args.weights} does not match the configured model geometry "
+            "(run with the same --preset/--set the weights were trained "
+            "with)")
+    _write_run_dir(cfg, params, args.step, args.output)
+    log(f"imported {args.weights} → {args.output} (step {args.step})")
     return 0
 
 
@@ -837,6 +880,20 @@ def build_parser() -> argparse.ArgumentParser:
                     help="config override the pretrain run was made with")
     ex.add_argument("--output", type=creatable_path, required=True)
     ex.set_defaults(fn=cmd_export_weights)
+
+    im = sub.add_parser("import-weights",
+                        help="flat NPZ → orbax run dir")
+    im.add_argument("--weights", type=existing_file, required=True,
+                    help="NPZ in the export-weights format")
+    im.add_argument("--output", type=creatable_path, required=True,
+                    help="orbax run dir to create")
+    im.add_argument("--preset", default="tiny",
+                    choices=["tiny", "base", "long", "large"])
+    im.add_argument("--step", type=int, default=0,
+                    help="iteration counter to record")
+    im.add_argument("--set", action="append", metavar="PATH=VALUE",
+                    help="config matching the weights' geometry")
+    im.set_defaults(fn=cmd_import_weights)
 
     em = sub.add_parser("embed", help="trunk representations → HDF5/NPZ")
     add_infer_args(em, output_required=True)

@@ -5,6 +5,7 @@ import dataclasses
 
 import jax
 import numpy as np
+import pytest
 
 from proteinbert_tpu import export
 from proteinbert_tpu.configs import ModelConfig
@@ -96,3 +97,64 @@ def test_export_weights_cli(tmp_path):
                  "--preset", "tiny", *overrides, "--output", out]) == 0
     restored = export.import_params(out)
     _assert_tree_equal(state.params, restored)
+
+
+def test_import_weights_cli_roundtrip(tmp_path):
+    """export-weights → import-weights → the new run dir serves embed."""
+    from proteinbert_tpu.cli.main import main
+    from proteinbert_tpu.configs import (
+        DataConfig, OptimizerConfig, PretrainConfig, TrainConfig,
+    )
+    from proteinbert_tpu.train import Checkpointer, create_train_state
+
+    cfg = PretrainConfig(model=CFG, data=DataConfig(seq_len=48, batch_size=4),
+                         optimizer=OptimizerConfig(warmup_steps=5),
+                         train=TrainConfig(seed=0))
+    state = create_train_state(jax.random.PRNGKey(0), cfg)
+    ck = Checkpointer(str(tmp_path / "ck"), async_save=False)
+    ck.save(0, state, None)
+    ck.close()
+    npz = str(tmp_path / "w.npz")
+    setargs = [
+        f"--set=model.{f}={getattr(CFG, f)}"
+        for f in ("local_dim", "global_dim", "key_dim", "num_heads",
+                  "num_blocks", "num_annotations")
+    ] + ["--set=model.dtype=float32", "--set=data.seq_len=48"]
+    psetargs = [a.replace("--set=", "--pretrained-set=") for a in setargs]
+    assert main(["export-weights", "--pretrained", str(tmp_path / "ck"),
+                 "--preset", "tiny", *psetargs, "--output", npz]) == 0
+    out_dir = str(tmp_path / "imported")
+    assert main(["import-weights", "--weights", npz, "--output", out_dir,
+                 "--preset", "tiny", "--step", "7", *setargs]) == 0
+    emb = str(tmp_path / "e.npz")
+    assert main(["embed", "--pretrained", out_dir, "--preset", "tiny",
+                 *psetargs, "--output", emb, "MKTAYIAKQR"]) == 0
+    assert np.load(emb)["global"].shape == (1, CFG.global_dim)
+
+
+def test_import_weights_cli_rejects_geometry_mismatch(tmp_path, key):
+    from proteinbert_tpu.cli.main import main
+
+    params = proteinbert.init(key, CFG)
+    npz = str(tmp_path / "w.npz")
+    export.export_params(params, npz)
+    with pytest.raises(SystemExit, match="does not match"):
+        main(["import-weights", "--weights", npz,
+              "--output", str(tmp_path / "o"), "--preset", "tiny",
+              "--set=model.local_dim=64", "--set=model.dtype=float32"])
+
+
+def test_import_weights_cli_rejects_malformed_npz(tmp_path, key):
+    """Inconsistent block subtrees must produce the curated error, not a
+    raw jax.tree traceback."""
+    from proteinbert_tpu.cli.main import main
+
+    flat = export.flatten_params(proteinbert.init(key, CFG))
+    bad = {k: v for k, v in flat.items()
+           if not k.startswith("blocks/1/attention")}
+    npz = str(tmp_path / "bad.npz")
+    np.savez(npz, **bad)
+    with pytest.raises(SystemExit, match="not a well-formed"):
+        main(["import-weights", "--weights", npz,
+              "--output", str(tmp_path / "o"), "--preset", "tiny",
+              "--set=model.dtype=float32"])

@@ -264,6 +264,15 @@ def cmd_pretrain(args) -> int:
     ck = Checkpointer(cfg.checkpoint.directory,
                       max_to_keep=cfg.checkpoint.max_to_keep,
                       async_save=cfg.checkpoint.async_save)
+    if jax.process_index() == 0:
+        # Drop the resolved config beside the checkpoints so downstream
+        # commands (--pretrained) reconstruct the exact run config
+        # without repeated --pretrained-set flags.
+        from proteinbert_tpu.configs import save_config
+
+        os.makedirs(cfg.checkpoint.directory, exist_ok=True)
+        save_config(cfg, os.path.join(cfg.checkpoint.directory,
+                                      "config.json"))
     log_fn = None
     mf = None
     # Only host 0 writes (every process would append duplicate, possibly
@@ -341,15 +350,15 @@ def cmd_finetune(args) -> int:
 
     trunk = None
     if args.pretrained:
-        # Rebuild the pretrain-time state template: only model.* overrides
-        # shape the trunk params (optimizer/train overrides meant for the
-        # FINE-TUNE run must not leak in — they would change the template's
-        # opt_state structure and break the orbax restore). If the
-        # pretrain run itself used non-default optimizer/data settings,
-        # repeat them via --pretrained-set.
-        pre_cfg = get_preset(args.preset)
-        pre_cfg = apply_overrides(
-            pre_cfg,
+        # Rebuild the pretrain-time state template — from the run dir's
+        # config.json when present, else the preset. Only model.* of the
+        # fine-tune --set overrides leak in (optimizer/train overrides
+        # meant for the FINE-TUNE run would change the template's
+        # opt_state structure and break the orbax restore); anything the
+        # pretrain run itself customized beyond config.json goes through
+        # --pretrained-set.
+        pre_cfg = _pretrain_run_config(
+            args.pretrained, args.preset,
             [ov for ov in (args.set or []) if ov.startswith("model.")]
             + (args.pretrained_set or []))
         template = create_train_state(
@@ -362,6 +371,9 @@ def cmd_finetune(args) -> int:
         trunk = state.params
         log(f"loaded pretrained trunk from {args.pretrained} "
             f"(step {int(state.step)})")
+        # The fine-tune model geometry must BE the trunk's geometry —
+        # pre_cfg carries it (config.json / overrides), the preset may not.
+        cfg = cfg.replace(model=pre_cfg.model)
 
     rng = np.random.default_rng(cfg.train.seed)
     if args.data:
@@ -451,14 +463,33 @@ def _read_named_seqs(args) -> tuple:
     raise SystemExit("provide --fasta, --seqs-file, or positional sequences")
 
 
-def _load_inference_trunk(args):
-    """(params, cfg) for the inference commands: rebuild the pretrain-run
-    config (--preset + --pretrained-set, same contract as finetune's
-    trunk restore) and load the latest checkpoint."""
-    from proteinbert_tpu import inference
-    from proteinbert_tpu.configs import get_preset
+def _pretrain_run_config(pretrained: str, preset: str, overrides):
+    """The config describing a pretrain run dir: its saved config.json
+    when present (every run dir this framework writes carries one), else
+    the named preset; --pretrained-set overrides apply on top either way."""
+    from proteinbert_tpu.configs import get_preset, load_config
 
-    cfg = apply_overrides(get_preset(args.preset), args.pretrained_set or [])
+    path = os.path.join(pretrained, "config.json")
+    if os.path.isfile(path):
+        try:
+            cfg = load_config(path)
+        except (ValueError, TypeError, OSError) as e:
+            raise SystemExit(
+                f"corrupt config.json in {pretrained} ({e}); delete it and "
+                "pass --preset/--pretrained-set describing the run instead")
+    else:
+        cfg = get_preset(preset)
+    return apply_overrides(cfg, overrides or [])
+
+
+def _load_inference_trunk(args):
+    """(params, cfg) for the inference commands: recover the pretrain-run
+    config (config.json, or --preset + --pretrained-set) and load the
+    latest checkpoint."""
+    from proteinbert_tpu import inference
+
+    cfg = _pretrain_run_config(args.pretrained, args.preset,
+                               args.pretrained_set)
     params, step = inference.load_trunk(args.pretrained, cfg)
     log(f"loaded trunk from {args.pretrained} (step {step})")
     return params, cfg
@@ -478,6 +509,9 @@ def _write_run_dir(cfg, params, step: int, output: str) -> None:
     ck = Checkpointer(output, async_save=False)
     ck.save(step, state, {"batches_consumed": step})
     ck.close()
+    from proteinbert_tpu.configs import save_config
+
+    save_config(cfg, os.path.join(os.path.abspath(output), "config.json"))
 
 
 def cmd_convert_torch(args) -> int:
@@ -518,10 +552,10 @@ def cmd_evaluate(args) -> int:
     import numpy as np
 
     from proteinbert_tpu import inference
-    from proteinbert_tpu.configs import get_preset
     from proteinbert_tpu.train.trainer import eval_base_key, evaluate_batches
 
-    cfg = apply_overrides(get_preset(args.preset), args.pretrained_set or [])
+    cfg = _pretrain_run_config(args.pretrained, args.preset,
+                               args.pretrained_set)
 
     if args.data:
         from proteinbert_tpu.data.dataset import HDF5PretrainingDataset
@@ -529,13 +563,19 @@ def cmd_evaluate(args) -> int:
         ds = HDF5PretrainingDataset(args.data, cfg.data.seq_len)
         n_ann = ds.num_annotations
         if n_ann != cfg.model.num_annotations:
-            explicit = any("num_annotations" in ov
-                           for ov in (args.pretrained_set or []))
-            if explicit:
+            # A value from --pretrained-set OR the run dir's config.json
+            # states what the checkpoint was trained with — silently
+            # "adapting" to the dataset would just move the failure into
+            # an opaque orbax restore mismatch.
+            authoritative = any(
+                "num_annotations" in ov for ov in (args.pretrained_set or [])
+            ) or os.path.isfile(
+                os.path.join(args.pretrained, "config.json"))
+            if authoritative:
                 raise SystemExit(
-                    f"{args.data} has {n_ann} annotation columns but "
-                    f"--pretrained-set says the checkpoint was trained "
-                    f"with {cfg.model.num_annotations} — these must match")
+                    f"{args.data} has {n_ann} annotation columns but the "
+                    f"checkpoint was trained with "
+                    f"{cfg.model.num_annotations} — these must match")
             log(f"setting model.num_annotations={n_ann} from {args.data}")
             cfg = cfg.replace(model=dataclasses.replace(
                 cfg.model, num_annotations=n_ann))

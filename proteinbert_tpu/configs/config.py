@@ -264,3 +264,63 @@ def get_preset(name: str) -> PretrainConfig:
         return PRESETS[name]()
     except KeyError:
         raise ValueError(f"unknown preset {name!r}; have {sorted(PRESETS)}") from None
+
+
+def config_to_dict(cfg) -> dict:
+    """Frozen config tree → plain JSON-serializable dict (tuples become
+    lists; from_dict restores them)."""
+    return dataclasses.asdict(cfg)
+
+
+def _build(cls, data: dict):
+    kwargs = {}
+    for f in dataclasses.fields(cls):
+        if f.name not in data:
+            continue
+        v = data[f.name]
+        if isinstance(v, dict):
+            # Nested config: resolve the node class from the field's
+            # default (f.type is a string under PEP 563 annotations).
+            default = (f.default_factory() if f.default_factory
+                       is not dataclasses.MISSING else f.default)
+            kwargs[f.name] = _build(type(default), v)
+        elif isinstance(v, list):
+            kwargs[f.name] = tuple(v)  # configs must stay hashable
+        else:
+            kwargs[f.name] = v
+    return cls(**kwargs)
+
+
+def config_from_dict(data: dict, cls=None):
+    """Inverse of config_to_dict. `cls` defaults to PretrainConfig."""
+    return _build(cls or PretrainConfig, data)
+
+
+def save_config(cfg, path: str) -> None:
+    """Write the config as JSON (pretrain drops one into the run dir so
+    downstream commands need no repeated --pretrained-set flags).
+
+    Atomic (temp file + rename): a crash mid-write must not leave a
+    truncated config.json that poisons every later --pretrained consumer
+    of an otherwise-valid run dir."""
+    import json
+    import os
+    import tempfile
+
+    fd, tmp = tempfile.mkstemp(prefix=os.path.basename(path) + ".tmp",
+                               dir=os.path.dirname(os.path.abspath(path)))
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(config_to_dict(cfg), f, indent=2, sort_keys=True)
+        os.chmod(tmp, 0o644)  # mkstemp is 0600
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def load_config(path: str, cls=None):
+    import json
+
+    with open(path) as f:
+        return config_from_dict(json.load(f), cls)

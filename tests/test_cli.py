@@ -247,3 +247,87 @@ def test_bucketed_pretrain_on_h5_with_resume(etl_inputs, tmp_path):
     c = Checkpointer(str(ck), async_save=False)
     assert c.latest_step() == 4
     c.close()
+
+
+def test_config_json_roundtrip_all_presets():
+    from proteinbert_tpu.configs import config_from_dict, config_to_dict
+
+    for name in ("tiny", "base", "long", "large"):
+        cfg = get_preset(name)
+        assert config_from_dict(config_to_dict(cfg)) == cfg
+
+
+def test_pretrain_writes_config_json_and_inference_needs_no_overrides(tmp_path):
+    """The killer usability path: pretrain with custom geometry → every
+    downstream command reconstructs the run config from config.json with
+    NO --pretrained-set flags."""
+    import json
+
+    from proteinbert_tpu.cli.main import main
+    from proteinbert_tpu.configs import load_config
+
+    ck = str(tmp_path / "run")
+    overrides = ["--set", "model.local_dim=32", "--set", "model.global_dim=64",
+                 "--set", "model.key_dim=16", "--set", "model.num_blocks=2",
+                 "--set", "model.num_annotations=64",
+                 "--set", "model.dtype=float32", "--set", "data.seq_len=48",
+                 "--set", "data.batch_size=4"]
+    assert main(["pretrain", "--preset", "tiny", *overrides,
+                 "--max-steps", "3", "--checkpoint-dir", ck]) == 0
+    saved = load_config(str(tmp_path / "run" / "config.json"))
+    assert saved.model.local_dim == 32 and saved.data.seq_len == 48
+
+    emb = str(tmp_path / "e.npz")
+    assert main(["embed", "--pretrained", ck, "--output", emb,
+                 "MKTAYIAKQR"]) == 0
+    import numpy as np
+    assert np.load(emb)["global"].shape == (1, 64)
+
+    out = str(tmp_path / "ev.json")
+    assert main(["evaluate", "--pretrained", ck, "--max-batches", "2",
+                 "--output", out]) == 0
+    assert json.load(open(out))["step"] == 3
+
+    npz = str(tmp_path / "w.npz")
+    assert main(["export-weights", "--pretrained", ck,
+                 "--output", npz]) == 0
+
+    # finetune restores the trunk through config.json too
+    assert main(["finetune", "--preset", "tiny", "--pretrained", ck,
+                 "--task", "sequence_classification", "--num-outputs", "3",
+                 "--epochs", "1",
+                 "--set", "data.seq_len=48", "--set", "data.batch_size=4",
+                 "--checkpoint-dir", str(tmp_path / "ft")]) == 0
+
+
+def test_pretrained_set_overrides_config_json(tmp_path):
+    """Explicit --pretrained-set still wins over the saved config."""
+    from proteinbert_tpu.cli.main import _pretrain_run_config
+    from proteinbert_tpu.configs import save_config
+
+    cfg = get_preset("tiny")
+    (tmp_path / "run").mkdir()
+    save_config(cfg, str(tmp_path / "run" / "config.json"))
+    got = _pretrain_run_config(str(tmp_path / "run"), "base",
+                               ["data.seq_len=99"])
+    assert got.data.seq_len == 99
+    assert got.model.local_dim == cfg.model.local_dim  # from json, not preset
+
+
+def test_corrupt_config_json_gives_clear_error(tmp_path):
+    from proteinbert_tpu.cli.main import _pretrain_run_config
+
+    (tmp_path / "run").mkdir()
+    (tmp_path / "run" / "config.json").write_text('{"model": {"local_')
+    with pytest.raises(SystemExit, match="corrupt config.json"):
+        _pretrain_run_config(str(tmp_path / "run"), "tiny", [])
+
+
+def test_save_config_leaves_no_tmp_and_is_readable(tmp_path):
+    from proteinbert_tpu.configs import load_config, save_config
+
+    cfg = get_preset("long")  # exercises the bucket tuple
+    path = tmp_path / "config.json"
+    save_config(cfg, str(path))
+    assert load_config(str(path)) == cfg
+    assert [p.name for p in tmp_path.iterdir()] == ["config.json"]

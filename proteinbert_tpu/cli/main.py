@@ -16,6 +16,7 @@ is one console with subcommands:
   export-weights     orbax run dir → flat NPZ of named arrays (portability)
   import-weights     flat NPZ → orbax run dir (the export round trip)
   evaluate           score a checkpoint on a dataset (loss/acc/AUROC/p@k)
+  data-bench         host input-pipeline throughput probe (batches/s)
   embed              trunk representations for sequences → HDF5/NPZ
   predict-go         GO-annotation probabilities from sequence alone
   predict-residues   fill '?'-masked residues, report per-position probs
@@ -183,8 +184,7 @@ def cmd_pretrain(args) -> int:
     import numpy as np
 
     from proteinbert_tpu.data.dataset import (
-        HDF5PretrainingDataset, InMemoryPretrainingDataset,
-        make_pretrain_iterator,
+        HDF5PretrainingDataset, make_pretrain_iterator,
     )
     from proteinbert_tpu.parallel import (
         make_mesh, maybe_initialize_distributed,
@@ -206,12 +206,7 @@ def cmd_pretrain(args) -> int:
             cfg = cfg.replace(model=dataclasses.replace(
                 cfg.model, num_annotations=n_ann))
     else:
-        from proteinbert_tpu.data.synthetic import make_random_proteins
-        rng = np.random.default_rng(cfg.train.seed)
-        seqs, ann = make_random_proteins(
-            max(4 * cfg.data.batch_size, 256), rng,
-            num_annotations=cfg.model.num_annotations)
-        ds = InMemoryPretrainingDataset(seqs, ann, cfg.data.seq_len)
+        ds = _synthetic_dataset(cfg, n_min=256)
         log("no --data given: pretraining on synthetic random proteins")
 
     eval_batches = None
@@ -463,6 +458,21 @@ def _read_named_seqs(args) -> tuple:
     raise SystemExit("provide --fasta, --seqs-file, or positional sequences")
 
 
+def _synthetic_dataset(cfg, n_min: int):
+    """Synthetic random-protein fallback dataset shared by pretrain /
+    evaluate / data-bench when no --data is given."""
+    import numpy as np
+
+    from proteinbert_tpu.data.dataset import InMemoryPretrainingDataset
+    from proteinbert_tpu.data.synthetic import make_random_proteins
+
+    rng = np.random.default_rng(cfg.train.seed)
+    seqs, ann = make_random_proteins(
+        max(4 * cfg.data.batch_size, n_min), rng,
+        num_annotations=cfg.model.num_annotations)
+    return InMemoryPretrainingDataset(seqs, ann, cfg.data.seq_len)
+
+
 def _pretrain_run_config(pretrained: str, preset: str, overrides):
     """The config describing a pretrain run dir: its saved config.json
     when present (every run dir this framework writes carries one), else
@@ -580,14 +590,7 @@ def cmd_evaluate(args) -> int:
             cfg = cfg.replace(model=dataclasses.replace(
                 cfg.model, num_annotations=n_ann))
     else:
-        from proteinbert_tpu.data.dataset import InMemoryPretrainingDataset
-        from proteinbert_tpu.data.synthetic import make_random_proteins
-
-        rng = np.random.default_rng(cfg.train.seed)
-        seqs, ann = make_random_proteins(
-            max(4 * cfg.data.batch_size, 128), rng,
-            num_annotations=cfg.model.num_annotations)
-        ds = InMemoryPretrainingDataset(seqs, ann, cfg.data.seq_len)
+        ds = _synthetic_dataset(cfg, n_min=128)
         log("no --data given: evaluating on synthetic random proteins")
 
     if len(ds) == 0:
@@ -617,6 +620,88 @@ def cmd_evaluate(args) -> int:
     if args.output:
         with open(args.output, "w") as f:
             json.dump(result, f, indent=2)
+    return 0
+
+
+def cmd_data_bench(args) -> int:
+    """Measure the HOST side of the input pipeline in isolation — is the
+    chip going to starve? The reference's version of this probe never
+    varied what it claimed to sweep (reference utils.py:30-68, SURVEY
+    ledger #11); this one times the real iterator (tokenization, HDF5
+    block reads, shuffling) with and without the prefetch thread and
+    prints one JSON line per variant."""
+    import time
+
+    import numpy as np
+
+    from proteinbert_tpu.configs import get_preset
+
+    cfg = apply_overrides(get_preset(args.preset), args.set or [])
+
+    def make_ds():
+        # Fresh dataset per timed variant: sharing one would let the
+        # second variant ride the block cache the first just warmed, and
+        # the comparison would measure cache reuse instead of prefetch.
+        if args.data:
+            from proteinbert_tpu.data.dataset import HDF5PretrainingDataset
+
+            # Same construction as cmd_pretrain (incl. re-crop rng): the
+            # probe must time the pipeline training actually runs.
+            return HDF5PretrainingDataset(
+                args.data, cfg.data.seq_len,
+                crop_rng=np.random.default_rng(cfg.train.seed + 1))
+        return _synthetic_dataset(cfg, n_min=8 * cfg.data.batch_size)
+
+    if not args.data:
+        log("no --data given: probing on synthetic random proteins")
+
+    n = args.batches
+    variants = [("direct", 0)]
+    if cfg.data.prefetch_depth > 0:
+        variants.append(("prefetch", cfg.data.prefetch_depth))
+    else:
+        log("data.prefetch_depth=0: prefetch variant skipped")
+
+    def run(prefetch_depth):
+        ds = make_ds()
+        bs = min(cfg.data.batch_size, len(ds))
+        if cfg.data.buckets:  # the iterator the `long` preset trains with
+            from proteinbert_tpu.data.dataset import make_bucketed_iterator
+
+            it = make_bucketed_iterator(ds, bs, cfg.data.buckets,
+                                        seed=cfg.train.seed)
+        else:
+            from proteinbert_tpu.data.dataset import make_pretrain_iterator
+
+            it = make_pretrain_iterator(ds, bs, seed=cfg.train.seed)
+        if prefetch_depth:
+            from proteinbert_tpu.data.prefetch import prefetch
+
+            it = prefetch(it, prefetch_depth)
+        next(it)  # warm caches / start the thread
+        t0 = time.perf_counter()
+        got = 0
+        rows = 0
+        for _ in range(n):
+            try:
+                batch = next(it)
+            except StopIteration:
+                break
+            got += 1
+            rows += len(batch["tokens"])
+        return got, rows, time.perf_counter() - t0
+
+    for name, depth in variants:
+        got, rows, dt = run(depth)
+        if not got:
+            raise SystemExit("dataset too small for one timed batch")
+        print(json.dumps({
+            "variant": name,
+            "batches_per_sec": round(got / dt, 2),
+            "residues_per_sec": round(rows * cfg.data.seq_len / dt, 1),
+            "batch_ms": round(1000 * dt / got, 3),
+            "batches": got,
+        }))
     return 0
 
 
@@ -908,6 +993,16 @@ def build_parser() -> argparse.ArgumentParser:
     ev.add_argument("--output", type=creatable_path,
                     help="also write the JSON result here")
     ev.set_defaults(fn=cmd_evaluate)
+
+    dbench = sub.add_parser("data-bench",
+                            help="host input-pipeline throughput probe")
+    dbench.add_argument("--preset", default="base",
+                        choices=["tiny", "base", "long", "large"])
+    dbench.add_argument("--data", type=existing_file,
+                        help="HDF5 dataset (default: synthetic)")
+    dbench.add_argument("--batches", type=int, default=50)
+    dbench.add_argument("--set", action="append", metavar="PATH=VALUE")
+    dbench.set_defaults(fn=cmd_data_bench)
 
     ex = sub.add_parser("export-weights",
                         help="trained params → flat NPZ of named arrays")

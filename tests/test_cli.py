@@ -331,3 +331,21 @@ def test_save_config_leaves_no_tmp_and_is_readable(tmp_path):
     save_config(cfg, str(path))
     assert load_config(str(path)) == cfg
     assert [p.name for p in tmp_path.iterdir()] == ["config.json"]
+
+
+def test_data_bench_cli(tmp_path, capsys):
+    import json as _json
+
+    from proteinbert_tpu.cli.main import main
+
+    assert main(["data-bench", "--preset", "tiny", "--batches", "5",
+                 "--set", "model.num_annotations=64",
+                 "--set", "data.batch_size=4",
+                 "--set", "data.seq_len=48"]) == 0
+    lines = [ln for ln in capsys.readouterr().out.strip().split("\n")
+             if ln.startswith("{")]
+    assert len(lines) == 2
+    for ln in lines:
+        r = _json.loads(ln)
+        assert r["variant"] in ("direct", "prefetch")
+        assert r["batches_per_sec"] > 0 and r["batches"] == 5

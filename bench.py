@@ -23,10 +23,44 @@ remote execution, which silently under- or over-reports.
 
 import dataclasses
 import json
+import os
+import subprocess
 import sys
 import time
 
 import numpy as np
+
+
+def probe_tpu(timeout: int = 180):
+    """(tpu_ok, reason) — whether the TPU backend initializes, decided in
+    a SUBPROCESS.
+
+    The tunneled axon TPU plugin can hang indefinitely at PJRT client
+    creation when the tunnel is down (observed for hours at a time). If
+    this process touched jax.devices() directly in that state, the bench
+    would never emit its JSON line — so the first backend init happens in
+    a killable child, and on timeout/failure the parent forces the CPU
+    backend before ITS first jax use.
+    """
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, text=True, timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        return False, "backend init timed out (tunnel down?)"
+    if out.returncode != 0:
+        return False, f"backend init failed (rc {out.returncode})"
+    platform = out.stdout.strip()
+    return platform == "tpu", f"backend platform is {platform!r}"
+
+
+def force_cpu_backend() -> None:
+    import jax
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    jax.config.update("jax_platforms", "cpu")
 
 
 def time_step(cfg, batch_np, steps):
@@ -52,6 +86,12 @@ def time_step(cfg, batch_np, steps):
 
 
 def main():
+    on_tpu, reason = probe_tpu()
+    if not on_tpu:
+        print(f"not benchmarking on TPU — {reason}; forcing CPU",
+              file=sys.stderr)
+        force_cpu_backend()
+
     import jax
 
     from proteinbert_tpu.configs import (
@@ -60,10 +100,6 @@ def main():
     from proteinbert_tpu.train.metrics import (
         peak_flops_per_chip, train_flops,
     )
-
-    # Strictly TPU: on any other accelerator the MFU table has no peak
-    # entry and vs_baseline would be nonsense — run the CPU-sized config.
-    on_tpu = jax.devices()[0].platform == "tpu"
     seq_len = 512
     if on_tpu:
         base = ModelConfig(local_dim=512, global_dim=512, key_dim=64,

@@ -664,6 +664,8 @@ def cmd_data_bench(args) -> int:
 
     def run(prefetch_depth):
         ds = make_ds()
+        if len(ds) == 0:
+            raise SystemExit("dataset is empty")
         bs = min(cfg.data.batch_size, len(ds))
         if cfg.data.buckets:  # the iterator the `long` preset trains with
             from proteinbert_tpu.data.dataset import make_bucketed_iterator
@@ -681,24 +683,26 @@ def cmd_data_bench(args) -> int:
         next(it)  # warm caches / start the thread
         t0 = time.perf_counter()
         got = 0
-        rows = 0
+        positions = 0
         for _ in range(n):
             try:
                 batch = next(it)
             except StopIteration:
                 break
             got += 1
-            rows += len(batch["tokens"])
-        return got, rows, time.perf_counter() - t0
+            # tokens.size, not rows·seq_len: bucketed batches are sliced
+            # to their bucket width and must not be counted at full L.
+            positions += batch["tokens"].size
+        return got, positions, time.perf_counter() - t0
 
     for name, depth in variants:
-        got, rows, dt = run(depth)
+        got, positions, dt = run(depth)
         if not got:
             raise SystemExit("dataset too small for one timed batch")
         print(json.dumps({
             "variant": name,
             "batches_per_sec": round(got / dt, 2),
-            "residues_per_sec": round(rows * cfg.data.seq_len / dt, 1),
+            "residues_per_sec": round(positions / dt, 1),
             "batch_ms": round(1000 * dt / got, 3),
             "batches": got,
         }))

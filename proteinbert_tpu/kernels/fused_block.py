@@ -26,8 +26,16 @@ differentiates it — i.e. the kernel behaves like a rematerialised
 (jax.checkpoint) block, saving only (params, x, broadcast).
 
 VMEM budget: weights dominate at 2·K·C² + C² activation-dtype bytes
-(~10 MB at C=512 bf16), so the kernel is gated to C ≤ 512; larger
-configs (ProteinBERT-Large C=1024) use the XLA path automatically.
+(~10 MB at C=512 bf16). Up to C = 512 the whole weight set resides in
+VMEM and the grid is (B, L/TL). Beyond that (ProteinBERT-Large C=1024)
+a CHANNEL-TILED variant runs instead: the grid grows a third, fastest
+axis over output-channel tiles of width TC — each step loads only the
+(K, C, TC) conv weight slices and accumulates its (TL, TC) slice of
+    h = x + gelu(narrow) + gelu(wide) + broadcast
+into a persistent (TL, C) fp32 VMEM scratch (TPU grid steps run
+sequentially, so scratch carries across the c-axis); the final c step
+computes LN → dense (+GELU, residual) → LN on the full row. Shapes the
+tiled plan cannot fit either fall back to the XLA path automatically.
 """
 
 from __future__ import annotations
@@ -43,8 +51,10 @@ from jax.experimental.pallas import tpu as pltpu
 
 Params = Dict[str, jax.Array]
 
-# Largest feature dim whose weights fit the VMEM budget (see module doc).
+# Largest feature dim whose weights fit the VMEM budget whole (see
+# module doc); larger dims use the channel-tiled kernel.
 MAX_PALLAS_DIM = 512
+MAX_TILED_DIM = 2048  # upper bound for the channel-tiled variant
 _LANE = 128  # TPU lane width; C must be a multiple for clean tiling
 _VMEM_BUDGET = 13 * 1024 * 1024  # per-core VMEM we allow the kernel to plan for
 
@@ -148,6 +158,18 @@ def _layer_norm_f32(x32, scale, bias, eps=1e-5):
     return (x32 - mean) * lax.rsqrt(var + eps) * scale + bias
 
 
+def _finish_row(h32, s1_ref, b1_ref, dk_ref, db_ref, s2_ref, b2_ref, dtype):
+    """LN → dense(+GELU, residual) → LN tail shared by both kernel
+    variants (they must never diverge numerically)."""
+    x1 = _layer_norm_f32(h32, s1_ref[0], b1_ref[0]).astype(dtype)
+    d = lax.dot_general(
+        x1, dk_ref[:], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) + db_ref[0].astype(jnp.float32)
+    h2 = x1.astype(jnp.float32) + _gelu(d)
+    return _layer_norm_f32(h2, s2_ref[0], b2_ref[0]).astype(dtype)
+
+
 def _fused_kernel(
     x_ref, bcast_ref,
     nk_ref, nb_ref, wk_ref, wb_ref,
@@ -170,14 +192,98 @@ def _fused_kernel(
     # satisfies Mosaic's last-two-dims tiling rule (a (1, C) slice of a
     # (B, C) array does not, nor does a dynamic row-select).
     h = x_center + narrow + wide + bcast_ref[0, 0].astype(jnp.float32)[None, :]
-    x1 = _layer_norm_f32(h, s1_ref[0], b1_ref[0]).astype(dtype)
+    out_ref[0] = _finish_row(h, s1_ref, b1_ref, dk_ref, db_ref,
+                             s2_ref, b2_ref, dtype)
 
-    d = lax.dot_general(
-        x1, dk_ref[:], (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    ) + db_ref[0].astype(jnp.float32)
-    h2 = x1.astype(jnp.float32) + _gelu(d)
-    out_ref[0] = _layer_norm_f32(h2, s2_ref[0], b2_ref[0]).astype(dtype)
+
+def _fused_kernel_tiled(
+    x_ref, bcast_ref,
+    cw_ref, cb_ref,
+    s1_ref, b1_ref, dk_ref, db_ref, s2_ref, b2_ref,
+    out_ref,
+    h_scratch,
+    *, tile, halo, taps, narrow_dilation, wide_dilation, c_tiles,
+):
+    """Channel-tiled body: grid (B, L/tile, c_tiles, 2), phase fastest.
+
+    The two convs are stacked on a leading axis of `cw_ref`/`cb_ref` and
+    visited as grid phases so only ONE conv's (taps, C, TC) weight slice
+    is resident per step (the conv weights dominate VMEM at C=1024; see
+    _plan_tiled). Phase 0 seeds this c tile's columns of the fp32
+    scratch row with x + broadcast + gelu(narrow); phase 1 adds
+    gelu(wide); the final (c, phase) step finishes the row (LN → dense
+    residual → LN) and writes the output block.
+    """
+    j = pl.program_id(1)
+    c = pl.program_id(2)
+    phase = pl.program_id(3)
+    dtype = x_ref.dtype
+    window = x_ref[0, pl.ds(j * tile, tile + 2 * halo), :]
+
+    tc = cw_ref.shape[-1]
+    # window/bcast rows are materialized values here — slice their tile
+    # columns with dynamic_slice (pl.ds indexes refs, not values).
+    x_center_cols = lax.dynamic_slice_in_dim(
+        window[halo:halo + tile], c * tc, tc, axis=1)
+    bcast_cols = lax.dynamic_slice_in_dim(bcast_ref[0, 0], c * tc, tc, axis=0)
+
+    @pl.when(phase == 0)
+    def _narrow():
+        conv = _tap_matmuls(window, cw_ref[0], taps, narrow_dilation,
+                            halo, tile)
+        h_scratch[:, pl.ds(c * tc, tc)] = (
+            x_center_cols.astype(jnp.float32)
+            + bcast_cols.astype(jnp.float32)[None, :]
+            + _gelu(conv + cb_ref[0, 0].astype(jnp.float32))
+        )
+
+    @pl.when(phase == 1)
+    def _wide():
+        conv = _tap_matmuls(window, cw_ref[0], taps, wide_dilation,
+                            halo, tile)
+        h_scratch[:, pl.ds(c * tc, tc)] += _gelu(
+            conv + cb_ref[0, 0].astype(jnp.float32))
+
+    @pl.when((c == c_tiles - 1) & (phase == 1))
+    def _finish():
+        out_ref[0] = _finish_row(h_scratch[:, :], s1_ref, b1_ref,
+                                 dk_ref, db_ref, s2_ref, b2_ref, dtype)
+
+
+def _plan_tiled(C: int, seq_len: int, dtype,
+                narrow_taps: int = 9, wide_taps: int = 9,
+                wide_dilation: int = 5):
+    """(c_tile, l_tile) of the widest-channel plan that fits the VMEM
+    budget, or (0, 0).
+
+    The model counts what Mosaic actually keeps resident: blocks whose
+    index map varies over the grid are DOUBLE-buffered (conv weight/bias
+    slices vary with (phase, c); the input row, broadcast, and output
+    blocks vary with b/j), plus the fp32 scratch row and the finish
+    step's (tile, C) temporaries. The phase split exists exactly so the
+    double-buffered conv residency is one conv, not two. A narrower L
+    tile is tried before a narrower channel tile — it shrinks the
+    scratch/out/finish terms without adding weight refetches."""
+    if narrow_taps != wide_taps:
+        return 0, 0  # the stacked phase layout needs equal tap counts
+    itemsize = jnp.dtype(dtype).itemsize
+    halo = max((narrow_taps - 1) // 2, (wide_taps - 1) // 2 * wide_dilation)
+    for tc in (512, 256, 128):
+        if C % tc:
+            continue
+        for tile in (_pick_tile(seq_len), 128):
+            if seq_len % tile:
+                continue
+            conv_w = 2 * narrow_taps * C * tc * itemsize  # one conv, 2 bufs
+            dense = C * C * itemsize                      # whole, 1 buffer
+            row = 2 * (seq_len + 2 * halo) * C * itemsize  # varies with b
+            out = 2 * tile * C * itemsize                 # varies with (b, j)
+            scratch = tile * C * 4                        # fp32 h row
+            finish = tile * C * (4 + 4 + itemsize)        # d, h2 f32 + x1
+            if (conv_w + dense + row + out + scratch + finish
+                    <= _VMEM_BUDGET):
+                return tc, tile
+    return 0, 0
 
 
 def _pallas_forward(
@@ -204,7 +310,6 @@ def _pallas_forward(
         Lp = L + 2 * halo
 
     tile = _pick_tile(L)
-    grid = (B, L // tile)
 
     def vec(p):  # (C,) fp32 vector → (1, C) activation-dtype VMEM block
         return p.reshape(1, C)
@@ -219,37 +324,90 @@ def _pallas_forward(
         dn["kernel"].astype(dtype), vec(dn["bias"]),
         vec(ln2["scale"]), vec(ln2["bias"]),
     )
+    flops_conv = 2 * B * L * C * C * (narrow_taps + wide_taps + 1)
+    cost = pl.CostEstimate(
+        flops=flops_conv,
+        bytes_accessed=x.size * x.dtype.itemsize * 2,
+        transcendentals=3 * B * L * C,
+    )
 
-    row_spec = pl.BlockSpec((1, Lp, C), lambda b, j: (b, 0, 0),
+    if C <= MAX_PALLAS_DIM:
+        grid = (B, L // tile)
+
+        row_spec = pl.BlockSpec((1, Lp, C), lambda b, j: (b, 0, 0),
+                                memory_space=pltpu.VMEM)
+
+        def whole(a):
+            return pl.BlockSpec(a.shape, lambda b, j: (0,) * a.ndim,
+                                memory_space=pltpu.VMEM)
+
+        bcast_spec = pl.BlockSpec((1, 1, C), lambda b, j: (b, 0, 0),
+                                  memory_space=pltpu.VMEM)
+
+        kernel = functools.partial(
+            _fused_kernel, tile=tile, halo=halo,
+            narrow_taps=narrow_taps, wide_taps=wide_taps,
+            narrow_dilation=narrow_dilation, wide_dilation=wide_dilation,
+        )
+        return pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[row_spec, bcast_spec] + [whole(a) for a in inputs[2:]],
+            out_specs=pl.BlockSpec((1, tile, C), lambda b, j: (b, j, 0),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((B, L, C), dtype),
+            cost_estimate=cost,
+            interpret=interpret,
+        )(*inputs)
+
+    # Channel-tiled variant for C > MAX_PALLAS_DIM (module docstring).
+    tc, tile = _plan_tiled(C, L, dtype, narrow_taps, wide_taps,
+                           wide_dilation)
+    if tc == 0:  # callers gate via pallas_supported; belt and braces
+        raise ValueError(f"no VMEM plan for C={C}, L={L}")
+    c_tiles = C // tc
+    grid = (B, L // tile, c_tiles, 2)  # phase (narrow/wide) fastest
+
+    # Both convs stacked on a leading phase axis so each grid step loads
+    # ONE conv's weight slice (see _plan_tiled).
+    conv_w = jnp.stack([inputs[2], inputs[4]])          # (2, taps, C, C)
+    conv_b = jnp.stack([inputs[3], inputs[5]])          # (2, 1, C)
+
+    row_spec = pl.BlockSpec((1, Lp, C), lambda b, j, c, p: (b, 0, 0),
                             memory_space=pltpu.VMEM)
-
-    def whole(a):
-        return pl.BlockSpec(a.shape, lambda b, j: (0,) * a.ndim,
-                            memory_space=pltpu.VMEM)
-
-    bcast_spec = pl.BlockSpec((1, 1, C), lambda b, j: (b, 0, 0),
+    bcast_spec = pl.BlockSpec((1, 1, C), lambda b, j, c, p: (b, 0, 0),
                               memory_space=pltpu.VMEM)
 
+    def whole4(a):
+        return pl.BlockSpec(a.shape, lambda b, j, c, p: (0,) * a.ndim,
+                            memory_space=pltpu.VMEM)
+
+    conv_w_spec = pl.BlockSpec((1, narrow_taps, C, tc),
+                               lambda b, j, c, p: (p, 0, 0, c),
+                               memory_space=pltpu.VMEM)
+    conv_b_spec = pl.BlockSpec((1, 1, tc), lambda b, j, c, p: (p, 0, c),
+                               memory_space=pltpu.VMEM)
+
+    in_specs = [
+        row_spec, bcast_spec, conv_w_spec, conv_b_spec,
+        *[whole4(a) for a in inputs[6:]],
+    ]
     kernel = functools.partial(
-        _fused_kernel, tile=tile, halo=halo,
-        narrow_taps=narrow_taps, wide_taps=wide_taps,
+        _fused_kernel_tiled, tile=tile, halo=halo, taps=narrow_taps,
         narrow_dilation=narrow_dilation, wide_dilation=wide_dilation,
+        c_tiles=c_tiles,
     )
-    flops_conv = 2 * B * L * C * C * (narrow_taps + wide_taps + 1)
     return pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[row_spec, bcast_spec] + [whole(a) for a in inputs[2:]],
-        out_specs=pl.BlockSpec((1, tile, C), lambda b, j: (b, j, 0),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, tile, C), lambda b, j, c, p: (b, j, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((B, L, C), dtype),
-        cost_estimate=pl.CostEstimate(
-            flops=flops_conv,
-            bytes_accessed=x.size * x.dtype.itemsize * 2,
-            transcendentals=3 * B * L * C,
-        ),
+        scratch_shapes=[pltpu.VMEM((tile, C), jnp.float32)],
+        cost_estimate=cost,
         interpret=interpret,
-    )(*inputs)
+    )(*inputs[:2], conv_w, conv_b, *inputs[6:])
 
 
 def _pick_tile(L: int) -> int:
@@ -264,17 +422,21 @@ def pallas_supported(
     narrow_taps: int = 9, wide_taps: int = 9, wide_dilation: int = 5,
 ) -> bool:
     """Whether the fused kernel handles this shape+dtype within the VMEM
-    budget (else the model falls back to the XLA path). The dominant
-    residents per program are the conv/dense weights, the full padded
-    input row, and fp32 (tile, C) temporaries. Note `seq_len` is the
-    PER-SHARD length the kernel actually sees — under sequence
-    parallelism a long global L divides down to supportable shards."""
-    if local_dim % _LANE or local_dim > MAX_PALLAS_DIM or seq_len < 8:
+    budget (else the model falls back to the XLA path). Up to
+    MAX_PALLAS_DIM the whole weight set must fit; beyond it the
+    channel-tiled plan (_pick_c_tile) must find a tile width. Note
+    `seq_len` is the PER-SHARD length the kernel actually sees — under
+    sequence parallelism a long global L divides down to supportable
+    shards."""
+    if local_dim % _LANE or local_dim > MAX_TILED_DIM or seq_len < 8:
         return False
     itemsize = jnp.dtype(dtype).itemsize
     C = local_dim
     halo = max((narrow_taps - 1) // 2, (wide_taps - 1) // 2 * wide_dilation)
     tile = _pick_tile(seq_len)
+    if C > MAX_PALLAS_DIM:
+        return _plan_tiled(C, seq_len, dtype, narrow_taps, wide_taps,
+                           wide_dilation)[0] > 0
     weights = (narrow_taps + wide_taps + 1) * C * C * itemsize
     row = (seq_len + 2 * halo) * C * itemsize
     temps = 3 * tile * C * 4

@@ -97,7 +97,9 @@ def test_model_level_parity(key):
 def test_pallas_supported_gating():
     assert pallas_supported(128, 256)
     assert pallas_supported(512, 512)               # base config, bf16
-    assert not pallas_supported(1024, 512)          # Large config → XLA path
+    assert pallas_supported(1024, 512)              # Large → channel-tiled
+    assert not pallas_supported(1024, 512, "float32")  # fp32 tiled plan: no
+    assert not pallas_supported(4096, 512)          # beyond MAX_TILED_DIM
     assert not pallas_supported(96, 256)            # non-lane-aligned C
     assert not pallas_supported(512, 512, "float32")  # fp32 weights blow VMEM
     assert pallas_supported(128, 64, "float32")     # small fp32 is fine
@@ -132,3 +134,89 @@ def test_train_step_with_pallas(key):
     new_state, metrics = train_step(state, batch, cfg)
     assert np.isfinite(float(metrics["loss"]))
     assert int(new_state.step) == 1
+
+
+# ------------------------------------------- channel-tiled variant (C>512)
+
+def test_tiled_forward_parity_c1024(key):
+    """Large-config C=1024 runs the channel-tiled kernel (scratch
+    accumulation over the c grid axis). fp32 has no tiled VMEM plan, so
+    parity runs in bf16 — the config the Large preset actually trains —
+    with bf16-appropriate tolerances against the reference composition."""
+    params, x, bcast = _make_inputs(key, B=1, L=128, C=1024,
+                                    dtype=jnp.bfloat16)
+    assert pallas_supported(1024, 128)
+    got = fused_local_track(params, x, bcast, 1, 5, True).astype(jnp.float32)
+    want = local_track_reference(params, x, bcast, 1, 5).astype(jnp.float32)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=0.05, atol=0.05)
+
+
+def test_tiled_multi_l_tiles_and_batch(key):
+    """Multiple L tiles AND batch entries: the fp32 scratch row must be
+    fully overwritten per (b, l) step — stale columns from the previous
+    grid step would show up as cross-tile leakage."""
+    params, x, bcast = _make_inputs(key, B=2, L=256, C=1024,
+                                    dtype=jnp.bfloat16)
+    got = fused_local_track(params, x, bcast, 1, 5, True).astype(jnp.float32)
+    want = local_track_reference(params, x, bcast, 1, 5).astype(jnp.float32)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=0.05, atol=0.05)
+
+
+def test_tiled_gradient_parity(key):
+    params, x, bcast = _make_inputs(key, B=1, L=64, C=1024,
+                                    dtype=jnp.bfloat16)
+
+    def f_fused(p, xx, bb):
+        return (fused_local_track(p, xx, bb, 1, 5, True)
+                .astype(jnp.float32).sum())
+
+    def f_ref(p, xx, bb):
+        return (local_track_reference(p, xx, bb, 1, 5)
+                .astype(jnp.float32).sum())
+
+    g_fused = jax.grad(f_fused, argnums=(0, 1, 2))(params, x, bcast)
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(params, x, bcast)
+    for a, b in zip(jax.tree.leaves(g_fused), jax.tree.leaves(g_ref)):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            rtol=0.05, atol=0.1)
+
+
+def test_tiled_plan_details():
+    from proteinbert_tpu.kernels.fused_block import _plan_tiled
+
+    # Large preset, unsharded L=512: fits via the narrower L tile.
+    tc, tile = _plan_tiled(1024, 512, "bfloat16")
+    assert tc == 128 and tile == 128
+    # Unequal tap counts can't use the stacked phase layout → no plan.
+    assert _plan_tiled(1024, 512, "bfloat16", narrow_taps=9,
+                       wide_taps=5)[0] == 0
+
+
+def test_tiled_unequal_taps_falls_back_to_xla(key):
+    """pallas_supported must refuse the stacked layout when the convs
+    have different tap counts (the model then runs the XLA path)."""
+    assert not pallas_supported(1024, 128, narrow_taps=9, wide_taps=5)
+
+
+def test_tiled_prehaloed_parity(key):
+    """The seq-parallel pre-haloed variant also routes through the tiled
+    kernel at C=1024 (real halo rows, VALID output center)."""
+    from proteinbert_tpu.kernels import (
+        fused_local_track_valid, local_track_valid_reference, track_halo,
+    )
+
+    params, _, bcast = _make_inputs(key, B=1, L=64, C=1024,
+                                    dtype=jnp.bfloat16)
+    H = track_halo(params, 1, 5)
+    xh = jax.random.normal(jax.random.PRNGKey(3), (1, 64 + 2 * H, 1024),
+                           jnp.bfloat16)
+    got = fused_local_track_valid(params, xh, bcast, 1, 5, True
+                                  ).astype(jnp.float32)
+    want = local_track_valid_reference(params, xh, bcast, 1, 5
+                                       ).astype(jnp.float32)
+    assert got.shape == (1, 64, 1024)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=0.05, atol=0.05)

@@ -260,14 +260,9 @@ def cmd_pretrain(args) -> int:
                       max_to_keep=cfg.checkpoint.max_to_keep,
                       async_save=cfg.checkpoint.async_save)
     if jax.process_index() == 0:
-        # Drop the resolved config beside the checkpoints so downstream
-        # commands (--pretrained) reconstruct the exact run config
-        # without repeated --pretrained-set flags.
-        from proteinbert_tpu.configs import save_config
-
-        os.makedirs(cfg.checkpoint.directory, exist_ok=True)
-        save_config(cfg, os.path.join(cfg.checkpoint.directory,
-                                      "config.json"))
+        # Downstream --pretrained commands reconstruct the exact run
+        # config from this file, no repeated --pretrained-set flags.
+        _save_run_config(cfg, cfg.checkpoint.directory)
     log_fn = None
     mf = None
     # Only host 0 writes (every process would append duplicate, possibly
@@ -344,6 +339,14 @@ def cmd_finetune(args) -> int:
     cfg = apply_overrides(cfg, args.set or [])
 
     trunk = None
+    if args.pretrained and (os.path.abspath(args.pretrained)
+                            == os.path.abspath(cfg.checkpoint.directory)):
+        # Sharing the dir would interleave fine-tune epochs with pretrain
+        # steps in one orbax manager and clobber the pretrain run's
+        # config.json with a FinetuneConfig.
+        raise SystemExit(
+            "--checkpoint-dir must differ from --pretrained "
+            f"({args.pretrained}): fine-tune epochs get their own run dir")
     if args.pretrained:
         # Rebuild the pretrain-time state template — from the run dir's
         # config.json when present, else the preset. Only model.* of the
@@ -404,6 +407,10 @@ def cmd_finetune(args) -> int:
     ck = Checkpointer(cfg.checkpoint.directory,
                       max_to_keep=cfg.checkpoint.max_to_keep,
                       async_save=cfg.checkpoint.async_save)
+    # Provenance: record the resolved FinetuneConfig beside the epochs
+    # (same convention — and the same host-0 guard — as pretrain run dirs).
+    if jax.process_index() == 0:
+        _save_run_config(cfg, cfg.checkpoint.directory)
     out = finetune(cfg, train_batches, eval_batches=eval_batches,
                    pretrained_trunk=trunk, checkpointer=ck)
     ck.close()
@@ -456,6 +463,15 @@ def _read_named_seqs(args) -> tuple:
     if getattr(args, "seqs", None):
         return [f"seq{i}" for i in range(len(args.seqs))], list(args.seqs)
     raise SystemExit("provide --fasta, --seqs-file, or positional sequences")
+
+
+def _save_run_config(cfg, directory: str) -> None:
+    """Record the resolved config beside a run's checkpoints (the file
+    _pretrain_run_config and the --pretrained consumers read back)."""
+    from proteinbert_tpu.configs import save_config
+
+    os.makedirs(directory, exist_ok=True)
+    save_config(cfg, os.path.join(os.path.abspath(directory), "config.json"))
 
 
 def _synthetic_dataset(cfg, n_min: int):
@@ -519,9 +535,7 @@ def _write_run_dir(cfg, params, step: int, output: str) -> None:
     ck = Checkpointer(output, async_save=False)
     ck.save(step, state, {"batches_consumed": step})
     ck.close()
-    from proteinbert_tpu.configs import save_config
-
-    save_config(cfg, os.path.join(os.path.abspath(output), "config.json"))
+    _save_run_config(cfg, output)
 
 
 def cmd_convert_torch(args) -> int:

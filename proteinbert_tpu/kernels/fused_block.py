@@ -221,14 +221,16 @@ def _fused_kernel_tiled(
     window = x_ref[0, pl.ds(j * tile, tile + 2 * halo), :]
 
     tc = cw_ref.shape[-1]
-    # window/bcast rows are materialized values here — slice their tile
-    # columns with dynamic_slice (pl.ds indexes refs, not values).
-    x_center_cols = lax.dynamic_slice_in_dim(
-        window[halo:halo + tile], c * tc, tc, axis=1)
-    bcast_cols = lax.dynamic_slice_in_dim(bcast_ref[0, 0], c * tc, tc, axis=0)
 
     @pl.when(phase == 0)
     def _narrow():
+        # window/bcast rows are materialized values — slice their tile
+        # columns with dynamic_slice (pl.ds indexes refs, not values);
+        # only this phase consumes them, so the slices live here.
+        x_center_cols = lax.dynamic_slice_in_dim(
+            window[halo:halo + tile], c * tc, tc, axis=1)
+        bcast_cols = lax.dynamic_slice_in_dim(
+            bcast_ref[0, 0], c * tc, tc, axis=0)
         conv = _tap_matmuls(window, cw_ref[0], taps, narrow_dilation,
                             halo, tile)
         h_scratch[:, pl.ds(c * tc, tc)] = (
@@ -424,7 +426,7 @@ def pallas_supported(
     """Whether the fused kernel handles this shape+dtype within the VMEM
     budget (else the model falls back to the XLA path). Up to
     MAX_PALLAS_DIM the whole weight set must fit; beyond it the
-    channel-tiled plan (_pick_c_tile) must find a tile width. Note
+    channel-tiled plan (_plan_tiled) must find a tile width. Note
     `seq_len` is the PER-SHARD length the kernel actually sees — under
     sequence parallelism a long global L divides down to supportable
     shards."""

@@ -349,3 +349,29 @@ def test_data_bench_cli(tmp_path, capsys):
         r = _json.loads(ln)
         assert r["variant"] in ("direct", "prefetch")
         assert r["batches_per_sec"] > 0 and r["batches"] == 5
+
+
+def test_finetune_writes_config_json(tmp_path):
+    from proteinbert_tpu.cli.main import main
+    from proteinbert_tpu.configs import FinetuneConfig, load_config
+
+    ft = str(tmp_path / "ft")
+    assert main(["finetune", "--preset", "tiny",
+                 "--task", "sequence_classification", "--num-outputs", "3",
+                 "--epochs", "1", "--set", "data.seq_len=48",
+                 "--set", "data.batch_size=4", "--set", "model.local_dim=32",
+                 "--set", "model.num_annotations=64",
+                 "--checkpoint-dir", ft]) == 0
+    saved = load_config(str(tmp_path / "ft" / "config.json"),
+                        FinetuneConfig)
+    assert saved.task.kind == "sequence_classification"
+    assert saved.model.local_dim == 32
+
+
+def test_finetune_rejects_shared_run_dir(tmp_path):
+    from proteinbert_tpu.cli.main import main
+
+    d = str(tmp_path / "run")
+    with pytest.raises(SystemExit, match="must differ"):
+        main(["finetune", "--preset", "tiny", "--pretrained", d,
+              "--checkpoint-dir", d])

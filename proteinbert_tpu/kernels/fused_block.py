@@ -29,13 +29,16 @@ VMEM budget: weights dominate at 2·K·C² + C² activation-dtype bytes
 (~10 MB at C=512 bf16). Up to C = 512 the whole weight set resides in
 VMEM and the grid is (B, L/TL). Beyond that (ProteinBERT-Large C=1024)
 a CHANNEL-TILED variant runs instead: the grid grows a third, fastest
-axis over output-channel tiles of width TC — each step loads only the
-(K, C, TC) conv weight slices and accumulates its (TL, TC) slice of
-    h = x + gelu(narrow) + gelu(wide) + broadcast
+axis over output-channel tiles of width TC — each step loads only one
+conv's (K, C, TC) weight slice and accumulates its (TL, TC) slice of
+    gelu(narrow) + gelu(wide)
 into a persistent (TL, C) fp32 VMEM scratch (TPU grid steps run
 sequentially, so scratch carries across the c-axis); the final c step
-computes LN → dense (+GELU, residual) → LN on the full row. Shapes the
-tiled plan cannot fit either fall back to the XLA path automatically.
+adds x + broadcast over the FULL row (static slices only — Mosaic
+cannot lower lax.dynamic_slice on materialized values, so nothing may
+column-slice x/broadcast by the dynamic grid index) and then computes
+LN → dense (+GELU, residual) → LN. Shapes the tiled plan cannot fit
+either fall back to the XLA path automatically.
 """
 
 from __future__ import annotations
@@ -210,9 +213,12 @@ def _fused_kernel_tiled(
     visited as grid phases so only ONE conv's (taps, C, TC) weight slice
     is resident per step (the conv weights dominate VMEM at C=1024; see
     _plan_tiled). Phase 0 seeds this c tile's columns of the fp32
-    scratch row with x + broadcast + gelu(narrow); phase 1 adds
-    gelu(wide); the final (c, phase) step finishes the row (LN → dense
-    residual → LN) and writes the output block.
+    scratch row with gelu(narrow); phase 1 adds gelu(wide); the final
+    (c, phase) step adds x + broadcast over the FULL row — static
+    slices only; Mosaic cannot lower lax.dynamic_slice on materialized
+    values, so nothing may column-slice `window`/`bcast` by the dynamic
+    grid index `c` — then finishes (LN → dense residual → LN) and
+    writes the output block.
     """
     j = pl.program_id(1)
     c = pl.program_id(2)
@@ -224,20 +230,10 @@ def _fused_kernel_tiled(
 
     @pl.when(phase == 0)
     def _narrow():
-        # window/bcast rows are materialized values — slice their tile
-        # columns with dynamic_slice (pl.ds indexes refs, not values);
-        # only this phase consumes them, so the slices live here.
-        x_center_cols = lax.dynamic_slice_in_dim(
-            window[halo:halo + tile], c * tc, tc, axis=1)
-        bcast_cols = lax.dynamic_slice_in_dim(
-            bcast_ref[0, 0], c * tc, tc, axis=0)
         conv = _tap_matmuls(window, cw_ref[0], taps, narrow_dilation,
                             halo, tile)
-        h_scratch[:, pl.ds(c * tc, tc)] = (
-            x_center_cols.astype(jnp.float32)
-            + bcast_cols.astype(jnp.float32)[None, :]
-            + _gelu(conv + cb_ref[0, 0].astype(jnp.float32))
-        )
+        h_scratch[:, pl.ds(c * tc, tc)] = _gelu(
+            conv + cb_ref[0, 0].astype(jnp.float32))
 
     @pl.when(phase == 1)
     def _wide():
@@ -248,7 +244,10 @@ def _fused_kernel_tiled(
 
     @pl.when((c == c_tiles - 1) & (phase == 1))
     def _finish():
-        out_ref[0] = _finish_row(h_scratch[:, :], s1_ref, b1_ref,
+        h32 = (h_scratch[:, :]
+               + window[halo:halo + tile].astype(jnp.float32)
+               + bcast_ref[0, 0].astype(jnp.float32)[None, :])
+        out_ref[0] = _finish_row(h32, s1_ref, b1_ref,
                                  dk_ref, db_ref, s2_ref, b2_ref, dtype)
 
 
@@ -281,7 +280,7 @@ def _plan_tiled(C: int, seq_len: int, dtype,
             row = 2 * (seq_len + 2 * halo) * C * itemsize  # varies with b
             out = 2 * tile * C * itemsize                 # varies with (b, j)
             scratch = tile * C * 4                        # fp32 h row
-            finish = tile * C * (4 + 4 + itemsize)        # d, h2 f32 + x1
+            finish = tile * C * (4 + 4 + 4 + itemsize)    # h32, d, h2 f32 + x1
             if (conv_w + dense + row + out + scratch + finish
                     <= _VMEM_BUDGET):
                 return tc, tile

@@ -37,8 +37,12 @@ sequentially, so scratch carries across the c-axis); the final c step
 adds x + broadcast over the FULL row (static slices only — Mosaic
 cannot lower lax.dynamic_slice on materialized values, so nothing may
 column-slice x/broadcast by the dynamic grid index) and then computes
-LN → dense (+GELU, residual) → LN. Shapes the tiled plan cannot fit
-either fall back to the XLA path automatically.
+LN → dense (+GELU, residual) → LN. The grid order adapts to VMEM:
+when an fp32 scratch covering the full (L, C) row set fits, the L-tile
+axis runs FASTEST so each conv weight slice stays resident across the
+whole L sweep (weight HBM traffic O(weights), not O(B·L/TL·weights));
+otherwise the per-row order runs with phase fastest. Shapes the tiled
+plan cannot fit either way fall back to the XLA path automatically.
 """
 
 from __future__ import annotations
@@ -49,6 +53,7 @@ from typing import Dict
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -115,7 +120,10 @@ def local_track_valid_reference(
             padding="VALID", rhs_dilation=(dilation,),
             dimension_numbers=("NWC", "WIO", "NWC"),
         )
-        return y + p["bias"].astype(xh.dtype)
+        # Same remat tag as conv1d_apply so the "convs" policy also
+        # bites on the sequence-parallel XLA path (parallel/seq_parallel
+        # wraps this body in jax.checkpoint); inert everywhere else.
+        return checkpoint_name(y + p["bias"].astype(xh.dtype), "conv_out")
 
     # VALID output row m covers input rows starting at m; center row l of
     # a 'SAME' conv corresponds to window start l + H - ((k-1)/2)·d.
@@ -206,8 +214,20 @@ def _fused_kernel_tiled(
     out_ref,
     h_scratch,
     *, tile, halo, taps, narrow_dilation, wide_dilation, c_tiles,
+    resident,
 ):
-    """Channel-tiled body: grid (B, L/tile, c_tiles, 2), phase fastest.
+    """Channel-tiled body, one of two grid orders (see _plan_tiled):
+
+    - resident=False: grid (B, L/tile, c_tiles, 2), phase fastest,
+      scratch covers ONE (tile, C) row. Conv weight slices are refetched
+      for every L tile and batch row.
+    - resident=True: grid (B, c_tiles, 2, L/tile), L-tile fastest,
+      scratch covers the FULL (L, C) row set of one batch entry. The
+      conv weight slice's block index varies only with the slow (c,
+      phase) axes, so Mosaic's pipeline keeps each slice resident across
+      the whole L sweep — weight HBM traffic drops from
+      O(B · L/tile · weights) to O(weights) per call. Preferred
+      whenever the full-row scratch fits the VMEM budget.
 
     The two convs are stacked on a leading axis of `cw_ref`/`cb_ref` and
     visited as grid phases so only ONE conv's (taps, C, TC) weight slice
@@ -220,9 +240,16 @@ def _fused_kernel_tiled(
     grid index `c` — then finishes (LN → dense residual → LN) and
     writes the output block.
     """
-    j = pl.program_id(1)
-    c = pl.program_id(2)
-    phase = pl.program_id(3)
+    if resident:
+        c = pl.program_id(1)
+        phase = pl.program_id(2)
+        j = pl.program_id(3)
+        rsel = pl.ds(j * tile, tile)
+    else:
+        j = pl.program_id(1)
+        c = pl.program_id(2)
+        phase = pl.program_id(3)
+        rsel = slice(None)
     dtype = x_ref.dtype
     window = x_ref[0, pl.ds(j * tile, tile + 2 * halo), :]
 
@@ -232,19 +259,19 @@ def _fused_kernel_tiled(
     def _narrow():
         conv = _tap_matmuls(window, cw_ref[0], taps, narrow_dilation,
                             halo, tile)
-        h_scratch[:, pl.ds(c * tc, tc)] = _gelu(
+        h_scratch[rsel, pl.ds(c * tc, tc)] = _gelu(
             conv + cb_ref[0, 0].astype(jnp.float32))
 
     @pl.when(phase == 1)
     def _wide():
         conv = _tap_matmuls(window, cw_ref[0], taps, wide_dilation,
                             halo, tile)
-        h_scratch[:, pl.ds(c * tc, tc)] += _gelu(
+        h_scratch[rsel, pl.ds(c * tc, tc)] += _gelu(
             conv + cb_ref[0, 0].astype(jnp.float32))
 
     @pl.when((c == c_tiles - 1) & (phase == 1))
     def _finish():
-        h32 = (h_scratch[:, :]
+        h32 = (h_scratch[rsel, :]
                + window[halo:halo + tile].astype(jnp.float32)
                + bcast_ref[0, 0].astype(jnp.float32)[None, :])
         out_ref[0] = _finish_row(h32, s1_ref, b1_ref,
@@ -253,18 +280,24 @@ def _fused_kernel_tiled(
 
 def _plan_tiled(C: int, seq_len: int, dtype,
                 narrow_taps: int = 9, wide_taps: int = 9,
-                wide_dilation: int = 5):
+                wide_dilation: int = 5, resident: bool = False):
     """(c_tile, l_tile) of the widest-channel plan that fits the VMEM
     budget, or (0, 0).
 
     The model counts what Mosaic actually keeps resident: blocks whose
     index map varies over the grid are DOUBLE-buffered (conv weight/bias
     slices vary with (phase, c); the input row, broadcast, and output
-    blocks vary with b/j), plus the fp32 scratch row and the finish
-    step's (tile, C) temporaries. The phase split exists exactly so the
+    blocks vary with b/j), plus the fp32 scratch and the finish step's
+    (tile, C) temporaries. The phase split exists exactly so the
     double-buffered conv residency is one conv, not two. A narrower L
     tile is tried before a narrower channel tile — it shrinks the
-    scratch/out/finish terms without adding weight refetches."""
+    scratch/out/finish terms without adding weight refetches.
+
+    `resident=True` prices the weights-resident grid order (L-tile axis
+    fastest, see _fused_kernel_tiled): the only difference is the fp32
+    scratch covering the full (seq_len, C) row set instead of one
+    (tile, C) row, so a resident plan always fits wherever it exists —
+    the per-row plan is the superset and remains the support gate."""
     if narrow_taps != wide_taps:
         return 0, 0  # the stacked phase layout needs equal tap counts
     itemsize = jnp.dtype(dtype).itemsize
@@ -279,7 +312,7 @@ def _plan_tiled(C: int, seq_len: int, dtype,
             dense = C * C * itemsize                      # whole, 1 buffer
             row = 2 * (seq_len + 2 * halo) * C * itemsize  # varies with b
             out = 2 * tile * C * itemsize                 # varies with (b, j)
-            scratch = tile * C * 4                        # fp32 h row
+            scratch = (seq_len if resident else tile) * C * 4  # fp32 h
             finish = tile * C * (4 + 4 + 4 + itemsize)    # h32, d, h2 f32 + x1
             if (conv_w + dense + row + out + scratch + finish
                     <= _VMEM_BUDGET):
@@ -362,31 +395,48 @@ def _pallas_forward(
         )(*inputs)
 
     # Channel-tiled variant for C > MAX_PALLAS_DIM (module docstring).
+    # Prefer the weights-resident grid order; fall back to the per-row
+    # scratch order when the full-row scratch doesn't fit (long L).
+    resident = True
     tc, tile = _plan_tiled(C, L, dtype, narrow_taps, wide_taps,
-                           wide_dilation)
+                           wide_dilation, resident=True)
+    if tc == 0:
+        resident = False
+        tc, tile = _plan_tiled(C, L, dtype, narrow_taps, wide_taps,
+                               wide_dilation)
     if tc == 0:  # callers gate via pallas_supported; belt and braces
         raise ValueError(f"no VMEM plan for C={C}, L={L}")
     c_tiles = C // tc
-    grid = (B, L // tile, c_tiles, 2)  # phase (narrow/wide) fastest
+    if resident:
+        grid = (B, c_tiles, 2, L // tile)  # L tiles fastest
+
+        def imap(f):  # block index from (c, phase, j)
+            return lambda b, c, p, j: f(b, c, p, j)
+    else:
+        grid = (B, L // tile, c_tiles, 2)  # phase (narrow/wide) fastest
+
+        def imap(f):
+            return lambda b, j, c, p: f(b, c, p, j)
 
     # Both convs stacked on a leading phase axis so each grid step loads
     # ONE conv's weight slice (see _plan_tiled).
     conv_w = jnp.stack([inputs[2], inputs[4]])          # (2, taps, C, C)
     conv_b = jnp.stack([inputs[3], inputs[5]])          # (2, 1, C)
 
-    row_spec = pl.BlockSpec((1, Lp, C), lambda b, j, c, p: (b, 0, 0),
+    row_spec = pl.BlockSpec((1, Lp, C), imap(lambda b, c, p, j: (b, 0, 0)),
                             memory_space=pltpu.VMEM)
-    bcast_spec = pl.BlockSpec((1, 1, C), lambda b, j, c, p: (b, 0, 0),
+    bcast_spec = pl.BlockSpec((1, 1, C), imap(lambda b, c, p, j: (b, 0, 0)),
                               memory_space=pltpu.VMEM)
 
     def whole4(a):
-        return pl.BlockSpec(a.shape, lambda b, j, c, p: (0,) * a.ndim,
+        return pl.BlockSpec(a.shape, lambda *_: (0,) * a.ndim,
                             memory_space=pltpu.VMEM)
 
     conv_w_spec = pl.BlockSpec((1, narrow_taps, C, tc),
-                               lambda b, j, c, p: (p, 0, 0, c),
+                               imap(lambda b, c, p, j: (p, 0, 0, c)),
                                memory_space=pltpu.VMEM)
-    conv_b_spec = pl.BlockSpec((1, 1, tc), lambda b, j, c, p: (p, 0, c),
+    conv_b_spec = pl.BlockSpec((1, 1, tc),
+                               imap(lambda b, c, p, j: (p, 0, c)),
                                memory_space=pltpu.VMEM)
 
     in_specs = [
@@ -396,16 +446,29 @@ def _pallas_forward(
     kernel = functools.partial(
         _fused_kernel_tiled, tile=tile, halo=halo, taps=narrow_taps,
         narrow_dilation=narrow_dilation, wide_dilation=wide_dilation,
-        c_tiles=c_tiles,
+        c_tiles=c_tiles, resident=resident,
     )
+    if resident:
+        # The kernel only writes output on the final (c, phase) sweep, but
+        # Mosaic copies an output block to HBM on every block-index
+        # CHANGE — with j fastest a plain (b, j, 0) map would stream the
+        # (uninitialized) block 2·c_tiles times per row. Pinning the index
+        # to (b, 0, 0) during non-finish sweeps makes it change only
+        # across the finish sweep's j steps, so exactly the finished
+        # blocks are written, once each.
+        def out_map(b, c, p, j):
+            return (b, jnp.where((c == c_tiles - 1) & (p == 1), j, 0), 0)
+    else:
+        out_map = imap(lambda b, c, p, j: (b, j, 0))
     return pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, tile, C), lambda b, j, c, p: (b, j, 0),
+        out_specs=pl.BlockSpec((1, tile, C), out_map,
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((B, L, C), dtype),
-        scratch_shapes=[pltpu.VMEM((tile, C), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((L if resident else tile, C),
+                                   jnp.float32)],
         cost_estimate=cost,
         interpret=interpret,
     )(*inputs[:2], conv_w, conv_b, *inputs[6:])

@@ -59,6 +59,34 @@ from proteinbert_tpu.ops.layers import (
 Params = Dict[str, Any]
 
 
+def remat_wrap(body, cfg: ModelConfig):
+    """Apply cfg's rematerialisation choice to a block body — the single
+    policy-dispatch point shared by the jit path here and the explicit
+    sequence-parallel path (parallel/seq_parallel.py).
+
+    "full" recomputes the whole block in backward; "convs" keeps the two
+    conv outputs (the FLOPs-heavy ~85% of a block, tagged "conv_out" in
+    ops/layers.conv1d_apply and the seq-parallel valid-conv variant) and
+    recomputes only the cheap dense/LN/attention tail: ~3.15× forward
+    FLOPs per step instead of full remat's 4×, for 2·(B,L,C) bf16 extra
+    residency per block (measured +8% throughput, BASELINE.md). Under
+    use_pallas the kernel's custom VJP hides its internals either way, so
+    both policies degenerate to recompute-everything there.
+    """
+    if cfg.remat_policy not in ("full", "convs"):
+        raise ValueError(
+            f"unknown remat_policy {cfg.remat_policy!r}; have 'full', 'convs'"
+        )
+    if not cfg.remat:
+        return body
+    if cfg.remat_policy == "convs":
+        return jax.checkpoint(
+            body,
+            policy=jax.checkpoint_policies.save_only_these_names("conv_out"),
+        )
+    return jax.checkpoint(body)
+
+
 def block_init(key: jax.Array, cfg: ModelConfig) -> Params:
     """One dual-track block's parameters (reference modules.py:95-199)."""
     C, G = cfg.local_dim, cfg.global_dim
@@ -160,9 +188,7 @@ def encode(
         dense_apply(params["global_in"], annotations.astype(dtype))
     )
 
-    body = partial(block_apply, cfg=cfg)
-    if cfg.remat:
-        body = jax.checkpoint(body)
+    body = remat_wrap(partial(block_apply, cfg=cfg), cfg)
 
     if cfg.scan_blocks:
         def scan_body(carry, blk):

@@ -30,6 +30,7 @@ from typing import Dict, Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name
 
 Params = Dict[str, jax.Array]
 
@@ -92,7 +93,11 @@ def conv1d_apply(params: Params, x: jax.Array, dilation: int = 1) -> jax.Array:
         rhs_dilation=(dilation,),
         dimension_numbers=("NWC", "WIO", "NWC"),
     )
-    return y + params["bias"].astype(x.dtype)
+    # Named for selective rematerialisation: the convs are ~85% of block
+    # FLOPs, so model.remat_policy="convs" saves exactly these outputs
+    # and recomputes only the cheap elementwise/LN tail in the backward
+    # pass (models/proteinbert.encode).
+    return checkpoint_name(y + params["bias"].astype(x.dtype), "conv_out")
 
 
 def embedding_init(key: jax.Array, vocab_size: int, dim: int) -> Params:

@@ -46,6 +46,7 @@ from proteinbert_tpu.kernels.fused_block import (
     track_halo,
 )
 from proteinbert_tpu.models import proteinbert
+from proteinbert_tpu.models.proteinbert import remat_wrap
 from proteinbert_tpu.ops.layers import (
     dense_apply, embedding_apply, layer_norm_apply,
 )
@@ -152,10 +153,11 @@ def _shard_forward(
         dense_apply(params["global_in"], annotations.astype(dtype))
     )
 
-    body = partial(_seq_block_apply, cfg=cfg, axis_size=axis_size,
-                   interpret=interpret)
-    if cfg.remat:
-        body = jax.checkpoint(body)
+    body = remat_wrap(
+        partial(_seq_block_apply, cfg=cfg, axis_size=axis_size,
+                interpret=interpret),
+        cfg,
+    )
 
     if cfg.scan_blocks:
         def scan_body(carry, blk):

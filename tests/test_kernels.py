@@ -193,6 +193,27 @@ def test_tiled_plan_details():
     # Unequal tap counts can't use the stacked phase layout → no plan.
     assert _plan_tiled(1024, 512, "bfloat16", narrow_taps=9,
                        wide_taps=5)[0] == 0
+    # The weights-resident order (full-row fp32 scratch) fits at Large
+    # L=512 — the order the kernel actually runs there...
+    assert _plan_tiled(1024, 512, "bfloat16", resident=True) == (128, 128)
+    # ...but not at long L, where only the per-row order has a plan.
+    assert _plan_tiled(640, 2048, "bfloat16", resident=True)[0] == 0
+    assert _plan_tiled(640, 2048, "bfloat16") == (128, 128)
+
+
+def test_tiled_per_row_order_parity(key):
+    """C=640/L=2048 has no weights-resident plan (full-row scratch blows
+    VMEM), so this shape exercises the per-row fallback grid order."""
+    from proteinbert_tpu.kernels.fused_block import _plan_tiled
+
+    assert _plan_tiled(640, 2048, "bfloat16", resident=True)[0] == 0
+    assert pallas_supported(640, 2048)
+    params, x, bcast = _make_inputs(key, B=1, L=2048, C=640,
+                                    dtype=jnp.bfloat16)
+    got = fused_local_track(params, x, bcast, 1, 5, True).astype(jnp.float32)
+    want = local_track_reference(params, x, bcast, 1, 5).astype(jnp.float32)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=0.05, atol=0.05)
 
 
 def test_tiled_unequal_taps_falls_back_to_xla(key):

@@ -163,6 +163,31 @@ def test_remat_matches(key):
     )
 
 
+def test_remat_convs_policy_matches(key):
+    """The selective "convs" policy (save conv outputs, recompute the
+    tail — the base preset's default) is a pure scheduling change: its
+    gradients must equal the no-remat path exactly (full remat is
+    covered against no-remat by test_remat_matches above)."""
+    cfg = tiny_cfg()
+    cfg_c = tiny_cfg(remat=True, remat_policy="convs")
+    params = proteinbert.init(key, cfg)
+    tokens, ann = make_batch(key, cfg)
+
+    def loss(p, c):
+        l, g = proteinbert.apply(p, tokens, ann, c)
+        return jnp.abs(l).mean() + jnp.abs(g).mean()
+
+    g1 = jax.grad(loss)(params, cfg)
+    g2 = jax.grad(loss)(params, cfg_c)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5
+        ),
+        g1,
+        g2,
+    )
+
+
 def test_param_count_scales():
     cfg = tiny_cfg()
     p = proteinbert.init(jax.random.PRNGKey(0), cfg)

@@ -85,3 +85,103 @@ def device_trace(log_dir: str, host_profile: bool = False):
     finally:
         jax.profiler.stop_trace()
         log(f"device trace written to {log_dir}")
+
+
+def monitor_memory(threshold_bytes: int = 100 * 1024 ** 2,
+                   collect: bool = False, verbose: bool = True):
+    """Log every live host array buffer >= `threshold_bytes` (reference
+    shared_utils/util.py:175-228's heap walker). Walks every gc-tracked
+    container (module __dict__s included) plus the `__dict__` of every
+    gc-tracked instance, and recurses through UNTRACKED containers found
+    inside them — CPython untracks a dict/tuple whose members are all
+    untracked (a tuple-of-arrays pytree, an instance __dict__ holding
+    only arrays), so such nests are reachable only through a tracked
+    ancestor. Returns [(type_name, nbytes), ...] largest first,
+    deduplicated by identity; optionally gc.collect()s afterwards like
+    the reference.
+    """
+    import collections
+    import gc
+
+    def size_of(obj):
+        try:
+            n = getattr(obj, "nbytes", None)  # numpy / jax host buffers
+            if n is None and isinstance(obj, (bytes, bytearray)):
+                n = len(obj)
+        except Exception:  # objects with exploding __getattr__
+            return None
+        return n if isinstance(n, int) else None
+
+    seen: Dict[int, tuple] = {}
+    visited: set = set()
+    # The walker's own bookkeeping is gc-tracked and MUTATES during the
+    # walk — iterating it would raise "changed size during iteration".
+    internals = {id(seen), id(visited)}
+
+    # Iterative walk (an explicit stack): deep pathological nests must
+    # not RecursionError a diagnostic tool. Only containers enter
+    # `visited` — recording every leaf id would balloon the walker's own
+    # footprint on multi-million-element lists (`seen` already dedups
+    # leaf buffers by id).
+    containers = (dict, list, tuple, set, frozenset, collections.deque)
+    stack = []
+    for c in gc.get_objects():
+        if isinstance(c, containers):
+            stack.append(c)
+        else:
+            # Instances are gc-tracked even when their __dict__ is not
+            # (all-untracked values, e.g. only numpy arrays on self) —
+            # the commonest big-buffer holder, reached via vars() here.
+            d = getattr(c, "__dict__", None)
+            if isinstance(d, dict):
+                stack.append(d)
+    while stack:
+        obj = stack.pop()
+        if isinstance(obj, containers):
+            if id(obj) in visited or id(obj) in internals:
+                continue
+            visited.add(id(obj))
+            try:
+                if isinstance(obj, dict):
+                    # keys too: bytes keys are legal and can be large
+                    stack.extend(list(obj.keys()))
+                    stack.extend(list(obj.values()))
+                else:
+                    stack.extend(list(obj))
+            except RuntimeError:
+                # Mutated mid-iteration by another thread (prefetch,
+                # jax-internal); skip it rather than crash a diagnostic.
+                continue
+        else:
+            n = size_of(obj)
+            if n is not None and n >= threshold_bytes:
+                seen[id(obj)] = (type(obj).__name__, n)
+
+    found = sorted(seen.values(), key=lambda kv: -kv[1])
+    if verbose:
+        for name, n in found:
+            log(f"monitor_memory: {name} {n / 1024 ** 2:.0f} MB")
+        if not found:
+            log(f"monitor_memory: no object >= "
+                f"{threshold_bytes / 1024 ** 2:.0f} MB")
+    if collect:
+        gc.collect()
+    return found
+
+
+def device_memory_report() -> Dict[str, Dict[str, int]]:
+    """Per-device HBM stats ({device: {bytes_in_use, peak_bytes_in_use,
+    bytes_limit, ...}}) — the on-chip counterpart of monitor_memory; the
+    numbers XLA's allocator actually enforces (a 16 GB v5e OOMs on
+    bytes_in_use, not on host heap size)."""
+    import jax
+
+    out: Dict[str, Dict[str, int]] = {}
+    for d in jax.local_devices():
+        try:
+            stats = d.memory_stats() or {}
+        except Exception:  # backends without memory_stats (e.g. some CPU)
+            stats = {}
+        out[str(d)] = {k: int(v) for k, v in stats.items()
+                       if isinstance(v, (int, float))}
+    return out

@@ -11,6 +11,7 @@ actually returns.
 
 from __future__ import annotations
 
+import functools
 from typing import Optional, Sequence, Tuple
 
 import numpy as np
@@ -121,3 +122,109 @@ def scatter_plot(x, y, out_path: str, xlabel: str = "", ylabel: str = "") -> Non
     fig.tight_layout()
     fig.savefig(out_path)
     plt.close(fig)
+
+
+def manhattan_plot(
+    chrom_labels: Sequence, positions: Sequence[int],
+    pvals: Sequence[float], out_path: str,
+) -> None:
+    """-log10(p) by genomic position, chromosomes concatenated on the x
+    axis in alternating shades (reference shared_utils/util.py:968-1105's
+    Manhattan variant). `chrom_labels` groups the points; groups are laid
+    out in first-appearance order."""
+    plt = _plt()
+    chrom_labels = list(chrom_labels)
+    positions = np.asarray(positions, dtype=np.float64)
+    logs = -np.log10(np.clip(np.asarray(pvals, np.float64), 1e-300, 1.0))
+    if not (len(chrom_labels) == positions.size == logs.size):
+        raise ValueError("chrom_labels, positions, pvals must align")
+
+    # Group by label via dict lookup (one O(n) pass, insertion-ordered).
+    # Deliberately NOT numpy `==`: a NaN label from a pandas column would
+    # match nothing under eq (nan != nan) and crash on an empty group,
+    # while dict hashing groups identical objects fine.
+    groups: dict = {}
+    for i, c in enumerate(chrom_labels):
+        groups.setdefault(c, []).append(i)
+    fig, ax = plt.subplots(figsize=(8, 3))
+    offset = 0.0
+    ticks, tick_labels = [], []
+    for g, (c, idx_list) in enumerate(groups.items()):
+        idx = np.asarray(idx_list)
+        pos = positions[idx]
+        span = pos.max() - pos.min() + 1
+        ax.plot(pos - pos.min() + offset, logs[idx], ".", ms=2,
+                color=("tab:blue", "tab:gray")[g % 2])
+        ticks.append(offset + span / 2)
+        tick_labels.append(str(c))
+        offset += span
+    ax.set_xticks(ticks, tick_labels, rotation=90, fontsize=6)
+    ax.set_ylabel("-log10(p)")
+    fig.tight_layout()
+    fig.savefig(out_path)
+    plt.close(fig)
+
+
+def write_excel(sheets: dict, out_path: str, fallback_csv: bool = True) -> list:
+    """Write {sheet_name: DataFrame} to one .xlsx (reference
+    shared_utils/util.py:794-805). An xlsx engine (openpyxl/xlsxwriter) is
+    optional in this image; with `fallback_csv` the sheets are written as
+    `<out_path>.<sheet>.csv` instead when no engine exists. Returns the
+    list of paths written."""
+    import pandas as pd
+
+    try:
+        with pd.ExcelWriter(out_path) as writer:
+            for name, df in sheets.items():
+                pd.DataFrame(df).to_excel(writer, sheet_name=str(name))
+        return [out_path]
+    except ImportError:
+        if not fallback_csv:
+            raise ImportError(
+                "write_excel needs openpyxl or xlsxwriter (optional in "
+                "this environment); pass fallback_csv=True for CSVs")
+        paths = []
+        for name, df in sheets.items():
+            p = f"{out_path}.{name}.csv"
+            pd.DataFrame(df).to_csv(p)
+            paths.append(p)
+        return paths
+
+
+@functools.lru_cache(maxsize=4)
+def _build_chain_index(chain_file: str):
+    from pyliftover import LiftOver
+
+    return LiftOver(chain_file)
+
+
+def _chain_index(chain_file: str):
+    """Cached pyliftover.LiftOver per chain file — construction parses
+    and indexes the whole UCSC chain (seconds), and the natural caller
+    loops liftover_positions per chromosome over the same chain."""
+    try:
+        return _build_chain_index(chain_file)
+    except ImportError as e:
+        raise ImportError(
+            "liftover_positions needs pyliftover, which is optional in "
+            "this environment") from e
+
+
+def liftover_positions(
+    chain_file: str, chrom: str, positions: Sequence[int],
+    one_based: bool = False,
+) -> list:
+    """Map genomic coordinates across assemblies via a UCSC chain file
+    (reference shared_utils/util.py:1161-1200). Positions are 0-based
+    (pyliftover's convention) unless `one_based=True`, in which case both
+    inputs and outputs use the 1-based VCF/GWAS convention. Returns
+    [(chrom, pos) | None, ...] per input position. pyliftover is optional
+    in this image — absent, this raises with a clear message (the
+    reference lazily imports it the same way)."""
+    lo = _chain_index(chain_file)
+    shift = 1 if one_based else 0
+    out = []
+    for pos in positions:
+        hits = lo.convert_coordinate(chrom, int(pos) - shift)
+        out.append((hits[0][0], int(hits[0][1]) + shift) if hits else None)
+    return out

@@ -2,12 +2,21 @@
 stats (reference C17/C20/C21/C22, rebuilt — see each module's docstring)."""
 
 from proteinbert_tpu.utils.logging import log, start_log
-from proteinbert_tpu.utils.profiling import Profiler, TimeMeasure, device_trace
+from proteinbert_tpu.utils.profiling import (
+    Profiler,
+    TimeMeasure,
+    device_memory_report,
+    device_trace,
+    monitor_memory,
+)
 from proteinbert_tpu.utils.stats import (
     benjamini_hochberg,
     drop_redundant_columns,
     fisher_enrichment,
+    liftover_positions,
+    manhattan_plot,
     one_hot,
+    write_excel,
 )
 from proteinbert_tpu.utils.sharding import (
     all_shard_file_names,
@@ -21,8 +30,9 @@ from proteinbert_tpu.utils.sharding import (
 __all__ = [
     "log", "start_log",
     "Profiler", "TimeMeasure", "device_trace",
+    "monitor_memory", "device_memory_report",
     "to_chunks", "shard_range", "shard_items", "task_identity",
     "shard_file_name", "all_shard_file_names",
     "benjamini_hochberg", "drop_redundant_columns", "fisher_enrichment",
-    "one_hot",
+    "one_hot", "manhattan_plot", "write_excel", "liftover_positions",
 ]

@@ -104,11 +104,19 @@ def monitor_memory(threshold_bytes: int = 100 * 1024 ** 2,
     import gc
 
     def size_of(obj):
+        # Probe `nbytes` (numpy / jax buffers) through the TYPE, never the
+        # instance: instance getattr would fire arbitrary __getattr__ on
+        # every live object (observed force-registering pytest marks;
+        # would force-initialize lazy proxies heap-wide). Everything is
+        # guarded — even isinstance raises on a dead weakref.proxy.
         try:
-            n = getattr(obj, "nbytes", None)  # numpy / jax host buffers
-            if n is None and isinstance(obj, (bytes, bytearray)):
-                n = len(obj)
-        except Exception:  # objects with exploding __getattr__
+            if isinstance(obj, (bytes, bytearray)):
+                return len(obj)
+            desc = getattr(type(obj), "nbytes", None)
+            if desc is None or not hasattr(desc, "__get__"):
+                return None
+            n = desc.__get__(obj, type(obj))
+        except Exception:  # dead weakproxies, raising descriptors
             return None
         return n if isinstance(n, int) else None
 
@@ -125,19 +133,29 @@ def monitor_memory(threshold_bytes: int = 100 * 1024 ** 2,
     # leaf buffers by id).
     containers = (dict, list, tuple, set, frozenset, collections.deque)
     stack = []
+    internals.add(id(stack))  # gc-listed below; must not walk itself
     for c in gc.get_objects():
-        if isinstance(c, containers):
-            stack.append(c)
-        else:
-            # Instances are gc-tracked even when their __dict__ is not
-            # (all-untracked values, e.g. only numpy arrays on self) —
-            # the commonest big-buffer holder, reached via vars() here.
-            d = getattr(c, "__dict__", None)
-            if isinstance(d, dict):
-                stack.append(d)
+        # Everything here is guarded: a dead weakref.proxy raises
+        # ReferenceError from isinstance itself (it forwards __class__ to
+        # the collected referent).
+        try:
+            if isinstance(c, containers):
+                stack.append(c)
+            else:
+                # Instances are gc-tracked even when their __dict__ is
+                # not (all-untracked values, e.g. only numpy arrays on
+                # self) — the commonest big-buffer holder, via vars().
+                d = getattr(c, "__dict__", None)
+                if isinstance(d, dict):
+                    stack.append(d)
+        except Exception:
+            continue
     while stack:
         obj = stack.pop()
-        if isinstance(obj, containers):
+        # issubclass(type(obj), ...) not isinstance: a dead weakref.proxy
+        # forwards __class__ to its collected referent and raises from
+        # isinstance, while type() never forwards.
+        if issubclass(type(obj), containers):
             if id(obj) in visited or id(obj) in internals:
                 continue
             visited.add(id(obj))

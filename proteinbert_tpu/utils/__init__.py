@@ -16,6 +16,8 @@ from proteinbert_tpu.utils.stats import (
     liftover_positions,
     manhattan_plot,
     one_hot,
+    qq_plot,
+    scatter_plot,
     write_excel,
 )
 from proteinbert_tpu.utils.sharding import (
@@ -34,5 +36,6 @@ __all__ = [
     "to_chunks", "shard_range", "shard_items", "task_identity",
     "shard_file_name", "all_shard_file_names",
     "benjamini_hochberg", "drop_redundant_columns", "fisher_enrichment",
-    "one_hot", "manhattan_plot", "write_excel", "liftover_positions",
+    "one_hot", "qq_plot", "scatter_plot", "manhattan_plot",
+    "write_excel", "liftover_positions",
 ]

@@ -144,8 +144,17 @@ def monitor_memory(threshold_bytes: int = 100 * 1024 ** 2,
             else:
                 # Instances are gc-tracked even when their __dict__ is
                 # not (all-untracked values, e.g. only numpy arrays on
-                # self) — the commonest big-buffer holder, via vars().
-                d = getattr(c, "__dict__", None)
+                # self) — the commonest big-buffer holder. Find the
+                # __dict__ slot through the TYPE's mro: plain getattr
+                # would fall through to instance __getattr__ on
+                # __slots__ classes and fire lazy-proxy side effects
+                # heap-wide (the same hazard size_of avoids).
+                d = None
+                for klass in type(c).__mro__:
+                    desc = klass.__dict__.get("__dict__")
+                    if desc is not None:
+                        d = desc.__get__(c, type(c))
+                        break
                 if isinstance(d, dict):
                     stack.append(d)
         except Exception:
@@ -166,9 +175,10 @@ def monitor_memory(threshold_bytes: int = 100 * 1024 ** 2,
                     stack.extend(list(obj.values()))
                 else:
                     stack.extend(list(obj))
-            except RuntimeError:
+            except Exception:
                 # Mutated mid-iteration by another thread (prefetch,
-                # jax-internal); skip it rather than crash a diagnostic.
+                # jax-internal), or a container subclass whose iteration
+                # raises; skip it rather than crash a diagnostic.
                 continue
         else:
             n = size_of(obj)

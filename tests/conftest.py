@@ -33,6 +33,39 @@ jax.config.update("jax_num_cpu_devices", 8)
 import numpy as np
 import pytest
 
+# ---------------------------------------------------------------- map relief
+# XLA's CPU thunk runtime JIT-maps every compiled kernel as its own small
+# executable mapping and never unmaps it; a full-suite run accumulates
+# ~60k mappings and segfaults inside LLVM when the process hits the
+# kernel's vm.max_map_count (65530 default) — observed twice, always at
+# the same test. Tearing the backend down releases them (measured
+# 3320 → 610). This valve fires between MODULES only: module-scoped
+# fixtures (tests/test_inference.py's `trunk`) legally hold device arrays
+# across tests within a module, and a mid-module reset would kill them.
+
+_MAP_RESET_THRESHOLD = 35_000
+
+
+def _map_count() -> int:
+    try:
+        with open("/proc/self/maps") as f:
+            return sum(1 for _ in f)
+    except OSError:  # non-Linux: no /proc, no known map ceiling either
+        return 0
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _jax_map_pressure_relief():
+    if _map_count() >= _MAP_RESET_THRESHOLD:
+        import gc
+
+        import jax.extend.backend
+
+        jax.clear_caches()
+        jax.extend.backend.clear_backends()
+        gc.collect()
+    yield
+
 
 @pytest.fixture
 def rng():

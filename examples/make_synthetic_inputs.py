@@ -1,0 +1,91 @@
+"""Generate a synthetic miniature of the real inputs for the example
+workflow: a GO OBO file, a UniRef90-shaped XML, the matching FASTA, and
+fine-tuning TSVs. Shapes mirror the real artifacts (reference
+uniref_dataset.py:76-98 element layout, go.txt OBO format) at ~1/10^6
+scale so the whole pipeline runs in seconds on a laptop or one chip.
+
+Usage: python examples/make_synthetic_inputs.py <out_dir>
+"""
+
+import gzip
+import os
+import sys
+
+import numpy as np
+
+AA = "ACDEFGHIKLMNPQRSTVWY"
+N_GO = 24            # GO terms in a 3-level DAG
+N_PROTEINS = 120
+CATEGORIES = ["GO Molecular Function", "GO Biological Process",
+              "GO Cellular Component"]
+
+
+def go_obo() -> str:
+    """3-level DAG: term 1 is the root; 2..8 are its children; the rest
+    hang off those."""
+    blocks = []
+    for i in range(1, N_GO + 1):
+        lines = [f"[Term]", f"id: GO:{i:07d}", f"name: term{i}",
+                 "namespace: molecular_function"]
+        if 2 <= i <= 8:
+            lines.append("is_a: GO:0000001 ! term1")
+        elif i > 8:
+            parent = 2 + (i % 7)
+            lines.append(f"is_a: GO:{parent:07d} ! term{parent}")
+        blocks.append("\n".join(lines))
+    return "\n\n".join(blocks) + "\n"
+
+
+def main(out_dir: str) -> None:
+    rng = np.random.default_rng(0)
+    os.makedirs(out_dir, exist_ok=True)
+
+    with open(os.path.join(out_dir, "go.txt"), "w") as f:
+        f.write(go_obo())
+
+    entries, fasta = [], []
+    tsv_rows = []
+    for p in range(N_PROTEINS):
+        acc = f"P{p:05d}"
+        seq = "".join(rng.choice(list(AA), size=rng.integers(20, 120)))
+        fasta.append(f">UniRef90_{acc} cluster member\n{seq}\n")
+        # each protein gets 1-4 random leaf GO terms in random categories
+        props = "\n".join(
+            f'        <property type="{rng.choice(CATEGORIES)}" '
+            f'value="GO:{int(g):07d}"/>'
+            for g in rng.choice(np.arange(9, N_GO + 1),
+                                size=rng.integers(1, 5), replace=False)
+        )
+        entries.append(f"""\
+  <entry id="UniRef90_{acc}" updated="2024-01-01">
+    <name>Cluster: protein {acc}</name>
+    <representativeMember>
+      <dbReference type="UniProtKB ID" id="{acc}_SYNTH">
+        <property type="NCBI taxonomy" value="{int(rng.integers(1, 99999))}"/>
+{props}
+      </dbReference>
+      <sequence length="{len(seq)}">IGNORED</sequence>
+    </representativeMember>
+  </entry>
+""")
+        # fine-tune task: per-protein label = is the sequence K-rich?
+        tsv_rows.append(f"{seq}\t{int(seq.count('K') > len(seq) * 0.05)}")
+
+    with gzip.open(os.path.join(out_dir, "uniref90.xml.gz"), "wt") as f:
+        f.write('<?xml version="1.0" encoding="ISO-8859-1"?>\n'
+                '<UniRef90 xmlns="http://uniprot.org/uniref" '
+                'releaseDate="2024-01-01">\n' + "".join(entries)
+                + "</UniRef90>\n")
+    with open(os.path.join(out_dir, "uniref90.fasta"), "w") as f:
+        f.write("".join(fasta))
+    split = int(N_PROTEINS * 0.8)
+    with open(os.path.join(out_dir, "train.tsv"), "w") as f:
+        f.write("\n".join(tsv_rows[:split]) + "\n")
+    with open(os.path.join(out_dir, "dev.tsv"), "w") as f:
+        f.write("\n".join(tsv_rows[split:]) + "\n")
+    print(f"wrote go.txt, uniref90.xml.gz, uniref90.fasta, "
+          f"train.tsv, dev.tsv to {out_dir}")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else "example_inputs")

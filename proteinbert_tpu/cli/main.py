@@ -874,6 +874,14 @@ def build_parser() -> argparse.ArgumentParser:
         prog="proteinbert_tpu",
         description="TPU-native ProteinBERT: ETL + pretraining CLI",
     )
+    p.add_argument(
+        "--platform", choices=("cpu", "tpu"), default=None,
+        help="force the JAX backend (goes BEFORE the subcommand). Needed "
+             "when the accelerator is unreachable: images whose "
+             "sitecustomize pins JAX_PLATFORMS ignore the env var, and a "
+             "dead TPU tunnel then hangs every command at device init — "
+             "--platform cpu keeps the whole CLI usable",
+    )
     sub = p.add_subparsers(dest="command", required=True)
 
     db = sub.add_parser("create-uniref-db", help="UniRef XML → SQLite")
@@ -1075,6 +1083,12 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv: Optional[List[str]] = None) -> int:
     start_log()
     args = build_parser().parse_args(argv)
+    if args.platform:
+        # Must land before the first backend use anywhere in the process;
+        # command handlers import jax lazily, so this is early enough.
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
     return args.fn(args)
 
 

@@ -197,6 +197,32 @@ def test_smoke_honors_preset_flag():
     assert p.parse_args(["smoke", "--preset", "base"]).preset == "base"
 
 
+def test_platform_flag(tmp_path):
+    """--platform forces the backend before first device use — the only
+    way to steer the CLI on images whose sitecustomize pins JAX_PLATFORMS
+    (a dead TPU tunnel otherwise hangs every command at device init)."""
+    import jax
+
+    p = build_parser()
+    assert p.parse_args(["--platform", "cpu", "smoke"]).platform == "cpu"
+    assert p.parse_args(["smoke"]).platform is None
+    # end-to-end under the forced (already-active) cpu backend; restore
+    # the config after — this mutation is process-global and must not
+    # leak into later tests.
+    prior = jax.config.jax_platforms
+    try:
+        assert main([
+            "--platform", "cpu", "smoke", "--max-steps", "2",
+            "--set", "data.batch_size=4", "--set", "train.log_every=1",
+            "--set", "model.num_blocks=1", "--set", "model.local_dim=8",
+            "--set", "model.global_dim=16", "--set", "model.key_dim=4",
+            "--set", "model.num_annotations=32", "--set", "data.seq_len=32",
+            "--set", "optimizer.warmup_steps=2",
+        ]) == 0
+    finally:
+        jax.config.update("jax_platforms", prior)
+
+
 def test_smoke_cli(tmp_path):
     assert main([
         "smoke", "--max-steps", "4",

@@ -34,7 +34,7 @@ import time
 import numpy as np
 
 
-def probe_tpu(timeout: int = 180):
+def probe_tpu(timeout: int = 120, attempts: int = 4, retry_wait: int = 60):
     """(tpu_ok, reason) — whether the TPU backend initializes, decided in
     a SUBPROCESS.
 
@@ -43,20 +43,33 @@ def probe_tpu(timeout: int = 180):
     this process touched jax.devices() directly in that state, the bench
     would never emit its JSON line — so the first backend init happens in
     a killable child, and on timeout/failure the parent forces the CPU
-    backend before ITS first jax use.
+    backend before ITS first jax use. The tunnel also FLAPS (observed
+    down for minutes then back), so a failed probe retries a few times
+    before surrendering the TPU number to the CPU fallback.
     """
-    try:
-        out = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; print(jax.devices()[0].platform)"],
-            capture_output=True, text=True, timeout=timeout,
-        )
-    except subprocess.TimeoutExpired:
-        return False, "backend init timed out (tunnel down?)"
-    if out.returncode != 0:
-        return False, f"backend init failed (rc {out.returncode})"
-    platform = out.stdout.strip()
-    return platform == "tpu", f"backend platform is {platform!r}"
+    reason = "no probe ran"
+    for attempt in range(attempts):
+        if attempt:
+            time.sleep(retry_wait)
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print(jax.devices()[0].platform)"],
+                capture_output=True, text=True, timeout=timeout,
+            )
+        except subprocess.TimeoutExpired:
+            reason = "backend init timed out (tunnel down?)"
+            print(f"TPU probe {attempt + 1}/{attempts}: {reason}",
+                  file=sys.stderr)
+            continue
+        if out.returncode != 0:
+            # Deterministic failure (broken install, missing plugin) —
+            # retrying would only add minutes of sleeps; only hangs
+            # (= possible tunnel flaps) are worth waiting out.
+            return False, f"backend init failed (rc {out.returncode})"
+        platform = out.stdout.strip()
+        return platform == "tpu", f"backend platform is {platform!r}"
+    return False, reason
 
 
 def force_cpu_backend() -> None:

@@ -875,8 +875,9 @@ def build_parser() -> argparse.ArgumentParser:
         description="TPU-native ProteinBERT: ETL + pretraining CLI",
     )
     p.add_argument(
-        "--platform", choices=("cpu", "tpu"), default=None,
-        help="force the JAX backend (goes BEFORE the subcommand). Needed "
+        "--platform", choices=("cpu", "tpu", "axon"), default=None,
+        help="force the JAX backend (goes BEFORE the subcommand): cpu, "
+             "tpu (local libtpu), or axon (tunneled TPU plugin). Needed "
              "when the accelerator is unreachable: images whose "
              "sitecustomize pins JAX_PLATFORMS ignore the env var, and a "
              "dead TPU tunnel then hangs every command at device init — "

@@ -201,26 +201,29 @@ def test_platform_flag(tmp_path):
     """--platform forces the backend before first device use — the only
     way to steer the CLI on images whose sitecustomize pins JAX_PLATFORMS
     (a dead TPU tunnel otherwise hangs every command at device init)."""
-    import jax
+    import subprocess
+    import sys
 
     p = build_parser()
     assert p.parse_args(["--platform", "cpu", "smoke"]).platform == "cpu"
     assert p.parse_args(["smoke"]).platform is None
-    # end-to-end under the forced (already-active) cpu backend; restore
-    # the config after — this mutation is process-global and must not
-    # leak into later tests.
-    prior = jax.config.jax_platforms
-    try:
-        assert main([
-            "--platform", "cpu", "smoke", "--max-steps", "2",
-            "--set", "data.batch_size=4", "--set", "train.log_every=1",
-            "--set", "model.num_blocks=1", "--set", "model.local_dim=8",
-            "--set", "model.global_dim=16", "--set", "model.key_dim=4",
-            "--set", "model.num_annotations=32", "--set", "data.seq_len=32",
-            "--set", "optimizer.warmup_steps=2",
-        ]) == 0
-    finally:
-        jax.config.update("jax_platforms", prior)
+    # End-to-end in a SUBPROCESS: forcing the platform initializes and
+    # caches that backend set process-wide (restoring the config value
+    # would not undo it), so the mutation must not happen in the pytest
+    # process.
+    code = (
+        "import sys; from proteinbert_tpu.cli.main import main; "
+        "sys.exit(main(["
+        "'--platform', 'cpu', 'smoke', '--max-steps', '2', "
+        "'--set', 'data.batch_size=4', '--set', 'train.log_every=1', "
+        "'--set', 'model.num_blocks=1', '--set', 'model.local_dim=8', "
+        "'--set', 'model.global_dim=16', '--set', 'model.key_dim=4', "
+        "'--set', 'model.num_annotations=32', '--set', 'data.seq_len=32', "
+        "'--set', 'optimizer.warmup_steps=2']))"
+    )
+    out = subprocess.run([sys.executable, "-c", code],
+                         capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr[-2000:]
 
 
 def test_smoke_cli(tmp_path):

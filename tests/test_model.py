@@ -163,6 +163,17 @@ def test_remat_matches(key):
     )
 
 
+def test_remat_policy_validated():
+    """Unknown policy names must fail loudly (typos would otherwise run
+    silently at full-remat speed), and validation fires with remat off."""
+    from proteinbert_tpu.models.proteinbert import remat_wrap
+
+    with pytest.raises(ValueError, match="remat_policy"):
+        remat_wrap(lambda *a: a, tiny_cfg(remat=True, remat_policy="conv"))
+    with pytest.raises(ValueError, match="remat_policy"):
+        remat_wrap(lambda *a: a, tiny_cfg(remat_policy="kv"))
+
+
 def test_remat_convs_policy_matches(key):
     """The selective "convs" policy (save conv outputs, recompute the
     tail — the base preset's default) is a pure scheduling change: its

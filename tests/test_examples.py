@@ -1,0 +1,52 @@
+"""The example workflow's synthetic-input generator must keep producing
+artifacts the real parsers accept (examples/full_workflow.sh drives the
+CLI on them; this guards the generator against rot without running the
+full multi-minute script)."""
+
+import pathlib
+import subprocess
+import sys
+
+from proteinbert_tpu.cli.main import main
+from proteinbert_tpu.data.finetune_data import load_task_tsv
+
+_GENERATOR = (pathlib.Path(__file__).resolve().parents[1]
+              / "examples" / "make_synthetic_inputs.py")
+
+
+def test_example_inputs_feed_the_etl(tmp_path):
+    out = subprocess.run(
+        [sys.executable, str(_GENERATOR), str(tmp_path / "inputs")],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 0, out.stderr[-1000:]
+
+    db = tmp_path / "ann.db"
+    h5 = tmp_path / "data.h5"
+    assert main([
+        "create-uniref-db",
+        "--uniref-xml", str(tmp_path / "inputs" / "uniref90.xml.gz"),
+        "--go-meta", str(tmp_path / "inputs" / "go.txt"),
+        "--output-db", str(db),
+        "--go-meta-csv", str(tmp_path / "meta.csv"),
+    ]) == 0
+    assert main([
+        "create-h5", "--db", str(db),
+        "--fasta", str(tmp_path / "inputs" / "uniref90.fasta"),
+        "--go-meta-csv", str(tmp_path / "meta.csv"),
+        "--output", str(h5), "--min-records", "2",
+    ]) == 0
+
+    import h5py
+
+    with h5py.File(h5) as f:
+        n, a = f["annotation_masks"].shape
+        assert n == 120 and a > 0
+        assert f["seqs"].shape[0] == n
+
+    # fine-tune TSVs parse and carry both classes
+    tokens, labels = load_task_tsv(
+        str(tmp_path / "inputs" / "train.tsv"),
+        kind="sequence_classification", seq_len=128)
+    assert tokens.shape[0] == labels.shape[0] > 0
+    assert len(set(int(l) for l in labels)) == 2

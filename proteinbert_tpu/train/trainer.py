@@ -129,6 +129,28 @@ def pretrain(
         state, metrics = step_fn(state, put(batch), cfg)
         timer.update()
 
+        if step == start_step:
+            # One-time HBM report once the step (incl. compile-time
+            # buffers) is resident — the first thing to look at when a
+            # bigger batch OOMs. CPU backends report no stats; silent.
+            # Dispatch is async, so force the step to completion first
+            # via a scalar fetch (on the tunneled single-chip setup even
+            # block_until_ready does not await remote execution —
+            # bench.py's sync note).
+            from proteinbert_tpu.utils.profiling import device_memory_report
+
+            float(metrics["loss"])
+            stats = next((s for s in device_memory_report().values()
+                          if "bytes_in_use" in s), None)
+            if stats:
+                logger.info(
+                    "HBM after first step: %.2f GB in use (peak %.2f) "
+                    "of %.2f GB",
+                    stats["bytes_in_use"] / 1e9,
+                    stats.get("peak_bytes_in_use", 0) / 1e9,
+                    stats.get("bytes_limit", 0) / 1e9,
+                )
+
         if cfg.train.log_every and (step + 1) % cfg.train.log_every == 0:
             m = {k: float(v) for k, v in metrics.items()}
             if cfg.train.on_nan != "off" and not check_finite(

@@ -8,10 +8,8 @@ multi-chip path via __graft_entry__.dryrun_multichip.
 
 import os
 
-# Persistent XLA compilation cache: the suite is compile-bound on CPU (the
-# same train-step HLO is rebuilt by many tests and by the CLI subprocess
-# tests), and a warm cache cuts single-test wall time ~3x. Set as env vars
-# (not jax.config) so pytest-spawned subprocesses inherit it.
+# Env-var config for plain environments AND pytest-spawned subprocesses
+# (which inherit os.environ).
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/proteinbert_tpu_jax_cache")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.3")
 
@@ -24,11 +22,21 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import jax  # noqa: E402  (import after env setup is the point)
 
-# On images where a TPU plugin is pre-registered by sitecustomize (it sets
-# JAX_PLATFORMS itself, so the env vars above don't take), force the CPU
-# backend through the config API — this must happen before any device use.
+# On images whose sitecustomize imports jax at interpreter start (the axon
+# plugin registration), jax reads its env vars BEFORE conftest runs, so
+# none of the settings above take in-process — everything must also go
+# through the config API, before any device use.
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
+# Persistent XLA compilation cache: the suite is compile-bound on CPU (the
+# same train-step HLO is rebuilt by many tests), and a warm cache cuts
+# single-test wall time ~3x.
+jax.config.update("jax_compilation_cache_dir",
+                  os.environ["JAX_COMPILATION_CACHE_DIR"])
+jax.config.update(
+    "jax_persistent_cache_min_compile_time_secs",
+    float(os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"]),
+)
 
 import numpy as np
 import pytest

@@ -198,8 +198,7 @@ def cmd_pretrain(args) -> int:
 
     if args.data is not None:
         ds = HDF5PretrainingDataset(
-            args.data, cfg.data.seq_len,
-            crop_rng=np.random.default_rng(cfg.train.seed + 1))
+            args.data, cfg.data.seq_len, crop_seed=cfg.train.seed + 1)
         n_ann = ds.num_annotations
         if n_ann != cfg.model.num_annotations:
             log(f"setting model.num_annotations={n_ann} from {args.data}")
@@ -659,11 +658,10 @@ def cmd_data_bench(args) -> int:
         if args.data:
             from proteinbert_tpu.data.dataset import HDF5PretrainingDataset
 
-            # Same construction as cmd_pretrain (incl. re-crop rng): the
+            # Same construction as cmd_pretrain (incl. re-crop seed): the
             # probe must time the pipeline training actually runs.
             return HDF5PretrainingDataset(
-                args.data, cfg.data.seq_len,
-                crop_rng=np.random.default_rng(cfg.train.seed + 1))
+                args.data, cfg.data.seq_len, crop_seed=cfg.train.seed + 1)
         return _synthetic_dataset(cfg, n_min=8 * cfg.data.batch_size)
 
     if not args.data:

@@ -89,8 +89,16 @@ class OptimizerConfig:
     warmup_steps: int = 10_000              # utils.py:233 warmup_duration
     schedule: str = "warmup_plateau"        # "warmup_plateau" | "warmup_cosine" | "constant"
     total_steps: int = 100_000              # cosine horizon
-    plateau_patience: int = 10              # plateau: evals without improvement
+    plateau_window: int = 100               # steps averaged into ONE plateau
+                                            # observation (set ≈ eval_every so
+                                            # the signal tracks eval cadence,
+                                            # not per-step batch noise)
+    plateau_patience: int = 10              # windowed observations without
+                                            # improvement before LR is cut
     plateau_factor: float = 0.1             # plateau: LR multiplier on trigger
+    plateau_cooldown: int = 10              # observations to ignore after a cut
+                                            # (lets the loss re-baseline before
+                                            # another reduction can chain)
     grad_clip_norm: float = 1.0             # reference clips grads (utils.py:136)
     b1: float = 0.9
     b2: float = 0.999

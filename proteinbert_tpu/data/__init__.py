@@ -13,6 +13,9 @@ from proteinbert_tpu.data.transforms import (
     tokenize,
     tokenize_batch,
     random_crop,
+    crop_starts,
+    epoch_crop_seed,
+    splitmix64,
 )
 from proteinbert_tpu.data.corruption import (
     randomize_tokens,
@@ -33,6 +36,7 @@ __all__ = [
     "ALPHABET", "PAD_ID", "SOS_ID", "EOS_ID", "UNK_ID", "VOCAB_SIZE",
     "N_SPECIAL", "Vocab", "get_vocab",
     "tokenize", "tokenize_batch", "random_crop",
+    "crop_starts", "epoch_crop_seed", "splitmix64",
     "randomize_tokens", "corrupt_annotations", "corrupt_batch",
     "pretrain_weights",
     "InMemoryPretrainingDataset", "HDF5PretrainingDataset",

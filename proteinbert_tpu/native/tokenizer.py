@@ -13,7 +13,7 @@ from proteinbert_tpu.native.build import load_library
 _configured = False
 
 
-_ABI_VERSION = 1  # must match pbt_abi_version() and the argtypes below
+_ABI_VERSION = 2  # must match pbt_abi_version() and the argtypes below
 
 
 def _lib():
@@ -31,6 +31,7 @@ def _lib():
         lib.pbt_tokenize_batch.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
             ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int32, ctypes.c_void_p,
+            ctypes.c_void_p,
         ]
         _configured = True
     return lib
@@ -39,15 +40,16 @@ def _lib():
 def tokenize_batch_native(
     seqs: Sequence[str],
     seq_len: int,
-    rng: Optional[np.random.Generator] = None,
+    crop_seed: Optional[int] = None,
+    row_ids: Optional[np.ndarray] = None,
 ) -> Optional[np.ndarray]:
     """(B, seq_len) int32 batch via the C++ kernel, or None when the
     native library is unavailable (callers fall back to the numpy path).
 
-    Matches transforms.tokenize_batch semantics: long rows random-cropped
-    when `rng` is given (crop windows drawn from a native splitmix64
-    stream seeded from `rng`, so runs are reproducible given the
-    generator state), else head-truncated.
+    Matches transforms.tokenize_batch BIT-FOR-BIT: long rows take the
+    counter-based window splitmix64(crop_seed + row_id) when `crop_seed`
+    is given (transforms.crop_starts computes the same formula in numpy),
+    else head-truncated.
     """
     lib = _lib()
     if lib is None:
@@ -58,11 +60,16 @@ def tokenize_batch_native(
     out = np.empty((len(seqs), seq_len), dtype=np.int32)
     buf = np.frombuffer(joined, dtype=np.uint8) if joined else np.zeros(1, np.uint8)
     lut = get_vocab()._lut
-    seed = int(rng.integers(0, 2**63)) if rng is not None else 0
+    if row_ids is None:
+        row_ids = np.arange(len(seqs), dtype=np.int64)
+    else:
+        row_ids = np.ascontiguousarray(row_ids, dtype=np.int64)
     lib.pbt_tokenize_batch(
         buf.ctypes.data, offsets.ctypes.data,
         len(seqs), seq_len, lut.ctypes.data,
-        seed, 1 if rng is not None else 0,
+        (crop_seed or 0) & 0xFFFFFFFFFFFFFFFF,
+        1 if crop_seed is not None else 0,
+        row_ids.ctypes.data,
         out.ctypes.data,
     )
     return out

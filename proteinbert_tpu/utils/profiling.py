@@ -122,9 +122,16 @@ def monitor_memory(threshold_bytes: int = 100 * 1024 ** 2,
 
     seen: Dict[int, tuple] = {}
     visited: set = set()
+    # Strong references to every object whose id() lands in `visited` or
+    # `seen`: the stack pops its only reference to intermediate objects,
+    # and if one were collected mid-walk CPython could reuse its id for a
+    # genuinely new container/buffer — silently skipping it or
+    # overwriting a seen entry (ADVICE r1). Pinning them for the walk's
+    # duration makes id-dedup sound; the list is released on return.
+    pinned: list = []
     # The walker's own bookkeeping is gc-tracked and MUTATES during the
     # walk — iterating it would raise "changed size during iteration".
-    internals = {id(seen), id(visited)}
+    internals = {id(seen), id(visited), id(pinned)}
 
     # Iterative walk (an explicit stack): deep pathological nests must
     # not RecursionError a diagnostic tool. Only containers enter
@@ -168,6 +175,7 @@ def monitor_memory(threshold_bytes: int = 100 * 1024 ** 2,
             if id(obj) in visited or id(obj) in internals:
                 continue
             visited.add(id(obj))
+            pinned.append(obj)
             try:
                 if isinstance(obj, dict):
                     # keys too: bytes keys are legal and can be large
@@ -183,6 +191,8 @@ def monitor_memory(threshold_bytes: int = 100 * 1024 ** 2,
         else:
             n = size_of(obj)
             if n is not None and n >= threshold_bytes:
+                if id(obj) not in seen:
+                    pinned.append(obj)
                 seen[id(obj)] = (type(obj).__name__, n)
 
     found = sorted(seen.values(), key=lambda kv: -kv[1])

@@ -50,6 +50,14 @@ import pytest
 # 3320 → 610). This valve fires between MODULES only: module-scoped
 # fixtures (tests/test_inference.py's `trunk`) legally hold device arrays
 # across tests within a module, and a mid-module reset would kill them.
+#
+# INVARIANT for test authors: NO live jax.Array may be held across a
+# module boundary — not via module-scoped fixtures only, but ANY
+# mechanism (module-level globals, session-scoped fixtures, caches like
+# functools.lru_cache over device arrays). clear_backends() invalidates
+# every buffer created before it runs; a cross-module array surfaces
+# later as a confusing "deleted/donated buffer" error in an unrelated
+# test. Keep device state module-local, or re-create it per module.
 
 _MAP_RESET_THRESHOLD = 35_000
 

@@ -241,3 +241,33 @@ def test_tiled_prehaloed_parity(key):
     assert got.shape == (1, 64, 1024)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=0.05, atol=0.05)
+
+
+# -------------------------------------------------- real-TPU hardware gate
+
+@pytest.mark.tpu_hardware
+@pytest.mark.skipif("PBT_TPU_TESTS" not in __import__("os").environ,
+                    reason="set PBT_TPU_TESTS=1 to run against the real chip")
+def test_resident_order_parity_on_tpu_hardware():
+    """ADVICE r1: the resident-order out-map (output pinned to (b,0,0)
+    during non-finish sweeps) relies on Mosaic flush semantics that
+    interpret mode cannot exercise — run the exact C=1024 resident
+    configuration through Mosaic on the real chip. Spawned as a
+    subprocess because this suite's conftest pins the process to the
+    8-device CPU mesh."""
+    import os
+    import subprocess
+    import sys
+
+    child = os.path.join(os.path.dirname(__file__), "tpu_kernel_child.py")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    env["PYTHONPATH"] = (os.path.dirname(os.path.dirname(child))
+                         + os.pathsep + env.get("PYTHONPATH", ""))
+    out = subprocess.run([sys.executable, child], capture_output=True,
+                         text=True, env=env, timeout=900)
+    if out.returncode == 3:
+        pytest.skip("TPU backend unreachable (tunnel down)")
+    assert out.returncode == 0, out.stderr[-3000:]
+    assert "PARITY OK" in out.stdout, out.stdout

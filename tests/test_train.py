@@ -79,6 +79,44 @@ def test_warmup_crosses_reference_crash_point():
         assert int(out["state"].step) == 25
 
 
+def test_plateau_ignores_per_step_noise():
+    """VERDICT r1 Weak #1: per-step batch loss is noisy; the plateau
+    transform must not cut the LR while the WINDOWED loss is improving.
+    Round-1 behavior (accumulation_size=1) cut LR 10x after any 10
+    consecutive steps without a new best batch loss — routine noise."""
+    from proteinbert_tpu.train.schedule import make_optimizer
+
+    cfg = OptimizerConfig(
+        learning_rate=1e-3, warmup_steps=1, schedule="warmup_plateau",
+        plateau_window=20, plateau_patience=5, plateau_cooldown=5,
+    )
+    tx = make_optimizer(cfg)
+    params = {"w": jnp.zeros(3)}
+    grads = {"w": jnp.ones(3)}
+    state = tx.init(params)
+
+    def scale(state):
+        return float(state[-1].scale)
+
+    # Noisy but improving: per-step noise (std 0.2) dwarfs the per-step
+    # trend (0.005), so raw best-loss tracking stalls for >patience steps
+    # routinely — the round-1 failure. Windowed (/sqrt(20)) the trend
+    # dominates and no window sequence plateaus.
+    rng = np.random.default_rng(0)
+    for t in range(300):
+        loss = 3.0 - 0.005 * t + 0.2 * rng.standard_normal()
+        _, state = tx.update(grads, state, params, value=jnp.float32(loss))
+    assert scale(state) == 1.0, "LR was cut on noisy-but-improving loss"
+
+    # A genuine plateau (constant loss) MUST trigger: needs patience+1
+    # windows to fill and compare, plus slack for the cooldown machinery.
+    for _ in range(cfg.plateau_window * (cfg.plateau_patience + 2)):
+        _, state = tx.update(grads, state, params, value=jnp.float32(1.0))
+    assert scale(state) == pytest.approx(cfg.plateau_factor), (
+        "LR was not cut on a genuine plateau"
+    )
+
+
 def test_schedule_shapes():
     cfg = OptimizerConfig(learning_rate=1e-3, warmup_steps=100,
                           schedule="warmup_cosine", total_steps=1000)

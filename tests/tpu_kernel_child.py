@@ -1,0 +1,62 @@
+"""Child process for the REAL-TPU Pallas parity test (spawned by
+tests/test_kernels.py::test_resident_order_parity_on_tpu_hardware; not a
+pytest module).
+
+Why this exists (ADVICE r1): the C=1024 channel-tiled kernel's
+weights-RESIDENT grid order pins its output block to (b, 0, 0) during
+non-finish sweeps and relies on Mosaic's flush-on-block-index-change
+semantics. Interpret mode overwrites every block on the finish sweep, so
+a wrong out-map passes CPU parity tests and only corrupts output on real
+hardware — this child runs the exact resident configuration through
+Mosaic on a TPU and checks parity against the jax.nn composition.
+
+Prints "PARITY OK <max_abs_err>" on success; exits 3 when no TPU backend
+is reachable (the parent skips).
+"""
+
+import sys
+
+
+def main() -> None:
+    import jax
+
+    if jax.devices()[0].platform != "tpu":
+        print(f"no tpu: platform is {jax.devices()[0].platform}")
+        sys.exit(3)
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from proteinbert_tpu.configs import ModelConfig
+    from proteinbert_tpu.kernels import fused_local_track, local_track_reference
+    from proteinbert_tpu.kernels.fused_block import _plan_tiled
+    from proteinbert_tpu.models import proteinbert
+
+    # The Large-preset local track: C=1024 bf16, L long enough for
+    # several L tiles. The resident plan must exist here — if it stops
+    # existing, this test must fail loudly rather than silently test the
+    # fallback order.
+    C, L, B = 1024, 512, 2
+    tc, tile = _plan_tiled(C, L, "bfloat16", resident=True)
+    assert tc > 0, "no weights-resident plan at C=1024/L=512 — update test"
+
+    cfg = ModelConfig(local_dim=C, global_dim=64, key_dim=16, num_heads=4,
+                      num_blocks=1, num_annotations=32, dtype="bfloat16")
+    kp, kx, kb = jax.random.split(jax.random.PRNGKey(0), 3)
+    block = proteinbert.block_init(kp, cfg)
+    params = {k: block[k] for k in ("narrow_conv", "wide_conv", "local_ln1",
+                                    "local_dense", "local_ln2")}
+    x = jax.random.normal(kx, (B, L, C), jnp.bfloat16)
+    bcast = jax.random.normal(kb, (B, C), jnp.bfloat16)
+
+    got = np.asarray(
+        fused_local_track(params, x, bcast, 1, 5, False).astype(jnp.float32))
+    want = np.asarray(
+        local_track_reference(params, x, bcast, 1, 5).astype(jnp.float32))
+    err = float(np.max(np.abs(got - want)))
+    np.testing.assert_allclose(got, want, rtol=0.05, atol=0.05)
+    print(f"PARITY OK {err:.6f} (resident plan tc={tc} tile={tile})")
+
+
+if __name__ == "__main__":
+    main()

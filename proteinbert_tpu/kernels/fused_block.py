@@ -43,6 +43,17 @@ axis runs FASTEST so each conv weight slice stays resident across the
 whole L sweep (weight HBM traffic O(weights), not O(B·L/TL·weights));
 otherwise the per-row order runs with phase fastest. Shapes the tiled
 plan cannot fit either way fall back to the XLA path automatically.
+
+OFFICIAL SCOPE (round-2 decision, measured on v5e — BASELINE.md "Large-
+preset kernel decision"): the kernel is the right tool at C <= 512,
+where the full weight set is VMEM-resident and it wins 1.28x over
+non-remat XLA. At C = 1024 every schedule is weight-bandwidth-bound
+(38 MB of conv weights vs 16 MB VMEM) and the measured kernel is
+0.88-1.03x XLA, so the Large preset deliberately trains on the XLA path
+with remat_policy="convs" (+16% over full remat) and the tiled variant
+remains an opt-in (`model.use_pallas`) validated for correctness —
+including the Mosaic-only resident-order semantics — by
+tests/tpu_kernel_child.py on real hardware.
 """
 
 from __future__ import annotations

@@ -11,7 +11,12 @@ process_count>1 branch), and psum-under-jit gradient reduction across
 process boundaries — the plan SURVEY §5 (distributed backend bullet)
 prescribes, executed for real.
 
-Usage: python multihost_child.py <process_id> <num_processes> <port>
+Usage: python multihost_child.py <process_id> <num_processes> <port> [mode]
+mode: "plain" (default) — fixed-shape make_pretrain_iterator;
+      "bucketed" — make_bucketed_iterator, exercising the multi-host
+      LOCKSTEP invariant (every host must emit the same bucket shape at
+      every step or the collective step deadlocks/mismatches) across a
+      real process boundary.
 Prints one line per step: STEP <i> LOSS <float>  (process 0 only).
 """
 
@@ -20,6 +25,7 @@ import sys
 
 def main() -> None:
     process_id, num_processes, port = (int(a) for a in sys.argv[1:4])
+    mode = sys.argv[4] if len(sys.argv) > 4 else "plain"
 
     import jax
 
@@ -45,7 +51,8 @@ def main() -> None:
         TrainConfig,
     )
     from proteinbert_tpu.data import (
-        InMemoryPretrainingDataset, make_pretrain_iterator,
+        InMemoryPretrainingDataset, make_bucketed_iterator,
+        make_pretrain_iterator,
     )
     from proteinbert_tpu.data.synthetic import make_random_proteins
     from proteinbert_tpu.parallel import make_mesh, shard_train_state
@@ -68,22 +75,37 @@ def main() -> None:
     # Every process builds the same full dataset (same seed); the
     # iterator hands each its disjoint shard, exactly as on a pod.
     rng = np.random.default_rng(0)
-    seqs, ann = make_random_proteins(16, rng, num_annotations=32, max_len=40)
-    ds = InMemoryPretrainingDataset(seqs, ann, cfg.data.seq_len)
+    if mode == "bucketed":
+        # Long rows + crop_seed + two length buckets: every host must run
+        # the SAME bucket bookkeeping and emit the same shape per step.
+        seqs, ann = make_random_proteins(48, rng, num_annotations=32,
+                                         max_len=60)
+        ds = InMemoryPretrainingDataset(seqs, ann, cfg.data.seq_len,
+                                        crop_seed=7)
+        buckets = (16, cfg.data.seq_len)
+
+        def host_iter(pid, pcount, batch):
+            return make_bucketed_iterator(
+                ds, batch, buckets, seed=1,
+                process_index=pid, process_count=pcount)
+    else:
+        seqs, ann = make_random_proteins(16, rng, num_annotations=32,
+                                         max_len=40)
+        ds = InMemoryPretrainingDataset(seqs, ann, cfg.data.seq_len)
+
+        def host_iter(pid, pcount, batch):
+            return make_pretrain_iterator(
+                ds, batch, seed=1, process_index=pid, process_count=pcount)
+
     if num_processes > 1:
-        it = make_pretrain_iterator(
-            ds, cfg.data.batch_size, seed=1,
-            process_index=process_id, process_count=num_processes,
-        )
+        it = host_iter(process_id, num_processes, cfg.data.batch_size)
     else:
         # Reference mode: ONE process reproduces the exact global batch
         # the 2-process run assembles — host h's shard occupies the h-th
         # slice of the data axis, so the global batch is the
         # concatenation of both hosts' per-host batches.
         def concat_host_shards():
-            its = [make_pretrain_iterator(ds, global_batch // 2, seed=1,
-                                          process_index=p, process_count=2)
-                   for p in range(2)]
+            its = [host_iter(p, 2, global_batch // 2) for p in range(2)]
             while True:
                 parts = [next(i) for i in its]
                 yield {k: np.concatenate([p[k] for p in parts])

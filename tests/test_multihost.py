@@ -44,13 +44,18 @@ def _parse_losses(stdout: str):
 
 
 @pytest.mark.slow
-def test_two_process_training_matches_single_process():
+@pytest.mark.parametrize("mode", ["plain", "bucketed"])
+def test_two_process_training_matches_single_process(mode):
+    """`plain` drives fixed-shape batches; `bucketed` drives the
+    length-bucketed iterator, whose multi-host LOCKSTEP invariant (same
+    bucket shape on every host at every step) only a real process
+    boundary can falsify."""
     port = _free_port()
     env = _child_env()
 
     procs = [
         subprocess.Popen(
-            [sys.executable, _CHILD, str(pid), "2", str(port)],
+            [sys.executable, _CHILD, str(pid), "2", str(port), mode],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
             env=env, cwd=_REPO,
         )
@@ -70,7 +75,7 @@ def test_two_process_training_matches_single_process():
     dist_losses = _parse_losses(outs[0][1])
 
     single = subprocess.run(
-        [sys.executable, _CHILD, "0", "1", str(port)],
+        [sys.executable, _CHILD, "0", "1", str(port), mode],
         capture_output=True, text=True, env=env, cwd=_REPO, timeout=600,
     )
     assert single.returncode == 0, single.stderr[-3000:]

@@ -873,13 +873,16 @@ def build_parser() -> argparse.ArgumentParser:
         description="TPU-native ProteinBERT: ETL + pretraining CLI",
     )
     p.add_argument(
-        "--platform", choices=("cpu", "tpu", "axon"), default=None,
+        "--platform", choices=("cpu", "tpu", "axon"),
+        default=os.environ.get("PB_PLATFORM") or None,
         help="force the JAX backend (goes BEFORE the subcommand): cpu, "
              "tpu (local libtpu), or axon (tunneled TPU plugin). Needed "
              "when the accelerator is unreachable: images whose "
              "sitecustomize pins JAX_PLATFORMS ignore the env var, and a "
              "dead TPU tunnel then hangs every command at device init — "
-             "--platform cpu keeps the whole CLI usable",
+             "--platform cpu keeps the whole CLI usable. Defaults to the "
+             "PB_PLATFORM environment variable (the examples' knob) when "
+             "set",
     )
     sub = p.add_subparsers(dest="command", required=True)
 

@@ -207,6 +207,14 @@ def test_platform_flag(tmp_path):
     p = build_parser()
     assert p.parse_args(["--platform", "cpu", "smoke"]).platform == "cpu"
     assert p.parse_args(["smoke"]).platform is None
+    # PB_PLATFORM env (the examples' knob) is the flag's default, so any
+    # CLI invocation — not just full_workflow.sh — honors it.
+    import unittest.mock as mock
+
+    with mock.patch.dict("os.environ", {"PB_PLATFORM": "cpu"}):
+        assert build_parser().parse_args(["smoke"]).platform == "cpu"
+    with mock.patch.dict("os.environ", {"PB_PLATFORM": ""}):
+        assert build_parser().parse_args(["smoke"]).platform is None
     # End-to-end in a SUBPROCESS: forcing the platform initializes and
     # caches that backend set process-wide (restoring the config value
     # would not undo it), so the mutation must not happen in the pytest

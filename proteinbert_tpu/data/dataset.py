@@ -36,6 +36,13 @@ import numpy as np
 from proteinbert_tpu.data.transforms import epoch_crop_seed, tokenize_batch
 
 
+def _window_seed(crop_seed: Optional[int], epoch: int) -> Optional[int]:
+    """Per-epoch window seed, or None when cropping is disabled."""
+    if crop_seed is None:
+        return None
+    return epoch_crop_seed(crop_seed, epoch)
+
+
 class InMemoryPretrainingDataset:
     """Dense in-RAM dataset (reference data_processing.py:146-183 parity).
 
@@ -81,11 +88,6 @@ class InMemoryPretrainingDataset:
             self._long = None
         self.annotations = annotations.astype(np.float32)
 
-    def _window_seed(self, epoch: int) -> Optional[int]:
-        if self.crop_seed is None:
-            return None
-        return epoch_crop_seed(self.crop_seed, epoch)
-
     def row_lengths(self) -> np.ndarray:
         """(N,) tokenized lengths incl. <sos>/<eos> (crop-invariant)."""
         return (self.tokens != 0).sum(axis=1).astype(np.int64)
@@ -97,21 +99,23 @@ class InMemoryPretrainingDataset:
         if self._long is not None and self._long[i]:
             tok = tokenize_batch(
                 [self._long_seqs[i]], self.seq_len,
-                self._window_seed(0), np.array([i]))[0]
+                _window_seed(self.crop_seed, 0), np.array([i]))[0]
         else:
             tok = self.tokens[i]
         return {"tokens": tok, "annotations": self.annotations[i]}
 
     def get_batch(self, idx: np.ndarray, epoch: int = 0) -> Dict[str, np.ndarray]:
-        """Vectorized gather; long rows take their (epoch, row) window."""
+        """Vectorized gather; long rows take their (epoch, row) window,
+        re-tokenized in ONE batched call (not one call per row)."""
         tokens = self.tokens[idx]
         if self._long is not None:
-            seed = self._window_seed(epoch)
-            for pos in np.flatnonzero(self._long[idx]):
-                i = int(idx[pos])
-                tokens[pos] = tokenize_batch(
-                    [self._long_seqs[i]], self.seq_len, seed, np.array([i])
-                )[0]
+            positions = np.flatnonzero(self._long[idx])
+            if len(positions):
+                ids = np.asarray(idx)[positions]
+                tokens[positions] = tokenize_batch(
+                    [self._long_seqs[int(i)] for i in ids], self.seq_len,
+                    _window_seed(self.crop_seed, epoch), ids,
+                )
         return {"tokens": tokens, "annotations": self.annotations[idx]}
 
 
@@ -144,11 +148,6 @@ class HDF5PretrainingDataset:
         self.num_annotations = int(self._f["annotation_masks"].shape[1])
         self._cache: "collections.OrderedDict[int, tuple]" = collections.OrderedDict()
         self._cache_blocks = cache_blocks
-
-    def _window_seed(self, epoch: int) -> Optional[int]:
-        if self.crop_seed is None:
-            return None
-        return epoch_crop_seed(self.crop_seed, epoch)
 
     def __len__(self) -> int:
         return self._n
@@ -186,7 +185,7 @@ class HDF5PretrainingDataset:
         seqs, ann = self._load_block(i // self.BLOCK)
         j = i % self.BLOCK
         row = tokenize_batch([seqs[j]], self.seq_len,
-                             self._window_seed(0), np.array([i]))[0]
+                             _window_seed(self.crop_seed, 0), np.array([i]))[0]
         return {"tokens": row, "annotations": ann[j]}
 
     def get_batch(self, idx: np.ndarray, epoch: int = 0) -> Dict[str, np.ndarray]:
@@ -202,7 +201,7 @@ class HDF5PretrainingDataset:
             ann_out[pos] = ann[j]
         return {
             "tokens": tokenize_batch(
-                seqs_out, self.seq_len, self._window_seed(epoch),
+                seqs_out, self.seq_len, _window_seed(self.crop_seed, epoch),
                 np.asarray(idx, np.int64)),
             "annotations": np.stack(ann_out),
         }

@@ -74,6 +74,12 @@ def crop_starts(
     return np.where(lengths > cap, (r % span).astype(np.int64), 0)
 
 
+def crop_start(length: int, cap: int, crop_seed: int, row_id: int = 0) -> int:
+    """Scalar form of `crop_starts` for single-row callers."""
+    return int(crop_starts(np.array([length]), cap, crop_seed,
+                           np.array([row_id]))[0])
+
+
 def random_crop(
     seq: str, max_residues: int, crop_seed: int, row_id: int = 0
 ) -> str:
@@ -82,8 +88,7 @@ def random_crop(
     function of its inputs)."""
     if len(seq) <= max_residues:
         return seq
-    start = int(crop_starts(
-        np.array([len(seq)]), max_residues, crop_seed, np.array([row_id]))[0])
+    start = crop_start(len(seq), max_residues, crop_seed, row_id)
     return seq[start : start + max_residues]
 
 
@@ -99,11 +104,8 @@ def tokenize(
     vocab = get_vocab()
     cap = seq_len - 2
     if len(seq) > cap:
-        if crop_seed is not None:
-            start = int(crop_starts(
-                np.array([len(seq)]), cap, crop_seed, np.array([row_id]))[0])
-        else:
-            start = 0
+        start = (crop_start(len(seq), cap, crop_seed, row_id)
+                 if crop_seed is not None else 0)
         seq = seq[start : start + cap]
     ids = vocab.encode(seq)
     out = np.full(seq_len, PAD_ID, dtype=np.int32)

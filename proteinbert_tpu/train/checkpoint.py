@@ -30,6 +30,14 @@ class Checkpointer:
                 max_to_keep=max_to_keep,
                 enable_async_checkpointing=async_save,
             ),
+            # Registering the per-item handlers up front lets
+            # item_metadata() (used by restore to detect the optional
+            # 'data' item) resolve without orbax's "could not be
+            # restored" warning on every CLI restore.
+            item_handlers={
+                "state": ocp.StandardCheckpointHandler(),
+                "data": ocp.JsonCheckpointHandler(),
+            },
         )
 
     def save(self, step: int, state: Any, data_state: Optional[Dict] = None) -> None:

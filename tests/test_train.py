@@ -211,13 +211,16 @@ def test_checkpoint_resume(tmp_path):
     )
 
 
-def test_checkpoint_resume_is_exact_with_cropping(tmp_path):
+@pytest.mark.parametrize("schedule", ["warmup_cosine", "warmup_plateau"])
+def test_checkpoint_resume_is_exact_with_cropping(tmp_path, schedule):
     """VERDICT r1 Weak #3, end to end: with LONG sequences re-cropped per
     epoch (crop_seed), a run resumed through the orbax checkpointer must
     reproduce the uninterrupted run EXACTLY — bit-equal losses, not just
     close. Counter-based windows + checkpointed RNG + replayed epoch
-    permutations make every post-resume batch byte-identical."""
-    cfg = smoke_cfg(max_steps=20)
+    permutations make every post-resume batch byte-identical. The
+    plateau variant additionally pins the reduce_on_plateau state
+    (windowed average, counters, scale) through the orbax round trip."""
+    cfg = smoke_cfg(max_steps=20, schedule=schedule)
     cfg = cfg.replace(train=TrainConfig(max_steps=20, log_every=1))
     rng = np.random.default_rng(3)
     # All sequences longer than seq_len-2 -> every row takes a crop window.
@@ -237,12 +240,20 @@ def test_checkpoint_resume_is_exact_with_cropping(tmp_path):
                         checkpoint=CheckpointConfig(every_steps=12,
                                                     async_save=False))
     ck1 = Checkpointer(str(tmp_path / "ck"), async_save=False)
-    pretrain(cfg_a, fresh_iter(), checkpointer=ck1)
+    partial = pretrain(cfg_a, fresh_iter(), checkpointer=ck1)
     ck1.close()
 
     ck2 = Checkpointer(str(tmp_path / "ck"), async_save=False)
     state = create_train_state(jax.random.PRNGKey(cfg.train.seed), cfg)
     state, data_state = ck2.restore(state)
+    # EVERY leaf of the state — params, Adam moments, schedule/plateau
+    # counters, RNG key — must round-trip bit-exactly; the loss check
+    # below can't see e.g. a corrupted plateau accumulator while the LR
+    # scale is still 1.0.
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        partial["state"], state)
     resumed = pretrain(cfg, fresh_iter(data_state["batches_consumed"]),
                        state=state)
     ck2.close()

@@ -81,6 +81,33 @@ def test_crop_windows_independent_of_batch_composition():
         tokenize(long, 32, crop_seed=11, row_id=42), alone)
 
 
+def test_crop_starts_bounds_and_coverage():
+    """Property test of the window primitive: starts are always within
+    [0, len-cap]; the boundary length len==cap never crops; len==cap+1
+    draws both of its two legal windows across rows; and large lengths
+    cover the full start range rather than clustering."""
+    from proteinbert_tpu.data.transforms import crop_starts
+
+    cap = 30
+    rng = np.random.default_rng(0)
+    lengths = rng.integers(0, 500, size=2000)
+    row_ids = np.arange(2000)
+    starts = crop_starts(lengths, cap, 123, row_ids)
+    assert (starts >= 0).all()
+    over = lengths > cap
+    assert (starts[~over] == 0).all()
+    assert (starts[over] <= lengths[over] - cap).all()
+
+    # len == cap + 1: exactly two legal windows, both must occur.
+    two = crop_starts(np.full(200, cap + 1), cap, 9, np.arange(200))
+    assert set(np.unique(two)) == {0, 1}
+
+    # Large fixed length: starts spread over most of the legal range.
+    wide = crop_starts(np.full(500, 400), cap, 7, np.arange(500))
+    assert wide.max() > 300 and wide.min() < 50
+    assert len(np.unique(wide)) > 100
+
+
 def test_epoch_crop_seed_varies_and_is_stable():
     from proteinbert_tpu.data.transforms import epoch_crop_seed
 

@@ -50,3 +50,26 @@ def test_example_inputs_feed_the_etl(tmp_path):
         kind="sequence_classification", seq_len=128)
     assert tokens.shape[0] == labels.shape[0] > 0
     assert len(set(int(l) for l in labels)) == 2
+
+
+def test_etl_scale_rehearsal_script(tmp_path):
+    """The scale-rehearsal script (examples/etl_scale_rehearsal.py) must
+    keep running end to end and emitting its JSON summary — guarded at
+    tiny N so the suite stays fast; the recorded 100k numbers live in
+    BASELINE.md."""
+    import json
+
+    script = _GENERATOR.parent / "etl_scale_rehearsal.py"
+    out = subprocess.run(
+        [sys.executable, str(script), "300", str(tmp_path / "rehearsal")],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert out.returncode == 0, out.stderr[-1500:]
+    summary = json.loads(out.stdout.strip().splitlines()[-1])
+    assert summary["n_entries"] == 300
+    assert summary["rows_in_h5"] == 300
+    assert set(summary["stages"]) == {"generate", "xml_to_sqlite",
+                                      "fasta_index", "h5_build"}
+    assert summary["pipeline_entries_per_sec"] > 0
+    # Artifacts kept because an out_dir was passed explicitly.
+    assert (tmp_path / "rehearsal" / "dataset.h5").exists()

@@ -65,7 +65,13 @@ def crop_starts(
     lengths: np.ndarray, cap: int, crop_seed: int, row_ids: np.ndarray
 ) -> np.ndarray:
     """(B,) window starts: splitmix64(seed + row_id) % (len - cap + 1)
-    for rows longer than `cap`, 0 otherwise. Mirrors tokenizer.cpp."""
+    for rows longer than `cap`, 0 otherwise. Mirrors tokenizer.cpp.
+
+    Divergence note: the span is len-cap+1 INCLUSIVE of the final legal
+    window. The reference's SentenceRandomCrop never samples the last
+    window (torch.randint's high is exclusive, reference
+    data_processing.py:82) — a deliberate off-by-one fix here, so the
+    sequence tail is reachable."""
     lengths = np.asarray(lengths, np.int64)
     with np.errstate(over="ignore"):
         r = splitmix64(_U64(crop_seed & 0xFFFFFFFFFFFFFFFF)
@@ -92,6 +98,18 @@ def random_crop(
     return seq[start : start + max_residues]
 
 
+def _encode_row(out_row: np.ndarray, seq: str, cap: int, start: int, vocab) -> None:
+    """Shared crop→encode→sos/eos body of `tokenize` and the numpy path of
+    `tokenize_batch` — ONE copy so the two paths cannot drift (they are
+    parity-tested against each other and against the C++ kernel)."""
+    if len(seq) > cap:
+        seq = seq[start : start + cap]
+    ids = vocab.encode(seq)
+    out_row[0] = SOS_ID
+    out_row[1 : 1 + len(ids)] = ids
+    out_row[1 + len(ids)] = EOS_ID
+
+
 def tokenize(
     seq: str,
     seq_len: int,
@@ -101,17 +119,11 @@ def tokenize(
     """Crop → encode → add <sos>/<eos> → pad to `seq_len`. Returns
     (seq_len,) int32. With `crop_seed`, long sequences take the
     counter-based window for (crop_seed, row_id); else head-truncate."""
-    vocab = get_vocab()
     cap = seq_len - 2
-    if len(seq) > cap:
-        start = (crop_start(len(seq), cap, crop_seed, row_id)
-                 if crop_seed is not None else 0)
-        seq = seq[start : start + cap]
-    ids = vocab.encode(seq)
+    start = (crop_start(len(seq), cap, crop_seed, row_id)
+             if crop_seed is not None and len(seq) > cap else 0)
     out = np.full(seq_len, PAD_ID, dtype=np.int32)
-    out[0] = SOS_ID
-    out[1 : 1 + len(ids)] = ids
-    out[1 + len(ids)] = EOS_ID
+    _encode_row(out, seq, cap, start, get_vocab())
     return out
 
 
@@ -157,10 +169,5 @@ def tokenize_batch(
         starts = np.zeros(len(seqs), np.int64)
     vocab = get_vocab()
     for i, s in enumerate(seqs):
-        if len(s) > cap:
-            s = s[starts[i] : starts[i] + cap]
-        ids = vocab.encode(s)
-        out[i, 0] = SOS_ID
-        out[i, 1 : 1 + len(ids)] = ids
-        out[i, 1 + len(ids)] = EOS_ID
+        _encode_row(out[i], s, cap, int(starts[i]), vocab)
     return out

@@ -20,6 +20,7 @@ def _lib():
     global _configured
     lib = load_library("tokenizer")
     if lib is not None and not _configured:
+        lib.pbt_abi_version.restype = ctypes.c_int32  # explicit, not c_int
         got = lib.pbt_abi_version()
         if got != _ABI_VERSION:
             # Loud and permanent: stale argtypes against a changed C

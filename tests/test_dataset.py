@@ -136,6 +136,58 @@ def test_inmemory_recrops_long_rows_per_epoch():
         ds[0]["tokens"], ds.get_batch(np.array([0]), epoch=0)["tokens"][0])
 
 
+def test_single_row_and_batched_paths_agree_every_epoch(tmp_path):
+    """`ds[i]` / `ds.get_row(i, epoch)` must equal `get_batch([i], epoch)`
+    for EVERY epoch on all three dataset surfaces (in-memory, HDF5,
+    Subset) — the single-row path used to pin epoch 0 while get_batch
+    varied windows per epoch (VERDICT r2 Weak #4 / item 6)."""
+    import h5py
+
+    from proteinbert_tpu.data.dataset import (
+        HDF5PretrainingDataset, Subset,
+    )
+
+    rng = np.random.default_rng(0)
+    # Mix of short rows and rows long enough to be re-cropped per epoch.
+    seqs = ["".join(rng.choice(list("ACDEFGHIKLMNPQRSTVWY"),
+                               size=int(n)))
+            for n in rng.integers(5, 200, size=12)]
+    ann = (rng.random((12, 6)) < 0.3).astype(np.float32)
+
+    path = tmp_path / "rows.h5"
+    with h5py.File(path, "w") as f:
+        sd = h5py.string_dtype()
+        f.create_dataset("seqs", data=np.array(seqs, dtype=object), dtype=sd)
+        f.create_dataset("seq_lengths",
+                         data=np.array([len(s) for s in seqs], np.int32))
+        f.create_dataset("annotation_masks", data=ann.astype(bool))
+
+    mem = InMemoryPretrainingDataset(seqs, ann, seq_len=32, crop_seed=7)
+    h5 = HDF5PretrainingDataset(str(path), seq_len=32, crop_seed=7)
+    sub = Subset(mem, np.array([0, 3, 5, 7, 11]))
+    try:
+        for ds, n in ((mem, 12), (h5, 12), (sub, 5)):
+            for i in (0, n - 1, n // 2):
+                for epoch in range(4):
+                    batch = ds.get_batch(np.array([i]), epoch=epoch)
+                    row = ds.get_row(i, epoch=epoch)
+                    for k in ("tokens", "annotations"):
+                        np.testing.assert_array_equal(row[k], batch[k][0])
+                # bare [] is the epoch-0 view of the SAME path
+                np.testing.assert_array_equal(
+                    ds[i]["tokens"],
+                    ds.get_batch(np.array([i]), epoch=0)["tokens"][0])
+            # windows genuinely vary somewhere across epochs (else the
+            # equality above would be vacuous for the re-crop machinery)
+            long_rows = [i for i, s in enumerate(seqs) if len(s) > 30]
+            assert long_rows
+        i = long_rows[0]
+        assert len({mem.get_row(i, epoch=e)["tokens"].tobytes()
+                    for e in range(8)}) > 1
+    finally:
+        h5.close()
+
+
 def test_iterator_epoch_windows_and_resume_are_byte_identical():
     """End-to-end over the iterator: (a) crop windows differ across
     epochs; (b) an iterator restarted with skip_batches yields EXACTLY
@@ -156,6 +208,33 @@ def test_iterator_epoch_windows_and_resume_are_byte_identical():
     resumed = [b["tokens"].tobytes() for b in make_pretrain_iterator(
         ds2, 4, seed=9, num_epochs=3, skip_batches=3)]
     assert resumed == full[3:], "resume is not byte-identical"
+
+
+def test_structured_proteins_properties():
+    """The transfer-experiment corpus generator: deterministic for a
+    seed, states aligned with sequences, annotations = 3-mer occurrence
+    bits, and the hidden state only WEAKLY decodable per residue (the
+    property that makes frozen-trunk probing discriminate context-
+    integrating features from random ones)."""
+    from proteinbert_tpu.data.synthetic import (
+        _STATE_RESIDUES, make_structured_proteins,
+    )
+
+    a = make_structured_proteins(50, np.random.default_rng(4),
+                                 num_annotations=32, max_len=100)
+    b = make_structured_proteins(50, np.random.default_rng(4),
+                                 num_annotations=32, max_len=100)
+    assert a[0] == b[0] and (a[1] == b[1]).all()
+    seqs, ann, states = a
+    assert ann.shape == (50, 32) and 0 < ann.mean() < 0.2
+    hydro = set(_STATE_RESIDUES[0])
+    accs = []
+    for s, st in zip(seqs, states):
+        assert len(s) == len(st) and set(np.unique(st)) <= {0, 1}
+        pred = np.fromiter((c in hydro for c in s), bool, len(s))
+        accs.append(float((pred == (np.asarray(st) == 0)).mean()))
+    acc = float(np.mean(accs))
+    assert 0.7 < acc < 0.95, f"single-residue decodability {acc} out of band"
 
 
 def test_row_lengths():

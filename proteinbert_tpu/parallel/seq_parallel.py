@@ -237,6 +237,9 @@ def make_seq_parallel_train_step(mesh: Mesh, cfg: PretrainConfig):
         )
         metrics = dict(metrics)
         metrics["grad_norm"] = optax.global_norm(grads)
+        from proteinbert_tpu.train.schedule import effective_lr
+
+        metrics["lr"] = effective_lr(cfg.optimizer, opt_state, state.step)
         return ts.TrainState(step=state.step + 1, params=params,
                              opt_state=opt_state, key=key), metrics
 

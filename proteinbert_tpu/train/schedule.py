@@ -74,3 +74,17 @@ def make_optimizer(cfg: OptimizerConfig) -> optax.GradientTransformation:
 def needs_loss_value(cfg: OptimizerConfig) -> bool:
     """True if the optimizer's update requires `value=loss` (plateau)."""
     return cfg.schedule == "warmup_plateau"
+
+
+def effective_lr(cfg: OptimizerConfig, opt_state, step):
+    """The LR in effect at update-count `step` — schedule value times the
+    plateau transform's current scale when schedule == 'warmup_plateau'.
+    Pure jnp arithmetic over opt_state leaves, so it runs inside the
+    jitted train step; logged per step like the reference's per-iteration
+    LR line (reference utils.py:306-313)."""
+    lr = make_schedule(cfg)(step)
+    if cfg.schedule == "warmup_plateau":
+        # optax.chain state is a tuple aligned with the transform list;
+        # reduce_on_plateau is always appended last for this schedule.
+        lr = lr * opt_state[-1].scale
+    return lr

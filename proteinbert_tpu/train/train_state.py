@@ -30,8 +30,12 @@ import optax
 from proteinbert_tpu.configs import PretrainConfig
 from proteinbert_tpu.models import proteinbert
 from proteinbert_tpu.data.corruption import corrupt_batch
-from proteinbert_tpu.train.loss import global_ranking_metrics, pretrain_loss
-from proteinbert_tpu.train.schedule import make_optimizer, needs_loss_value
+from proteinbert_tpu.train.loss import (
+    global_ranking_metrics, global_ranking_stats, pretrain_loss,
+)
+from proteinbert_tpu.train.schedule import (
+    effective_lr, make_optimizer, needs_loss_value,
+)
 
 
 @flax.struct.dataclass
@@ -99,6 +103,7 @@ def train_step(
 
     metrics = dict(metrics)
     metrics["grad_norm"] = optax.global_norm(grads)
+    metrics["lr"] = effective_lr(cfg.optimizer, opt_state, state.step)
     new_state = TrainState(
         step=state.step + 1, params=params, opt_state=opt_state, key=key
     )
@@ -126,7 +131,12 @@ def eval_step(
     )
     _, metrics = pretrain_loss(local_logits, global_logits, Y, W)
     # Ranking quality of the GO head — eval-only (kept out of the hot
-    # train step; the trainer prefixes these with eval_).
+    # train step; the trainer prefixes these with eval_). global_auroc /
+    # global_p_at_k are the EXACT in-batch values; ranking_stats is the
+    # mergeable histogram evaluate_batches pools into the split-level
+    # metrics (a dataset AUROC is not a mean of batch AUROCs).
     metrics.update(global_ranking_metrics(
         global_logits, Y["global"], W["global"]))
+    metrics["ranking_stats"] = global_ranking_stats(
+        global_logits, Y["global"], W["global"])
     return metrics

@@ -244,29 +244,48 @@ def evaluate_batches(
     state, batches, put, cfg: PretrainConfig, base_key: jax.Array,
     prefix: str = "eval_", max_batches: int = 0,
 ):
-    """Row-weighted mean of eval_step metrics over `batches` (each batch
-    keyed by fold_in(base_key, batch_index) → reproducible). Returns
-    (metrics dict, n_batches, n_rows). Row weighting matters only when
-    batch sizes differ (the standalone CLI's tail batch); for the
-    uniform batches of the in-training eval it equals the plain mean."""
+    """Eval metrics over `batches` (each batch keyed by
+    fold_in(base_key, batch_index) → reproducible). Returns
+    (metrics dict, n_batches, n_rows).
+
+    Loss/accuracy metrics are the row-weighted mean of the per-batch
+    values (weighting matters only when batch sizes differ — the
+    standalone CLI's tail batch). The ranking metrics global_auroc /
+    global_p_at_k are POOLED at the split level from each batch's
+    mergeable sufficient statistics (loss.global_ranking_stats): a
+    dataset micro-AUROC is a property of the joint score distribution,
+    not a mean of per-batch AUROCs (VERDICT r2 Weak #5). The per-batch
+    means of the exact in-batch values remain available, renamed
+    *_batch_mean."""
     if max_batches:
         # Cap BEFORE pulling: the for-loop must not fetch (and discard)
         # one extra batch's worth of HDF5 reads + tokenization.
         import itertools
 
         batches = itertools.islice(batches, max_batches)
+    from proteinbert_tpu.train.loss import ranking_metrics_from_stats
+
+    pooled = ("global_auroc", "global_p_at_k")
     sums: Dict[str, float] = {}
+    rank_stats = None
     n = 0
     rows = 0
     for batch in batches:
         b_rows = len(next(iter(batch.values())))
-        m = ts.eval_step(state, put(batch),
-                         jax.random.fold_in(base_key, n), cfg)
+        m = dict(ts.eval_step(state, put(batch),
+                              jax.random.fold_in(base_key, n), cfg))
+        stats = jax.device_get(m.pop("ranking_stats"))
+        rank_stats = stats if rank_stats is None else jax.tree.map(
+            lambda a, b: a + b, rank_stats, stats)
         for k, v in m.items():
-            sums[k] = sums.get(k, 0.0) + float(v) * b_rows
+            key = f"{k}_batch_mean" if k in pooled else k
+            sums[key] = sums.get(key, 0.0) + float(v) * b_rows
         n += 1
         rows += b_rows
     metrics = {f"{prefix}{k}": v / max(rows, 1) for k, v in sums.items()}
+    if rank_stats is not None:
+        metrics.update({f"{prefix}{k}": v for k, v in
+                        ranking_metrics_from_stats(rank_stats).items()})
     return metrics, n, rows
 
 

@@ -60,15 +60,20 @@ def probe():
 def main():
     # Single-instance guard: two daemons probe-succeeding together would
     # run contending sweeps on the one chip and persist skewed timings.
+    # The pid must still belong to a tpu_watch process — a bare
+    # /proc/<pid> check would lock new watchers out forever once the OS
+    # recycles an exited watcher's pid.
     if os.path.exists(STATUS_PATH):
         try:
             prev = json.load(open(STATUS_PATH))
             pid = prev.get("pid")
-            if pid and pid != os.getpid() and os.path.exists(
-                    f"/proc/{pid}"):
-                print(f"[tpu_watch] another watcher (pid {pid}) is "
-                      "alive; exiting", flush=True)
-                return 2
+            if pid and pid != os.getpid():
+                with open(f"/proc/{pid}/cmdline", "rb") as f:
+                    cmdline = f.read().decode(errors="replace")
+                if "tpu_watch" in cmdline:
+                    print(f"[tpu_watch] another watcher (pid {pid}) is "
+                          "alive; exiting", flush=True)
+                    return 2
         except (OSError, ValueError):
             pass
     t0 = time.time()

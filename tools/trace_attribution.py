@@ -1,0 +1,219 @@
+"""Step-time attribution from a jax.profiler device trace.
+
+The docs/performance.md method, made a runnable tool (VERDICT r2 item 8:
+"chase the next MFU step with the trace, not intuition"): capture a
+device trace of a few train steps, parse the perfetto trace.json.gz the
+profiler writes (plain JSON — no TF/tensorboard dependency), aggregate
+device-lane event durations per HLO op name, and report the top ops
+plus a category rollup (convs, dots, dynamic-update-slice saves, layout
+transposes/copies, collectives, elementwise fusions) normalized per
+step. The categories map directly onto the knobs: remat policy (saves),
+scan boundaries (transposes), sharding (collectives).
+
+Usage (real chip):
+  python tools/trace_attribution.py --seq-len 1024 --batch 256 --steps 3
+CPU smoke:
+  PBT_TRACE_CPU=1 python tools/trace_attribution.py --tiny --steps 2
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import gzip
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+CATEGORIES = (
+    # NB: no bare "conv" key — it would swallow HLO "convert" cast ops.
+    ("convolution", ("convolution",)),
+    ("dot/matmul", ("dot", "gemm", "matmul")),
+    ("dynamic-update-slice (scan saves)", ("dynamic-update-slice",
+                                           "dynamic_update_slice")),
+    ("transpose/copy (layout)", ("transpose", "copy", "bitcast")),
+    ("collectives", ("all-reduce", "all-gather", "reduce-scatter",
+                     "collective", "psum")),
+    ("reduce/softmax", ("reduce", "softmax")),
+    ("rng/corruption", ("rng", "threefry", "bernoulli")),
+)
+
+
+def categorize(name: str) -> str:
+    low = name.lower()
+    for cat, keys in CATEGORIES:
+        if any(k in low for k in keys):
+            return cat
+    return "other fusions/elementwise"
+
+
+def parse_trace(trace_dir: str):
+    """{op name: total device-lane µs} from the newest trace.json.gz."""
+    paths = sorted(glob.glob(os.path.join(
+        trace_dir, "**", "*.trace.json.gz"), recursive=True),
+        key=os.path.getmtime)
+    if not paths:
+        raise SystemExit(f"no trace.json.gz under {trace_dir}")
+    with gzip.open(paths[-1], "rt") as f:
+        data = json.load(f)
+    events = data.get("traceEvents", [])
+    # Lane discovery. Summing every span in a device pid double-counts:
+    # the pid carries an "XLA Modules"/"Steps" lane whose one
+    # jit_train_step span covers the whole step ALONGSIDE the per-op
+    # "XLA Ops" lane, plus runtime wrapper spans. Prefer threads whose
+    # name says "XLA Ops"; only they carry leaf-op attribution.
+    pid_name = {}
+    tid_name = {}
+    for e in events:
+        if e.get("ph") != "M":
+            continue
+        if e.get("name") == "process_name":
+            pid_name[e["pid"]] = e["args"].get("name", "")
+        elif e.get("name") == "thread_name":
+            tid_name[(e["pid"], e.get("tid"))] = e["args"].get("name", "")
+    device_pids = {p for p, n in pid_name.items()
+                   if any(k in n.lower() for k in ("tpu", "device",
+                                                   "/device:", "xla"))
+                   and "host" not in n.lower()}
+    if not device_pids:
+        # CPU runs have no device lane; the host lane carries the XLA
+        # ops there (smoke mode for this tool — attribution still works,
+        # timings just include host scheduling).
+        device_pids = {p for p, n in pid_name.items()
+                       if "cpu" in n.lower()}
+        if device_pids:
+            print("note: no TPU lane; attributing the host CPU lane",
+                  file=sys.stderr)
+    op_lanes = {(p, t) for (p, t), n in tid_name.items()
+                if p in device_pids and "xla ops" in n.lower()}
+
+    def in_scope(e):
+        if e.get("pid") not in device_pids:
+            return False
+        if op_lanes:
+            return (e.get("pid"), e.get("tid")) in op_lanes
+        return True
+
+    _WRAPPERS = ("execute", "thunk", "pjitfunction", "parsearguments",
+                 "collectgarbage", "lower_sharding", "trace_to_jaxpr",
+                 "compile")
+    per_op: dict = collections.Counter()
+    for e in events:
+        if e.get("ph") != "X" or not in_scope(e):
+            continue
+        name = e.get("name", "?")
+        low = name.lower()
+        # Host python frames / runtime wrapper spans / "end:" markers
+        # enclose the op events — counting them double-counts the step.
+        if (name.startswith("$") or ".py:" in name
+                or name.startswith("end:")
+                or any(w in low for w in _WRAPPERS)):
+            continue
+        per_op[name] += e.get("dur", 0)
+    if not per_op:
+        lanes = sorted(set(pid_name.values()))
+        raise SystemExit(
+            f"no XLA op events found; lanes: {lanes}. (CPU-backend "
+            "traces often carry only python/runtime spans — op-level "
+            "attribution needs the real TPU's 'XLA Ops' lane.)")
+    return per_op
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seq-len", type=int, default=1024)
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--steps", type=int, default=None,
+                    help="steps to trace (default 3); REQUIRED with "
+                         "--parse-only, where it must state how many "
+                         "steps the existing trace holds")
+    ap.add_argument("--tiny", action="store_true",
+                    help="tiny model (CPU smoke of the tool itself)")
+    ap.add_argument("--use-pallas", action="store_true")
+    ap.add_argument("--no-remat", action="store_true")
+    ap.add_argument("--outdir", default="/tmp/pbt_trace")
+    ap.add_argument("--top", type=int, default=25)
+    ap.add_argument("--parse-only", metavar="DIR",
+                    help="skip running; parse an existing trace dir")
+    args = ap.parse_args()
+
+    if args.parse_only:
+        if args.steps is None:
+            raise SystemExit("--parse-only needs an explicit --steps "
+                             "(the step count of the existing trace; "
+                             "ms/step is total/steps)")
+        per_op = parse_trace(args.parse_only)
+        steps = args.steps
+    else:
+        args.steps = 3 if args.steps is None else args.steps
+        import jax
+        import numpy as np
+
+        if os.environ.get("PBT_TRACE_CPU"):
+            jax.config.update("jax_platforms", "cpu")
+
+        from proteinbert_tpu.configs import (
+            DataConfig, ModelConfig, OptimizerConfig, PretrainConfig,
+            TrainConfig,
+        )
+        from proteinbert_tpu.train import create_train_state, train_step
+        from proteinbert_tpu.utils.profiling import device_trace
+
+        if args.tiny:
+            model = ModelConfig(local_dim=32, global_dim=64, key_dim=16,
+                                num_heads=4, num_blocks=2,
+                                num_annotations=128, dtype="float32")
+            args.batch = min(args.batch, 8)
+            args.seq_len = min(args.seq_len, 128)
+        else:
+            model = ModelConfig(local_dim=512, global_dim=512, key_dim=64,
+                                num_heads=8, num_blocks=6, dtype="bfloat16",
+                                remat=not args.no_remat,
+                                remat_policy="convs",
+                                use_pallas=args.use_pallas)
+        cfg = PretrainConfig(
+            model=model,
+            data=DataConfig(seq_len=args.seq_len, batch_size=args.batch),
+            optimizer=OptimizerConfig(warmup_steps=100),
+            train=TrainConfig(max_steps=args.steps))
+        rng = np.random.default_rng(0)
+        batch = {
+            "tokens": rng.integers(
+                4, 26, size=(args.batch, args.seq_len)).astype(np.int32),
+            "annotations": (rng.random(
+                (args.batch, model.num_annotations)) < 0.01
+            ).astype(np.float32),
+        }
+        state = create_train_state(jax.random.PRNGKey(0), cfg)
+        batch = jax.device_put(batch)
+        state, m = train_step(state, batch, cfg)  # compile + settle
+        float(m["loss"])
+        with device_trace(args.outdir):
+            for _ in range(args.steps):
+                state, m = train_step(state, batch, cfg)
+            float(m["loss"])  # hard sync inside the trace window
+        per_op = parse_trace(args.outdir)
+        steps = args.steps
+
+    total_us = sum(per_op.values())
+    cats: dict = collections.Counter()
+    for name, us in per_op.items():
+        cats[categorize(name)] += us
+    print(f"\n== device time: {total_us / 1e3 / steps:.2f} ms/step over "
+          f"{steps} steps ==\n")
+    print("-- categories --")
+    for cat, us in cats.most_common():
+        print(f"{us / 1e3 / steps:9.2f} ms/step  {100 * us / total_us:5.1f}%"
+              f"  {cat}")
+    print(f"\n-- top {args.top} ops --")
+    for name, us in per_op.most_common(args.top):
+        print(f"{us / 1e3 / steps:9.2f} ms/step  {100 * us / total_us:5.1f}%"
+              f"  {name[:110]}")
+
+
+if __name__ == "__main__":
+    main()

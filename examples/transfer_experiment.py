@@ -46,6 +46,12 @@ SCALES = {
                  num_blocks=2, seq_len=128, batch=16, steps=400,
                  corpus=1024, train_rows=32, eval_rows=128, epochs=40,
                  head_lr=3e-3),
+    # CPU-runnable in ~1 h — the recorded fallback when the TPU tunnel
+    # is down for the whole session.
+    "small": dict(local_dim=128, global_dim=256, key_dim=32, num_heads=4,
+                  num_blocks=3, seq_len=256, batch=32, steps=1000,
+                  corpus=4096, train_rows=48, eval_rows=256, epochs=40,
+                  head_lr=3e-3),
     "full": dict(local_dim=256, global_dim=512, key_dim=64, num_heads=8,
                  num_blocks=4, seq_len=512, batch=64, steps=4000,
                  corpus=16384, train_rows=64, eval_rows=512, epochs=40,
@@ -122,10 +128,11 @@ def main():
     ap.add_argument("--steps", type=int, help="override pretrain steps")
     ap.add_argument("--platform", choices=("cpu", "tpu", "axon"),
                     help="forwarded to every CLI call; defaults to cpu "
-                         "at --scale mini (a dead TPU tunnel otherwise "
-                         "hangs the subprocesses at device init)")
+                         "for the CPU-sized scales (a dead TPU tunnel "
+                         "otherwise hangs the subprocesses at device "
+                         "init)")
     args = ap.parse_args()
-    platform = args.platform or ("cpu" if args.scale == "mini" else None)
+    platform = args.platform or ("cpu" if args.scale != "full" else None)
     S = dict(SCALES[args.scale])
     if args.steps:
         S["steps"] = args.steps

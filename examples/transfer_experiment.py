@@ -20,8 +20,10 @@ Protocol (every phase is a REAL CLI subprocess, not an in-process call):
   5. Print ONE JSON line: per-task pretrained/random best eval scores
      and the gaps.
 
-Scales: --scale mini (CPU, ~2 min, used by the test suite) or
---scale full (the recorded run; TPU-sized model/steps).
+Scales: --scale mini (CPU, ~15 min — the smoke of this harness),
+--scale small (CPU, a few hours — the recorded fallback when the TPU
+tunnel is down; defaults --platform cpu like mini), or --scale full
+(the recorded run; TPU-sized model/steps).
 """
 
 from __future__ import annotations

@@ -196,3 +196,75 @@ def test_multislice_mesh_rejects_indivisible_data_axis():
     devs = [_FakeTpuDev(i, i // 4) for i in range(8)]
     with pytest.raises(ValueError, match="multiple of the 2 slices"):
         make_mesh(MeshConfig(data=1, fsdp=2, model=2, seq=2), devs)
+
+
+def test_fsdp_compile_has_no_involuntary_remat_warning():
+    """The fsdp-bearing mesh must compile the train step without the SPMD
+    partitioner's "Involuntary full rematerialization" fallback (VERDICT
+    r2 Weak #3: the scan-boundary stash of per-block bf16 param casts
+    used to trigger it; fixed by hoisting the cast out of the scan and
+    FSDP-sharding stacked-block leaves on their LAST divisible axis).
+    XLA emits the warning from C++ on stderr, so compile in a subprocess
+    and grep — an in-process warnings filter cannot see it. As a
+    POSITIVE control against silent rot (XLA rewording the message, or a
+    log-level knob suppressing C++ warnings would otherwise keep this
+    green forever), the same compile under the classic GSPMD partitioner
+    (shardy off) is known to emit the warning and must still match the
+    grep."""
+    import os
+    import subprocess
+    import sys
+
+    code = """
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+import os as _os
+if _os.environ.get("PBT_TEST_FORCE_GSPMD"):
+    jax.config.update("jax_use_shardy_partitioner", False)
+import numpy as np
+from proteinbert_tpu.configs import (DataConfig, MeshConfig, ModelConfig,
+    OptimizerConfig, PretrainConfig, TrainConfig)
+from proteinbert_tpu.parallel import batch_sharding, make_mesh
+from proteinbert_tpu.parallel.sharding import state_sharding
+from proteinbert_tpu.train import create_train_state
+import proteinbert_tpu.train.train_state as TS
+
+mesh_cfg = MeshConfig(data=2, fsdp=2, model=2, seq=1)
+cfg = PretrainConfig(
+    model=ModelConfig(local_dim=32, global_dim=64, key_dim=16, num_heads=4,
+                      num_blocks=2, num_annotations=128, dtype="bfloat16"),
+    data=DataConfig(seq_len=64, batch_size=8),
+    optimizer=OptimizerConfig(warmup_steps=10),
+    mesh=mesh_cfg, train=TrainConfig(max_steps=1))
+mesh = make_mesh(mesh_cfg, jax.devices()[:8])
+abstract = jax.eval_shape(lambda: create_train_state(jax.random.PRNGKey(0), cfg))
+sh = state_sharding(mesh, abstract)
+bsh = batch_sharding(mesh)
+bat = {"tokens": jax.ShapeDtypeStruct((8, 64), np.int32, sharding=bsh["tokens"]),
+       "annotations": jax.ShapeDtypeStruct((8, 128), np.float32,
+                                           sharding=bsh["annotations"])}
+st = jax.tree.map(lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
+                  abstract, sh)
+TS.train_step.lower(st, bat, cfg).compile()
+print("COMPILED-OK")
+"""
+    def compile_once(force_gspmd):
+        env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+        if force_gspmd:
+            env["PBT_TEST_FORCE_GSPMD"] = "1"
+        return subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True, timeout=420,
+                              env=env)
+
+    marker = "Involuntary full rematerialization"
+    out = compile_once(force_gspmd=False)
+    assert "COMPILED-OK" in out.stdout, out.stderr[-2000:]
+    assert marker not in out.stderr, out.stderr[-3000:]
+
+    control = compile_once(force_gspmd=True)
+    assert "COMPILED-OK" in control.stdout, control.stderr[-2000:]
+    assert marker in control.stderr, (
+        "positive control failed: the GSPMD compile no longer emits the "
+        "warning text this test greps for — update the marker (XLA may "
+        "have reworded it) before trusting the negative assertion above")

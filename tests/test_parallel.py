@@ -233,7 +233,8 @@ import proteinbert_tpu.train.train_state as TS
 mesh_cfg = MeshConfig(data=2, fsdp=2, model=2, seq=1)
 cfg = PretrainConfig(
     model=ModelConfig(local_dim=32, global_dim=64, key_dim=16, num_heads=4,
-                      num_blocks=2, num_annotations=128, dtype="bfloat16"),
+                      num_blocks=2, num_annotations=128, dtype="bfloat16",
+                      remat=True, remat_policy="convs"),
     data=DataConfig(seq_len=64, batch_size=8),
     optimizer=OptimizerConfig(warmup_steps=10),
     mesh=mesh_cfg, train=TrainConfig(max_steps=1))

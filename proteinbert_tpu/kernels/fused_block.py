@@ -44,16 +44,20 @@ whole L sweep (weight HBM traffic O(weights), not O(B·L/TL·weights));
 otherwise the per-row order runs with phase fastest. Shapes the tiled
 plan cannot fit either way fall back to the XLA path automatically.
 
-OFFICIAL SCOPE (round-2 decision, measured on v5e — BASELINE.md "Large-
-preset kernel decision"): the kernel is the right tool at C <= 512,
-where the full weight set is VMEM-resident and it wins 1.28x over
-non-remat XLA. At C = 1024 every schedule is weight-bandwidth-bound
-(38 MB of conv weights vs 16 MB VMEM) and the measured kernel is
-0.88-1.03x XLA, so the Large preset deliberately trains on the XLA path
-with remat_policy="convs" (+16% over full remat) and the tiled variant
+OFFICIAL SCOPE (rounds 2-3, measured on v5e — BASELINE.md "Kernel
+same-batch verdict"): a tiled plan exists only at C <= 512 (the full
+weight set VMEM-resident), but even there the full train step LOSES to
+the remat_policy="convs" XLA path at every measured batch (0.478 vs
+0.547 MFU at B=256/L=512, round 3) — its only full-step win was over
+NON-remat XLA, a configuration no preset uses. At C = 1024 every
+schedule is weight-bandwidth-bound (38 MB of conv weights vs 16 MB
+VMEM) and the measured kernel is 0.88-1.03x XLA. Every preset
+therefore trains on the XLA path with remat_policy="convs"; the kernel
 remains an opt-in (`model.use_pallas`) validated for correctness —
 including the Mosaic-only resident-order semantics — by
-tests/tpu_kernel_child.py on real hardware.
+tests/tpu_kernel_child.py on real hardware, and is the reference
+implementation for fused-local-track schedules at sharded
+(seq-parallel) shapes.
 """
 
 from __future__ import annotations

@@ -103,6 +103,25 @@ class StepTimer:
         if self._t0 is not None:
             self._t0 += seconds
 
+    def sync(self) -> None:
+        """Extend the measured window to now. Call right after a
+        device→host fetch that drained the dispatch queue: the per-step
+        `update()` timestamps only measure host ENQUEUE rate (dispatch
+        is async, and on the tunneled single-chip backend even
+        block_until_ready does not await remote execution — bench.py's
+        sync note), so without this the first log windows report
+        enqueue throughput — physically impossible MFUs — not device
+        throughput. A drain that lands before any step has been timed
+        re-anchors the window START instead: the backlog being waited
+        on there is compile/warmup work, which must not be charged to
+        the first timed window."""
+        if self._t0 is None:
+            return
+        if self._steps_timed:
+            self._t_last = time.perf_counter()
+        else:
+            self._t0 = time.perf_counter()
+
     def update(self) -> None:
         self._count += 1
         if self._count == self.warmup_steps:

@@ -122,12 +122,30 @@ def pretrain(
     history: list = []
     preempted = False
     diagnostic_saved = False
+    metrics = None
+
+    def drain_and_sync():
+        # Force the enqueued steps to completion and fold the wait into
+        # the timing window, so the returned perf summary is device
+        # rate even when max_steps is not a multiple of log_every (the
+        # in-loop log points do the same; this covers the tail).
+        if metrics is not None:
+            float(metrics["loss"])
+            timer.sync()
 
     with GracefulShutdown() as stop:
       for step in range(start_step, cfg.train.max_steps):
         batch = next(batch_iterator)
         state, metrics = step_fn(state, put(batch), cfg)
         timer.update()
+        if step - start_step + 1 == timer.warmup_steps:
+            # Guaranteed drain at the warmup boundary: t0 was just
+            # anchored at host ENQUEUE time, with the compile/warmup
+            # backlog still executing remotely. sync()'s re-anchor
+            # branch moves t0 past that backlog — without this, a run
+            # with log_every=0 and no eval/checkpoint cadence charges
+            # compile time to the timed window, deflating perf.
+            drain_and_sync()
 
         if step == start_step:
             # One-time HBM report once the step (incl. compile-time
@@ -153,6 +171,10 @@ def pretrain(
 
         if cfg.train.log_every and (step + 1) % cfg.train.log_every == 0:
             m = {k: float(v) for k, v in metrics.items()}
+            # The float() fetches above drained the async dispatch
+            # queue through this step — fold that wait into the timing
+            # window, else summary() reports host enqueue rate.
+            timer.sync()
             if cfg.train.on_nan != "off" and not check_finite(
                 m, step + 1, mode="quiet"
             ):
@@ -189,6 +211,7 @@ def pretrain(
         if stop.requested:
             # Preemption (SIGTERM) / operator interrupt: checkpoint at the
             # completed step and exit cleanly; resume picks up exactly here.
+            drain_and_sync()
             if checkpointer is not None:
                 checkpointer.save(step + 1, state,
                                   {"batches_consumed": step + 1})
@@ -203,6 +226,11 @@ def pretrain(
             and cfg.train.eval_every
             and (step + 1) % cfg.train.eval_every == 0
         ):
+            # Drain BEFORE starting the eval bracket: otherwise the
+            # eval's first device fetch waits out the enqueued train
+            # steps and discount() below subtracts that real step time
+            # from the window, inflating throughput/MFU.
+            drain_and_sync()
             t_eval = time.perf_counter()
             # Key the eval by the 1-based step recorded in history, so
             # `evaluate --like-step <history step>` reproduces it.
@@ -222,8 +250,17 @@ def pretrain(
             and cfg.checkpoint.every_steps
             and (step + 1) % cfg.checkpoint.every_steps == 0
         ):
+            # Drain first (so the save's state reads don't swallow real
+            # step time), then discount the save itself — host
+            # serialization is not training time and must not deflate
+            # the window when a later sync() extends it.
+            drain_and_sync()
+            t_save = time.perf_counter()
             checkpointer.save(step + 1, state, {"batches_consumed": step + 1})
+            timer.discount(time.perf_counter() - t_save)
 
+    if not preempted:
+        drain_and_sync()
     if checkpointer is not None and not preempted:
         checkpointer.save(cfg.train.max_steps, state,
                           {"batches_consumed": cfg.train.max_steps})

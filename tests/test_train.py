@@ -342,6 +342,38 @@ def test_flops_model_positive_and_monotone():
     assert 0 < f1 < f2
 
 
+def test_step_timer_sync_extends_window():
+    # Async dispatch: update() timestamps measure host enqueue rate.
+    # sync() (called after the log-point device fetch) must fold the
+    # fetch wait into the window so reported throughput is device rate,
+    # not enqueue rate — the tunneled backend otherwise logs MFUs > 1.
+    import time as _time
+
+    from proteinbert_tpu.train.metrics import StepTimer
+
+    timer = StepTimer(smoke_cfg().model, batch=8, seq_len=32)
+    for _ in range(4):  # 2 warmup + 2 timed "enqueues"
+        timer.update()
+    fast = timer.summary()["step_ms"]
+    _time.sleep(0.05)  # the device drain the float() fetch waits on
+    timer.sync()
+    synced = timer.summary()["step_ms"]
+    assert synced >= fast + 20.0  # 50 ms over 2 steps
+    # sync before timing starts must be a no-op, not a crash
+    fresh = StepTimer(smoke_cfg().model, batch=8, seq_len=32)
+    fresh.sync()
+    assert fresh.summary() == {}
+    # A drain at the warmup boundary (t0 set, nothing timed yet) waits
+    # on compile/warmup backlog — it must re-anchor the window START,
+    # not charge that wait to the first timed window.
+    warm = StepTimer(smoke_cfg().model, batch=8, seq_len=32)
+    warm.update(), warm.update()  # warmup done, t0 anchored at enqueue
+    _time.sleep(0.05)  # the log-point fetch draining compile backlog
+    warm.sync()
+    warm.update(), warm.update()
+    assert warm.summary()["step_ms"] < 20.0  # sleep not in the window
+
+
 def test_pretrain_with_eval_split():
     """Held-out eval wired through the trainer (reference C8's train/test
     split, completed): eval_* records appear at eval_every cadence and

@@ -230,6 +230,20 @@ def main():
             # North-star shape: seq_len 1024 (same tokens/step as 512@512).
             ("remat-convs", convs, 1024, 128),
             ("remat-convs", convs, 1024, 256),
+            # Batch is the biggest lever (docs/performance.md); push the
+            # north-star shape until HBM says stop — the in-loop skip
+            # keeps an OOM from killing the sweep.
+            ("remat-convs", convs, 1024, 384),
+            ("remat-convs", convs, 1024, 512),
+            # Partial scan unroll: XLA sees 2/3 block bodies per scan
+            # iteration and can keep activation layouts across them —
+            # targeting the measured scan-boundary transpose cost
+            # (docs/performance.md attribution) at bounded compile cost
+            # (full unroll was compile-prohibitive, round 2).
+            ("remat-convs-u2",
+             dataclasses.replace(convs, scan_unroll=2), 1024, 256),
+            ("remat-convs-u3",
+             dataclasses.replace(convs, scan_unroll=3), 1024, 256),
             # Full remat at the same shape so the convs-policy comparison
             # stays same-batch (ADVICE r1).
             ("xla-remat", dataclasses.replace(base, remat=True), 1024, 256),

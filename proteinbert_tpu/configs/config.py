@@ -42,6 +42,14 @@ class ModelConfig:
                                         # per block — ~85% of block FLOPs —
                                         # and recompute only the cheap tail)
     scan_blocks: bool = True            # lax.scan over stacked block params
+    scan_unroll: int = 1                # lax.scan unroll factor: XLA sees k
+                                        # block bodies per iteration and can
+                                        # keep activation layouts across
+                                        # them (the scan-boundary transposes
+                                        # are a measured cost,
+                                        # docs/performance.md); full unroll
+                                        # (scan_blocks=False) is compile-
+                                        # prohibitive at real sizes
     use_pallas: bool = False            # Pallas fused local-track kernel
 
     @property

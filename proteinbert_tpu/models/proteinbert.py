@@ -220,7 +220,8 @@ def encode(
             return (l, g), None
 
         (local, global_), _ = lax.scan(
-            scan_body, (local, global_), _cast_blocks(params["blocks"], dtype)
+            scan_body, (local, global_), _cast_blocks(params["blocks"], dtype),
+            unroll=cfg.scan_unroll,
         )
     else:
         for blk in params["blocks"]:

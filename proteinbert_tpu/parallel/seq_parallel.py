@@ -166,7 +166,8 @@ def _shard_forward(
             return (l, g), None
 
         (local, global_), _ = lax.scan(
-            scan_body, (local, global_), params["blocks"])
+            scan_body, (local, global_), params["blocks"],
+            unroll=cfg.scan_unroll)
     else:
         for blk in params["blocks"]:
             local, global_ = body(blk, local, global_, pad_mask)

@@ -142,6 +142,37 @@ def test_scan_matches_unrolled(key):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
 
+def test_scan_unroll_matches(key):
+    """Partial scan unroll is a scheduling knob — values and gradients
+    must be bit-compatible with unroll=1, including a factor that does
+    not divide num_blocks (lax.scan handles the remainder)."""
+    cfg1 = tiny_cfg(remat=True, remat_policy="convs", num_blocks=5)
+    params = proteinbert.init(key, cfg1)
+    tokens, ann = make_batch(key, cfg1)
+
+    def loss(p, c):
+        l, g = proteinbert.apply(p, tokens, ann, c)
+        return jnp.abs(l).mean() + jnp.abs(g).mean()
+
+    g1 = jax.grad(loss)(params, cfg1)
+    out1 = proteinbert.apply(params, tokens, ann, cfg1)
+    for unroll in (2, 3):  # neither divides 5: remainder path covered
+        cfg_u = tiny_cfg(remat=True, remat_policy="convs", num_blocks=5,
+                         scan_unroll=unroll)
+        out_u = proteinbert.apply(params, tokens, ann, cfg_u)
+        for a, b in zip(out1, out_u):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-5)
+        gu = jax.grad(loss)(params, cfg_u)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5
+            ),
+            g1,
+            gu,
+        )
+
+
 def test_remat_matches(key):
     cfg = tiny_cfg()
     cfg_r = tiny_cfg(remat=True)

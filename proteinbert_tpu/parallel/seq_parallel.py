@@ -46,7 +46,7 @@ from proteinbert_tpu.kernels.fused_block import (
     track_halo,
 )
 from proteinbert_tpu.models import proteinbert
-from proteinbert_tpu.models.proteinbert import remat_wrap
+from proteinbert_tpu.models.proteinbert import _cast_blocks, remat_wrap
 from proteinbert_tpu.ops.layers import (
     dense_apply, embedding_apply, layer_norm_apply,
 )
@@ -165,8 +165,13 @@ def _shard_forward(
             l, g = body(blk, l, g, pad_mask)
             return (l, g), None
 
+        # Same hoist as proteinbert.encode: cast the block stack to the
+        # compute dtype ONCE outside the scan, so the f32->bf16 convert
+        # is not re-run per block (and per backward recompute) inside
+        # the remat-wrapped body.
         (local, global_), _ = lax.scan(
-            scan_body, (local, global_), params["blocks"],
+            scan_body, (local, global_),
+            _cast_blocks(params["blocks"], dtype),
             unroll=cfg.scan_unroll)
     else:
         for blk in params["blocks"]:

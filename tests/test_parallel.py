@@ -219,6 +219,10 @@ def test_fsdp_compile_has_no_involuntary_remat_warning():
 import jax
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
+# A persistent-cache hit loads an AOT result and SKIPS partitioning, so
+# neither arm would emit the warning (observed: the positive control
+# went silent once the suite's cache warmed) — force fresh compiles.
+jax.config.update("jax_enable_compilation_cache", False)
 import os as _os
 if _os.environ.get("PBT_TEST_FORCE_GSPMD"):
     jax.config.update("jax_use_shardy_partitioner", False)

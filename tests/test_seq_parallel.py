@@ -77,16 +77,20 @@ def test_fused_valid_kernel_parity(key):
 
 
 @requires_8
+@pytest.mark.parametrize("unroll", [1, 2], ids=["u1", "u2"])
 @pytest.mark.parametrize("mesh_cfg", [
     MeshConfig(data=2, seq=4),
     MeshConfig(data=2, fsdp=2, seq=2),
 ], ids=["dp-sp4", "dp-fsdp-sp2"])
-def test_seq_parallel_forward_parity(key, mesh_cfg):
+def test_seq_parallel_forward_parity(key, mesh_cfg, unroll):
+    # unroll=2 covers scan_unroll coexisting with the per-block halo
+    # exchange + distributed-softmax collectives inside shard_map.
+    model = dataclasses.replace(MODEL, scan_unroll=unroll)
     mesh = make_mesh(mesh_cfg)
-    params = proteinbert.init(key, MODEL)
+    params = proteinbert.init(key, model)
     tokens, ann = _inputs(jax.random.fold_in(key, 1))
     want_l, want_g = proteinbert.apply(params, tokens, ann, MODEL)
-    got_l, got_g = seq_parallel_apply(mesh, params, tokens, ann, MODEL)
+    got_l, got_g = seq_parallel_apply(mesh, params, tokens, ann, model)
     np.testing.assert_allclose(np.asarray(got_l), np.asarray(want_l),
                                rtol=2e-5, atol=2e-5)
     np.testing.assert_allclose(np.asarray(got_g), np.asarray(want_g),

@@ -208,6 +208,21 @@ def time_step(cfg, batch_np, steps):
 
 
 def main():
+    # Optional variant filter (substring/regex on the variant name, e.g.
+    # `bench.py --only 'u[23]'`): lets a tunnel-up window be spent on
+    # exactly the unmeasured variants instead of re-running the whole
+    # ~25-min sweep. The driver invokes bench.py with no args, so the
+    # default (everything) and the emitted JSON contract are unchanged;
+    # persist_last_good merges per-shape, so a filtered run can only add
+    # or refresh rows, never drop evidence.
+    import argparse
+    import re
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--only", default=None, metavar="REGEX",
+                    help="run only variants whose name matches REGEX")
+    cli = ap.parse_args()
+
     on_tpu, reason = probe_tpu()
     if not on_tpu:
         print(f"not benchmarking on TPU — {reason}; forcing CPU",
@@ -275,6 +290,12 @@ def main():
                            dtype="float32")
         variants = [("xla", base, 128, 8)]
         steps = 5
+
+    if cli.only is not None:
+        pat = re.compile(cli.only)
+        variants = [v for v in variants if pat.search(v[0])]
+        if not variants:
+            raise SystemExit(f"--only {cli.only!r} matches no variant")
 
     rng = np.random.default_rng(0)
     best = None

@@ -259,6 +259,12 @@ def main():
              dataclasses.replace(convs, scan_unroll=2), 1024, 256),
             ("remat-convs-u3",
              dataclasses.replace(convs, scan_unroll=3), 1024, 256),
+            # The other lever on the same scan-boundary cost: transpose
+            # the block scan as two passes (lax.scan _split_transpose) so
+            # the saves' layout traffic schedules apart from grad math.
+            ("remat-convs-st",
+             dataclasses.replace(convs, scan_split_transpose=True),
+             1024, 256),
             # Full remat at the same shape so the convs-policy comparison
             # stays same-batch (ADVICE r1).
             ("xla-remat", dataclasses.replace(base, remat=True), 1024, 256),

@@ -50,6 +50,15 @@ class ModelConfig:
                                         # docs/performance.md); full unroll
                                         # (scan_blocks=False) is compile-
                                         # prohibitive at real sizes
+    scan_split_transpose: bool = False  # lax.scan(_split_transpose=True):
+                                        # transpose the block scan as two
+                                        # passes (recompute-forward, then
+                                        # grad sweep) so XLA can schedule
+                                        # the saves' layout traffic
+                                        # separately from the grad math —
+                                        # an experimental alternative lever
+                                        # on the same measured scan-
+                                        # boundary cost scan_unroll targets
     use_pallas: bool = False            # Pallas fused local-track kernel
 
     @property

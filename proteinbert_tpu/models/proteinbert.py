@@ -222,6 +222,7 @@ def encode(
         (local, global_), _ = lax.scan(
             scan_body, (local, global_), _cast_blocks(params["blocks"], dtype),
             unroll=cfg.scan_unroll,
+            _split_transpose=cfg.scan_split_transpose,
         )
     else:
         for blk in params["blocks"]:

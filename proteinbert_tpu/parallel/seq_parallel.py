@@ -172,7 +172,8 @@ def _shard_forward(
         (local, global_), _ = lax.scan(
             scan_body, (local, global_),
             _cast_blocks(params["blocks"], dtype),
-            unroll=cfg.scan_unroll)
+            unroll=cfg.scan_unroll,
+            _split_transpose=cfg.scan_split_transpose)
     else:
         for blk in params["blocks"]:
             local, global_ = body(blk, local, global_, pad_mask)

@@ -173,6 +173,36 @@ def test_scan_unroll_matches(key):
         )
 
 
+def test_scan_split_transpose_matches(key):
+    """_split_transpose restructures only the scan's TRANSPOSE (the
+    backward); forward values must be identical and gradients must match
+    the default scan's to numerical tolerance, with and without remat."""
+    for kw in ({}, dict(remat=True, remat_policy="convs")):
+        cfg1 = tiny_cfg(**kw)
+        cfg_s = tiny_cfg(scan_split_transpose=True, **kw)
+        params = proteinbert.init(key, cfg1)
+        tokens, ann = make_batch(key, cfg1)
+
+        def loss(p, c):
+            l, g = proteinbert.apply(p, tokens, ann, c)
+            return jnp.abs(l).mean() + jnp.abs(g).mean()
+
+        out1 = proteinbert.apply(params, tokens, ann, cfg1)
+        out_s = proteinbert.apply(params, tokens, ann, cfg_s)
+        for a, b in zip(out1, out_s):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-6)
+        g1 = jax.grad(loss)(params, cfg1)
+        gs = jax.grad(loss)(params, cfg_s)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5
+            ),
+            g1,
+            gs,
+        )
+
+
 def test_remat_matches(key):
     cfg = tiny_cfg()
     cfg_r = tiny_cfg(remat=True)

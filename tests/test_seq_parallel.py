@@ -98,15 +98,18 @@ def test_seq_parallel_forward_parity(key, mesh_cfg, unroll):
 
 
 @requires_8
-@pytest.mark.parametrize("unroll", [1, 2], ids=["u1", "u2"])
-def test_seq_parallel_gradient_parity(key, unroll):
-    # The u2 case runs under remat-convs — the exact backward regime the
-    # bench's remat-convs-u2 variant executes, where the unrolled scan
-    # body recomputes the tail from the stashed conv outputs; a grad
-    # regression there is invisible to the forward-parity test.
-    model = dataclasses.replace(MODEL, scan_unroll=unroll,
-                                remat=unroll > 1,
-                                remat_policy="convs" if unroll > 1 else "full")
+@pytest.mark.parametrize("variant", ["u1", "u2", "st"])
+def test_seq_parallel_gradient_parity(key, variant):
+    # u2 and st run under remat-convs — the exact backward regimes the
+    # bench's remat-convs-u2/-st variants execute (unrolled scan body /
+    # _split_transpose'd scan under shard_map); a grad regression there
+    # is invisible to the forward-parity test.
+    model = dataclasses.replace(
+        MODEL,
+        scan_unroll=2 if variant == "u2" else 1,
+        scan_split_transpose=variant == "st",
+        remat=variant != "u1",
+        remat_policy="full" if variant == "u1" else "convs")
     mesh = make_mesh(MeshConfig(data=2, seq=4))
     params = proteinbert.init(key, model)
     tokens, ann = _inputs(jax.random.fold_in(key, 1))

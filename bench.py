@@ -207,36 +207,15 @@ def time_step(cfg, batch_np, steps):
     return (time.perf_counter() - t0) / steps
 
 
-def main():
-    # Optional variant filter (substring/regex on the variant name, e.g.
-    # `bench.py --only 'u[23]'`): lets a tunnel-up window be spent on
-    # exactly the unmeasured variants instead of re-running the whole
-    # ~25-min sweep. The driver invokes bench.py with no args, so the
-    # default (everything) and the emitted JSON contract are unchanged;
-    # persist_last_good merges per-shape, so a filtered run can only add
-    # or refresh rows, never drop evidence.
-    import argparse
-    import re
+def build_variants(on_tpu):
+    """The variant list, as (name, model_cfg, seq_len, batch) plus the
+    timing-step count — in a function so the parent sweep process and a
+    `--run-index` child (which re-derives the list instead of having a
+    config pickled at it) agree on indices by construction. Pallas
+    variants whose shape has no VMEM plan are filtered HERE so indices
+    refer to the gated list in both processes."""
+    from proteinbert_tpu.configs import ModelConfig
 
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--only", default=None, metavar="REGEX",
-                    help="run only variants whose name matches REGEX")
-    cli = ap.parse_args()
-
-    on_tpu, reason = probe_tpu()
-    if not on_tpu:
-        print(f"not benchmarking on TPU — {reason}; forcing CPU",
-              file=sys.stderr)
-        force_cpu_backend()
-
-    import jax
-
-    from proteinbert_tpu.configs import (
-        DataConfig, ModelConfig, OptimizerConfig, PretrainConfig, TrainConfig,
-    )
-    from proteinbert_tpu.train.metrics import (
-        peak_flops_per_chip, train_flops,
-    )
     if on_tpu:
         base = ModelConfig(local_dim=512, global_dim=512, key_dim=64,
                            num_heads=8, num_blocks=6, dtype="bfloat16")
@@ -296,60 +275,190 @@ def main():
                            dtype="float32")
         variants = [("xla", base, 128, 8)]
         steps = 5
+    return variants, steps
 
-    if cli.only is not None:
-        pat = re.compile(cli.only)
-        variants = [v for v in variants if pat.search(v[0])]
-        if not variants:
-            raise SystemExit(f"--only {cli.only!r} matches no variant")
 
-    rng = np.random.default_rng(0)
+def run_variant(index, on_tpu):
+    """Measure ONE variant in-process and return its sweep row (with the
+    backend platform that actually executed it).
+
+    This is the `--run-index` child body: the parent sweep runs each
+    variant in a killable subprocess so a single pathological case — a
+    remote AOT compile that never returns on a dropped tunnel, observed
+    to eat 20+ minutes of a tunnel-up window — costs at most
+    PBT_BENCH_VARIANT_TIMEOUT seconds instead of the whole capture."""
+    import jax
+
+    from proteinbert_tpu.configs import (
+        DataConfig, OptimizerConfig, PretrainConfig, TrainConfig,
+    )
+    from proteinbert_tpu.train.metrics import (
+        peak_flops_per_chip, train_flops,
+    )
+
+    variants, steps = build_variants(on_tpu)
+    name, model, seq_len, batch = variants[index]
+    cfg = PretrainConfig(
+        model=model,
+        data=DataConfig(seq_len=seq_len, batch_size=batch),
+        optimizer=OptimizerConfig(warmup_steps=100),
+        train=TrainConfig(max_steps=steps),
+    )
+    rng = np.random.default_rng(index)
+    batch_np = {
+        "tokens": rng.integers(4, 26, size=(batch, seq_len)
+                               ).astype(np.int32),
+        "annotations": (rng.random((batch, model.num_annotations)) < 0.01
+                        ).astype(np.float32),
+    }
+    dt = time_step(cfg, batch_np, steps)
+    res_per_sec = batch * seq_len / dt
+    mfu = train_flops(model, batch, seq_len) / dt / peak_flops_per_chip()
+    print(f"variant={name} seq={seq_len} batch={batch}: "
+          f"{dt * 1e3:.1f} ms/step "
+          f"res/s={res_per_sec:,.0f} MFU={mfu:.3f}", file=sys.stderr)
+    return {
+        "variant": name, "seq_len": seq_len, "batch": batch,
+        "ms_per_step": round(dt * 1e3, 2),
+        "residues_per_sec": round(res_per_sec, 1),
+        "mfu": round(mfu, 4),
+        # Gate field for the parent's persist: if the tunnel dropped
+        # between probe and this child's first jax use and the backend
+        # fell back, stamping these numbers "tpu" would fabricate the
+        # record.
+        "platform": jax.devices()[0].platform,
+    }
+
+
+def main():
+    # Optional variant filter (substring/regex on the variant name, e.g.
+    # `bench.py --only 'u[23]'`): lets a tunnel-up window be spent on
+    # exactly the unmeasured variants instead of re-running the whole
+    # ~25-min sweep. The driver invokes bench.py with no args, so the
+    # default (everything) and the emitted JSON contract are unchanged;
+    # persist_last_good merges per-shape, so a filtered run can only add
+    # or refresh rows, never drop evidence.
+    import argparse
+    import re
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--only", default=None, metavar="REGEX",
+                    help="run only variants whose name matches REGEX")
+    ap.add_argument("--run-index", type=int, default=None, metavar="N",
+                    help="internal: run ONE variant of the TPU list "
+                         "in-process and print its row as JSON")
+    cli = ap.parse_args()
+
+    if cli.run_index is not None:
+        # Child mode. The parent already probed the tunnel; skipping the
+        # re-probe keeps the child's budget for compile+measure.
+        print(json.dumps(run_variant(cli.run_index, on_tpu=True)))
+        return
+
+    on_tpu, reason = probe_tpu()
+    if not on_tpu:
+        print(f"not benchmarking on TPU — {reason}; forcing CPU",
+              file=sys.stderr)
+        force_cpu_backend()
+
+    pat = re.compile(cli.only) if cli.only is not None else None
+
+    def select(variant_list):
+        idx = list(range(len(variant_list)))
+        if pat is not None:
+            idx = [i for i in idx if pat.search(variant_list[i][0])]
+            if not idx:
+                raise SystemExit(f"--only {cli.only!r} matches no variant")
+        return idx
+
+    variants, _ = build_variants(on_tpu)
+    indices = select(variants)
+
     best = None
     sweep = []  # every variant's numbers, persisted on a TPU run
-    for name, model, seq_len, batch in variants:
-        cfg = PretrainConfig(
-            model=model,
-            data=DataConfig(seq_len=seq_len, batch_size=batch),
-            optimizer=OptimizerConfig(warmup_steps=100),
-            train=TrainConfig(max_steps=steps),
-        )
-        batch_np = {
-            "tokens": rng.integers(4, 26, size=(batch, seq_len)
-                                   ).astype(np.int32),
-            "annotations": (rng.random((batch, model.num_annotations)) < 0.01
-                            ).astype(np.float32),
-        }
-        try:
-            dt = time_step(cfg, batch_np, steps)
-        except Exception as e:  # OOM/Mosaic rejection must not kill the bench
-            print(f"variant {name} failed ({type(e).__name__}); skipped",
-                  file=sys.stderr)
-            continue
-        res_per_sec = batch * seq_len / dt
-        mfu = train_flops(model, batch, seq_len) / dt / peak_flops_per_chip()
-        print(f"variant={name} seq={seq_len} batch={batch}: "
-              f"{dt * 1e3:.1f} ms/step "
-              f"res/s={res_per_sec:,.0f} MFU={mfu:.3f}", file=sys.stderr)
-        sweep.append({
-            "variant": name, "seq_len": seq_len, "batch": batch,
-            "ms_per_step": round(dt * 1e3, 2),
-            "residues_per_sec": round(res_per_sec, 1),
-            "mfu": round(mfu, 4),
-        })
-        if best is None or res_per_sec > best[0]:
-            best = (res_per_sec, mfu, name, seq_len, batch)
-        if jax.devices()[0].platform == "tpu":
+    platform_seen = None
+    if on_tpu:
+        # One killable subprocess per variant; the parent NEVER touches
+        # the backend, so exactly one PJRT client exists at a time and a
+        # hung remote compile is bounded by the per-variant timeout.
+        variant_timeout = int(
+            os.environ.get("PBT_BENCH_VARIANT_TIMEOUT", 900))
+        for i in indices:
+            name = variants[i][0]
+            try:
+                out = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__),
+                     "--run-index", str(i)],
+                    stdout=subprocess.PIPE, timeout=variant_timeout,
+                )
+            except subprocess.TimeoutExpired:
+                print(f"variant {name} (#{i}) timed out after "
+                      f"{variant_timeout}s; skipped", file=sys.stderr)
+                continue
+            if out.returncode != 0:
+                # OOM/Mosaic rejection/tunnel error — the child's trace
+                # already streamed to stderr; the sweep must go on.
+                print(f"variant {name} (#{i}) failed "
+                      f"(rc {out.returncode}); skipped", file=sys.stderr)
+                continue
+            try:
+                row = json.loads(out.stdout.decode().strip().splitlines()[-1])
+            except (ValueError, IndexError):
+                print(f"variant {name} (#{i}) emitted no row; skipped",
+                      file=sys.stderr)
+                continue
+            if row.pop("platform", None) != "tpu":
+                # The tunnel dropped between the probe and this child's
+                # first jax use and its backend fell back — a CPU-
+                # measured row in a TPU sweep would fabricate the
+                # last-good record (and could poison `best`). Drop it.
+                print(f"variant {name} (#{i}) ran on a non-TPU backend; "
+                      "row discarded", file=sys.stderr)
+                continue
+            platform_seen = "tpu"
+            sweep.append(row)
+            if best is None or row["residues_per_sec"] > best[0]:
+                best = (row["residues_per_sec"], row["mfu"], row["variant"],
+                        row["seq_len"], row["batch"])
             # Persist after EVERY variant: the tunnel can drop mid-sweep
-            # and hang the next variant forever — whatever already ran
-            # must survive as last-good data. Gate on the REAL backend,
-            # not the probe flag: if the tunnel dropped between probe
-            # and first jax use and the backend fell back to CPU,
-            # stamping these numbers "tpu" would fabricate the record.
+            # and stall the rest — whatever already ran must survive as
+            # last-good data.
             persist_last_good(sweep)
+        if best is None:
+            # Every child timed out, died, or fell back (tunnel dropped
+            # right after the probe said yes). The bench must still emit
+            # its line — fall through to the CPU fallback path below,
+            # with the --only filter still honored.
+            print("all TPU variants failed; falling back to CPU",
+                  file=sys.stderr)
+            force_cpu_backend()
+            on_tpu = False
+            variants, _ = build_variants(False)
+            indices = select(variants)
+
+    if not on_tpu:
+        import jax
+
+        for i in indices:
+            name = variants[i][0]
+            try:
+                # Same measurement body as a TPU child (one shared
+                # implementation — the rows must stay comparable).
+                row = run_variant(i, on_tpu=False)
+            except Exception as e:
+                print(f"variant {name} failed ({type(e).__name__}); skipped",
+                      file=sys.stderr)
+                continue
+            row.pop("platform", None)
+            sweep.append(row)
+            if best is None or row["residues_per_sec"] > best[0]:
+                best = (row["residues_per_sec"], row["mfu"], row["variant"],
+                        row["seq_len"], row["batch"])
+        platform_seen = jax.devices()[0].platform
 
     if best is None:
         raise SystemExit("all bench variants failed")
-    record = build_record(best, jax.devices()[0].platform)
+    record = build_record(best, platform_seen or "unknown")
     if record["platform"] != "tpu":
         # (On TPU the in-loop persists already wrote the full sweep.)
         try:

@@ -1,0 +1,47 @@
+"""bench.py sweep plumbing (no hardware): variant-list invariants the
+parent↔child `--run-index` protocol and the persisted-record merge rely
+on, plus the last-good merge semantics themselves."""
+
+import json
+
+import bench
+
+
+def test_variant_rows_unique():
+    """persist_last_good keys rows by (variant, seq_len, batch) — a
+    duplicate key would silently overwrite a row mid-sweep; and the
+    child re-derives the list by index, so it must be deterministic."""
+    v1, _ = bench.build_variants(True)
+    v2, _ = bench.build_variants(True)
+    keys = [(name, seq, b) for name, _, seq, b in v1]
+    assert len(set(keys)) == len(keys)
+    assert keys == [(name, seq, b) for name, _, seq, b in v2]
+
+
+def test_cpu_fallback_variant_is_tiny():
+    (name, model, seq, batch), steps = bench.build_variants(False)[0][0], \
+        bench.build_variants(False)[1]
+    assert name == "xla" and model.num_blocks <= 2 and steps <= 5
+
+
+def test_persist_merge_never_demotes(tmp_path, monkeypatch):
+    """A later partial sweep must only add/refresh rows, never drop the
+    stronger evidence already recorded."""
+    monkeypatch.setattr(bench, "LAST_GOOD_PATH",
+                        str(tmp_path / "last_good.json"))
+    bench.persist_last_good([
+        {"variant": "a", "seq_len": 512, "batch": 64,
+         "ms_per_step": 10.0, "residues_per_sec": 100.0, "mfu": 0.5},
+        {"variant": "b", "seq_len": 512, "batch": 64,
+         "ms_per_step": 10.0, "residues_per_sec": 200.0, "mfu": 0.6},
+    ])
+    bench.persist_last_good([
+        {"variant": "a", "seq_len": 512, "batch": 64,
+         "ms_per_step": 9.0, "residues_per_sec": 150.0, "mfu": 0.55},
+    ])
+    rec = json.load(open(tmp_path / "last_good.json"))
+    rows = {(r["variant"], r["seq_len"], r["batch"]):
+            r["residues_per_sec"] for r in rec["sweep"]}
+    assert rows[("a", 512, 64)] == 150.0  # refreshed
+    assert rows[("b", 512, 64)] == 200.0  # survived the partial sweep
+    assert rec["value"] == 200.0  # headline = best merged row

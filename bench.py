@@ -119,6 +119,28 @@ def force_cpu_backend() -> None:
     jax.config.update("jax_platforms", "cpu")
 
 
+def enable_compile_cache() -> None:
+    """Persistent XLA compilation cache for bench processes.
+
+    With one subprocess per variant, every child pays its own compile
+    (~20-40 s through the tunnel). The cache keys by HLO+config, so a
+    re-measured variant — the common case across tunnel windows, watch
+    sweeps, and the driver's round-end capture — skips straight to
+    measurement. Must go through the config API before any device use
+    (env vars are read at interpreter start by the axon sitecustomize,
+    same constraint as tests/conftest.py:26-35)."""
+    import jax
+
+    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             ".jax_bench_cache")
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception as e:  # cache is an accelerant, never a blocker
+        print(f"compile cache unavailable: {e}", file=sys.stderr)
+
+
 def build_record(best, platform):
     res_per_sec, mfu, name, seq_len, batch = best
     return {
@@ -288,6 +310,8 @@ def run_variant(index, on_tpu):
     to eat 20+ minutes of a tunnel-up window — costs at most
     PBT_BENCH_VARIANT_TIMEOUT seconds instead of the whole capture."""
     import jax
+
+    enable_compile_cache()
 
     from proteinbert_tpu.configs import (
         DataConfig, OptimizerConfig, PretrainConfig, TrainConfig,

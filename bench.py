@@ -131,12 +131,20 @@ def enable_compile_cache() -> None:
     same constraint as tests/conftest.py:26-35)."""
     import jax
 
-    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                             ".jax_bench_cache")
+    # An operator- or CI-provided cache dir wins: overriding it would
+    # split the warm cache and re-pay exactly the compiles it holds.
+    cache_dir = os.environ.get(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".jax_bench_cache"))
     try:
         os.makedirs(cache_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        # LRU-bound the dir: sweep configs drift every round, and without
+        # eviction the repo-local cache grows by stale executables forever.
+        jax.config.update("jax_compilation_cache_max_size",
+                          2 * 1024 * 1024 * 1024)
     except Exception as e:  # cache is an accelerant, never a blocker
         print(f"compile cache unavailable: {e}", file=sys.stderr)
 

@@ -45,3 +45,55 @@ def test_persist_merge_never_demotes(tmp_path, monkeypatch):
     assert rows[("a", 512, 64)] == 150.0  # refreshed
     assert rows[("b", 512, 64)] == 200.0  # survived the partial sweep
     assert rec["value"] == 200.0  # headline = best merged row
+
+
+class _FakeCompleted:
+    def __init__(self, rc, stdout=b""):
+        self.returncode = rc
+        self.stdout = stdout
+
+
+def test_parent_sweep_filters_and_survives_bad_children(
+        tmp_path, monkeypatch, capsys):
+    """The TPU parent loop must skip timeouts/crashes/garbage, DISCARD
+    rows measured on a fallen-back backend (fabrication guard), persist
+    after every good row, and headline the best TPU row."""
+    import subprocess as sp
+
+    monkeypatch.setattr(bench, "LAST_GOOD_PATH",
+                        str(tmp_path / "last_good.json"))
+    monkeypatch.setattr(bench, "probe_tpu", lambda: (True, "fake"))
+    n = len(bench.build_variants(True)[0])
+
+    def fake_run(cmd, **kw):
+        i = int(cmd[-1])
+        name, _, seq, batch = bench.build_variants(True)[0][i]
+        if i == 0:
+            raise sp.TimeoutExpired(cmd, kw.get("timeout"))
+        if i == 1:
+            return _FakeCompleted(1)
+        if i == 2:
+            return _FakeCompleted(0, b"not json")
+        row = {"variant": name, "seq_len": seq, "batch": batch,
+               "ms_per_step": 1.0, "residues_per_sec": 1000.0 + i,
+               "mfu": 0.5,
+               "platform": "cpu" if i == 3 else "tpu"}
+        return _FakeCompleted(0, json.dumps(row).encode())
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.setattr(bench.sys, "argv", ["bench.py"])
+    bench.main()
+
+    out = capsys.readouterr()
+    record = json.loads(out.out.strip().splitlines()[-1])
+    assert record["platform"] == "tpu"
+    # Best = highest-index surviving TPU child (i == n-1).
+    assert record["value"] == 1000.0 + (n - 1)
+    persisted = json.load(open(tmp_path / "last_good.json"))
+    rows = {(r["variant"], r["seq_len"], r["batch"]) for r in
+            persisted["sweep"]}
+    v = bench.build_variants(True)[0]
+    # Children 0-3 contributed nothing; 4..n-1 all landed.
+    assert len(rows) == len({(v[i][0], v[i][2], v[i][3])
+                             for i in range(4, n)})
+    assert not any(r.get("platform") for r in persisted["sweep"])

@@ -44,6 +44,13 @@ LAST_GOOD_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                               "bench_last_tpu.json")
 
 
+def variant_timeout() -> int:
+    """One definition for parent wait and child self-destruct margin —
+    a drifted default would turn every slow variant into a false
+    'failed; skipped'."""
+    return int(os.environ.get("PBT_BENCH_VARIANT_TIMEOUT", 900))
+
+
 def atomic_json_dump(obj, path):
     """Write-then-rename so a killed writer can't truncate the target —
     bench_last_tpu.json guards the only TPU evidence across tunnel flaps
@@ -384,7 +391,19 @@ def main():
     if cli.run_index is not None:
         # Child mode. The parent already probed the tunnel; skipping the
         # re-probe keeps the child's budget for compile+measure.
+        #
+        # Self-destruct slightly after the parent's per-variant timeout:
+        # if the PARENT is SIGKILLed mid-variant (tpu_watch kills its
+        # sweep that way at SWEEP_TIMEOUT), the orphaned child would
+        # otherwise sit in a hung remote compile holding the single
+        # chip's PJRT client indefinitely. No handler is installed, so
+        # SIGALRM's default action terminates the process even while
+        # it is blocked inside native tunnel code.
+        import signal
+
+        signal.alarm(variant_timeout() + 60)
         print(json.dumps(run_variant(cli.run_index, on_tpu=True)))
+        signal.alarm(0)
         return
 
     on_tpu, reason = probe_tpu()
@@ -413,19 +432,18 @@ def main():
         # One killable subprocess per variant; the parent NEVER touches
         # the backend, so exactly one PJRT client exists at a time and a
         # hung remote compile is bounded by the per-variant timeout.
-        variant_timeout = int(
-            os.environ.get("PBT_BENCH_VARIANT_TIMEOUT", 900))
+        wait_s = variant_timeout()
         for i in indices:
             name = variants[i][0]
             try:
                 out = subprocess.run(
                     [sys.executable, os.path.abspath(__file__),
                      "--run-index", str(i)],
-                    stdout=subprocess.PIPE, timeout=variant_timeout,
+                    stdout=subprocess.PIPE, timeout=wait_s,
                 )
             except subprocess.TimeoutExpired:
                 print(f"variant {name} (#{i}) timed out after "
-                      f"{variant_timeout}s; skipped", file=sys.stderr)
+                      f"{wait_s}s; skipped", file=sys.stderr)
                 continue
             if out.returncode != 0:
                 # OOM/Mosaic rejection/tunnel error — the child's trace

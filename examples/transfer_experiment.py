@@ -106,7 +106,27 @@ def run_cli(args_list, platform=None, env=None):
     pre = ["--platform", platform] if platform else []
     cmd = [sys.executable, "-m", "proteinbert_tpu"] + pre + args_list
     print("+ " + " ".join(pre + args_list), file=sys.stderr, flush=True)
-    r = subprocess.run(cmd, cwd=REPO, env=env or os.environ.copy())
+    # Bounded per phase on tunnel-exposed platforms only: a mid-phase
+    # tunnel drop hangs the CLI child at device init/compile forever.
+    # CPU phases (where no such hang exists) stay unbounded — a slow
+    # but progressing full-scale CPU run must not be misdiagnosed as a
+    # drop. Two layers: subprocess.run's timeout kills the child while
+    # THIS process lives, and PBT_SELF_DESTRUCT_SECS arms a SIGALRM in
+    # the child (cli/main.py) so an outer kill of this harness cannot
+    # orphan a hung child still holding the single chip's client.
+    phase_timeout = int(os.environ.get(
+        "PBT_TX_PHASE_TIMEOUT", 0 if platform == "cpu" else 3600))
+    run_env = dict(env or os.environ)
+    if phase_timeout > 0:
+        run_env.setdefault("PBT_SELF_DESTRUCT_SECS",
+                           str(phase_timeout + 60))
+    try:
+        r = subprocess.run(cmd, cwd=REPO, env=run_env,
+                           timeout=phase_timeout or None)
+    except subprocess.TimeoutExpired:
+        raise SystemExit(
+            f"CLI phase exceeded {phase_timeout}s (tunnel drop?): "
+            f"{' '.join(cmd)}")
     if r.returncode != 0:
         raise SystemExit(f"CLI failed ({r.returncode}): {' '.join(cmd)}")
 

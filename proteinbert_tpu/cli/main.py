@@ -1084,6 +1084,17 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv: Optional[List[str]] = None) -> int:
     start_log()
+    self_destruct = int(os.environ.get("PBT_SELF_DESTRUCT_SECS", "0"))
+    if self_destruct > 0:
+        # Opt-in hard deadline for harness-driven runs (experiment
+        # scripts set it to their phase timeout + margin): if the
+        # harness is killed while this process hangs at tunneled-TPU
+        # device init or compile, the orphan would hold the single
+        # chip's PJRT client forever. No handler is installed, so
+        # SIGALRM's default action terminates even inside native code.
+        import signal
+
+        signal.alarm(self_destruct)
     args = build_parser().parse_args(argv)
     if args.platform:
         # Must land before the first backend use anywhere in the process;

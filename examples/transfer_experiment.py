@@ -20,10 +20,12 @@ Protocol (every phase is a REAL CLI subprocess, not an in-process call):
   5. Print ONE JSON line: per-task pretrained/random best eval scores
      and the gaps.
 
-Scales: --scale mini (CPU, ~15 min — the smoke of this harness),
---scale small (CPU, a few hours — the recorded fallback when the TPU
-tunnel is down; defaults --platform cpu like mini), or --scale full
-(the recorded run; TPU-sized model/steps).
+Scales: --scale mini (CPU, ~15 min on one core — the smoke of this
+harness), --scale small (CPU fallback when the TPU tunnel is down;
+sized for a multi-core host — measured ~113 s/step ≈ 30+ h on a
+SINGLE-core box, so check `nproc` before choosing it; defaults
+--platform cpu like mini), or --scale full (the recorded run;
+TPU-sized model/steps).
 """
 
 from __future__ import annotations

@@ -101,7 +101,11 @@ def test_sharding_rules_tp_and_fsdp():
 def test_sharded_train_step_matches_single_device(mesh_cfg):
     """The compiled distributed step must be numerically equivalent to the
     single-device step (XLA inserts psum/all-gather/halo automatically)."""
-    cfg = cfg_for(mesh_cfg)
+    _assert_sharded_step_matches(cfg_for(mesh_cfg))
+
+
+def _assert_sharded_step_matches(cfg):
+    mesh_cfg = cfg.mesh
     batch = make_batch(cfg)
 
     state0 = create_train_state(jax.random.PRNGKey(0), cfg)
@@ -124,6 +128,26 @@ def test_sharded_train_step_matches_single_device(mesh_cfg):
             np.asarray(r), np.asarray(jax.device_get(g)), atol=2e-5,
             err_msg=str(mesh_cfg),
         )
+
+
+@requires_8
+@pytest.mark.parametrize("model_kw", [
+    # num_blocks=5 with unroll=2 keeps a REAL loop (2 iterations of 2
+    # bodies + remainder) — at the default num_blocks=2 the scan would
+    # fully unroll to straight-line code and never compile the mixed
+    # loop-plus-unroll pattern this test exists to cover.
+    dict(scan_unroll=2, num_blocks=5, remat=True, remat_policy="convs"),
+    dict(scan_split_transpose=True, remat=True, remat_policy="convs"),
+], ids=["u2-remat-convs", "st-remat-convs"])
+def test_scan_knobs_match_single_device_under_fsdp(model_kw):
+    """The scan scheduling knobs (partial unroll / split transpose) on
+    the implicit-SPMD path must stay numerically equivalent to the
+    single-device step when the stacked-block params are fsdp-sharded —
+    with unroll the scan body consumes k fsdp-sharded block slices per
+    iteration, a different all-gather pattern than the u1 scan the other
+    parity tests compile."""
+    mesh_cfg = MeshConfig(data=2, fsdp=2, seq=2)
+    _assert_sharded_step_matches(cfg_for(mesh_cfg, **model_kw))
 
 
 @requires_8

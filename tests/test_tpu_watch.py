@@ -1,0 +1,94 @@
+"""tpu_watch daemon logic without hardware: probe → sweep → after-sweep
+hook chaining, all subprocess calls faked."""
+
+import json
+import types
+
+
+def _load(monkeypatch, tmp_path):
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "tpu_watch", os.path.join(os.path.dirname(__file__), "..",
+                                  "tools", "tpu_watch.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    monkeypatch.setattr(mod, "STATUS_PATH", str(tmp_path / "status.json"))
+    monkeypatch.setattr(mod, "POLL_WAIT", 0)
+    return mod
+
+
+def test_after_sweep_hook_runs_on_capture(monkeypatch, tmp_path):
+    mod = _load(monkeypatch, tmp_path)
+    monkeypatch.setattr(mod, "probe", lambda: (True, None))
+    proof = tmp_path / "hook_proof"
+    monkeypatch.setenv("PBT_WATCH_AFTER_SWEEP",
+                       f"echo chained > {proof}")
+
+    record = {"platform": "tpu", "value": 1.0}
+    calls = []
+
+    def fake_run(cmd, **kw):
+        assert isinstance(cmd, list) and any("bench.py" in c for c in cmd)
+        calls.append("bench")
+        return types.SimpleNamespace(
+            returncode=0, stderr="", stdout=json.dumps(record) + "\n")
+
+    # Only the sweep goes through subprocess.run; the hook runs via a
+    # REAL Popen in its own session (group-kill semantics), so the proof
+    # file is written by an actual shell.
+    monkeypatch.setattr(mod.subprocess, "run", fake_run)
+    rc = mod.main()
+    assert rc == 0
+    assert calls == ["bench"]
+    assert proof.read_text().strip() == "chained"
+    status = json.load(open(tmp_path / "status.json"))
+    assert status["status"] == "captured"
+
+
+def test_no_hook_when_sweep_falls_back(monkeypatch, tmp_path):
+    mod = _load(monkeypatch, tmp_path)
+    monkeypatch.setattr(mod, "DEADLINE_H", 0.0001)  # one loop, then out
+    monkeypatch.setattr(mod, "probe", lambda: (True, None))
+    proof = tmp_path / "hook_proof"
+    monkeypatch.setenv("PBT_WATCH_AFTER_SWEEP", f"echo chained > {proof}")
+
+    def fake_run(cmd, **kw):
+        return types.SimpleNamespace(
+            returncode=0, stderr="",
+            stdout=json.dumps({"platform": "cpu"}) + "\n")
+
+    monkeypatch.setattr(mod.subprocess, "run", fake_run)
+    rc = mod.main()
+    assert rc == 3  # deadline, never captured
+    assert not proof.exists()
+
+
+def test_hook_timeout_kills_process_group(monkeypatch, tmp_path):
+    """A compound hook command that outlives the bound must be killed as
+    a GROUP — run(shell=True) would kill only the sh wrapper and leave
+    the experiment process hammering the shared chip."""
+    mod = _load(monkeypatch, tmp_path)
+    monkeypatch.setattr(mod, "probe", lambda: (True, None))
+    monkeypatch.setattr(mod, "HOOK_TIMEOUT", 1)
+    marker = tmp_path / "survivor"
+    # sleep is the grandchild; if only sh died, the second command would
+    # still create the marker afterwards.
+    monkeypatch.setenv("PBT_WATCH_AFTER_SWEEP",
+                       f"sleep 30 && echo alive > {marker}")
+
+    def fake_run(cmd, **kw):
+        return types.SimpleNamespace(
+            returncode=0, stderr="",
+            stdout=json.dumps({"platform": "tpu", "value": 1.0}) + "\n")
+
+    monkeypatch.setattr(mod.subprocess, "run", fake_run)
+    import time
+    t0 = time.time()
+    rc = mod.main()
+    assert rc == 0 and time.time() - t0 < 25
+    time.sleep(1.5)
+    assert not marker.exists()
+    status = json.load(open(tmp_path / "status.json"))
+    assert status["status"] == "captured"

@@ -7,9 +7,14 @@ poll cheaply all session, and the MOMENT a probe succeeds run the full
 bench sweep, refreshing bench_last_tpu.json with every variant.
 
 Run detached:  nohup python tools/tpu_watch.py >> tpu_watch.log 2>&1 &
-Exits 0 after a successful sweep (so an operator tailing the log can
-start the heavier hardware experiments while the tunnel is up), 3 on
-deadline without ever reaching the TPU.
+Exits 0 after a successful sweep, 3 on deadline without ever reaching
+the TPU. To chain the heavier hardware experiments automatically while
+the tunnel is proven up, set PBT_WATCH_AFTER_SWEEP to a shell command
+(e.g. "python examples/transfer_experiment.py --scale full"); it runs
+best-effort after the sweep persists, bounded by PBT_WATCH_HOOK_TIMEOUT
+(default 7200 s, process group killed on timeout), BEFORE the daemon
+exits — so do not also start experiments manually on exit 0 when the
+hook is set.
 
 Status is mirrored to tpu_watch_status.json for cheap polling.
 """
@@ -32,6 +37,9 @@ DEADLINE_H = float(os.environ.get("PBT_WATCH_HOURS", 11))
 SWEEP_TIMEOUT = int(os.environ.get("PBT_WATCH_SWEEP_TIMEOUT", 2700))
 HARD_FAIL_CAP = int(os.environ.get("PBT_WATCH_HARD_FAIL_CAP", 10))
 SWEEP_FAIL_CAP = int(os.environ.get("PBT_WATCH_SWEEP_FAIL_CAP", 3))
+# Parsed at import like every other knob: a malformed value must fail at
+# startup, not at the single success moment hours later.
+HOOK_TIMEOUT = int(os.environ.get("PBT_WATCH_HOOK_TIMEOUT", 7200))
 
 
 def put_status(**kv):
@@ -128,6 +136,45 @@ def main():
             except ValueError:
                 pass
             if rec.get("platform") == "tpu":
+                after = os.environ.get("PBT_WATCH_AFTER_SWEEP")
+                if after:
+                    # Chain the heavier hardware experiments while the
+                    # tunnel is PROVEN up (e.g. PBT_WATCH_AFTER_SWEEP=
+                    # "python examples/transfer_experiment.py --scale
+                    # full") instead of telling the operator to start
+                    # them by hand — up-windows are too rare to waste
+                    # on reaction time. Bounded and best-effort: the
+                    # sweep capture above is already safe.
+                    print(f"[tpu_watch] sweep captured; running "
+                          f"after-sweep hook: {after}", flush=True)
+                    put_status(status="after_sweep_hook", probes=n,
+                               record=rec, hook=after)
+                    try:
+                        # Own session so a timeout can kill the WHOLE
+                        # process group — run(shell=True) would kill
+                        # only the sh wrapper and leave a compound
+                        # command's experiment processes hammering the
+                        # one shared chip.
+                        import signal
+
+                        proc = subprocess.Popen(
+                            after, shell=True, cwd=REPO,
+                            start_new_session=True)
+                        try:
+                            proc.wait(timeout=HOOK_TIMEOUT)
+                            print(f"[tpu_watch] hook rc="
+                                  f"{proc.returncode}", flush=True)
+                        except subprocess.TimeoutExpired:
+                            os.killpg(proc.pid, signal.SIGKILL)
+                            print("[tpu_watch] after-sweep hook timed "
+                                  "out; process group killed",
+                                  flush=True)
+                    except Exception as e:  # hook is best-effort; the
+                        # sweep capture (and terminal status) must win
+                        print(f"[tpu_watch] after-sweep hook failed: "
+                              f"{e}", flush=True)
+                # Terminal status LAST so pollers never read a stale
+                # mid-hook state after the daemon exits.
                 put_status(status="captured", probes=n, record=rec)
                 print("[tpu_watch] full TPU sweep captured; exiting",
                       flush=True)

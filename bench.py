@@ -3,9 +3,12 @@
 Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"} plus
 provenance fields: "platform" (which backend produced the number — a CPU
 fallback's 0.009 MFU must never read as a 60x TPU regression, VERDICT r1
-Weak #2) and, when the run had to fall back to CPU, "last_good_tpu" (the
-most recent TPU-platform measurement, persisted in bench_last_tpu.json
-whenever a TPU run succeeds).
+Weak #2). When the run has to fall back to CPU but bench_last_tpu.json
+holds TPU evidence, the TOP-LEVEL record IS that last-good TPU
+measurement, flagged "stale": true with its captured_at timestamp, and
+the live CPU number is demoted to "live_fallback" (VERDICT r3 item 5 —
+the previous shape buried the TPU record in a nested blob so long the
+driver's parser choked on the line).
 
 Metric: residues/sec/chip on the BASELINE.json NORTH-STAR config — the
 6-block/d=512 base model at seq_len 1024 ("≥40% MFU ... at seq_len 1024",
@@ -244,13 +247,18 @@ def time_step(cfg, batch_np, steps):
     return (time.perf_counter() - t0) / steps
 
 
-def build_variants(on_tpu):
+def build_variants(on_tpu, gate_pallas=True):
     """The variant list, as (name, model_cfg, seq_len, batch) plus the
     timing-step count — in a function so the parent sweep process and a
     `--run-index` child (which re-derives the list instead of having a
     config pickled at it) agree on indices by construction. Pallas
     variants whose shape has no VMEM plan are filtered HERE so indices
-    refer to the gated list in both processes."""
+    refer to the gated list in both processes.
+
+    gate_pallas=False skips that filter (and with it the only jax
+    import on this path) so tpu_watch.py can size its sweep timeout
+    from the variant COUNT without touching the backend — the ungated
+    count is an upper bound, which is exactly what a timeout needs."""
     from proteinbert_tpu.configs import ModelConfig
 
     if on_tpu:
@@ -298,14 +306,31 @@ def build_variants(on_tpu):
             ("pallas", dataclasses.replace(base, use_pallas=True), 512, 256),
             ("pallas", dataclasses.replace(base, use_pallas=True), 512, 512),
         ]
-        steps = 15
-        from proteinbert_tpu.kernels import pallas_supported
+        # Large (12-block/d=1024) and long-context (L=2048) preset shapes
+        # at their measured-best single-chip batches, so the flagship
+        # BASELINE.md claims (0.69 MFU Large, 0.57 long) get timestamped
+        # machine-readable provenance in bench_last_tpu.json instead of
+        # living only in round-2 prose (VERDICT r3 Weak #3). Small
+        # batches keep each row inside the per-variant timeout. The
+        # models come FROM the presets so a preset change can never make
+        # these rows silently certify a different shape than they claim.
+        from proteinbert_tpu.configs import get_preset
 
-        variants = [
-            v for v in variants
-            if not (v[1].use_pallas
-                    and not pallas_supported(v[1].local_dim, v[2], v[1].dtype))
+        variants += [
+            ("large", get_preset("large").model, 1024, 32),
+            ("large", get_preset("large").model, 1024, 64),
+            ("long", get_preset("long").model, 2048, 32),
         ]
+        steps = 15
+        if gate_pallas:
+            from proteinbert_tpu.kernels import pallas_supported
+
+            variants = [
+                v for v in variants
+                if not (v[1].use_pallas
+                        and not pallas_supported(v[1].local_dim, v[2],
+                                                 v[1].dtype))
+            ]
     else:  # CPU fallback so the script always emits its line
         base = ModelConfig(local_dim=64, global_dim=128, key_dim=16,
                            num_heads=4, num_blocks=2, num_annotations=512,
@@ -414,16 +439,27 @@ def main():
 
     pat = re.compile(cli.only) if cli.only is not None else None
 
-    def select(variant_list):
+    def select(variant_list, strict=True):
         idx = list(range(len(variant_list)))
         if pat is not None:
-            idx = [i for i in idx if pat.search(variant_list[i][0])]
-            if not idx:
+            hit = [i for i in idx if pat.search(variant_list[i][0])]
+            if hit:
+                return hit
+            if strict:
                 raise SystemExit(f"--only {cli.only!r} matches no variant")
+            # CPU-fallback list (ADVICE r3): a TPU-targeted filter like
+            # --only 'remat-convs-(u|st)' matches none of the 1-variant
+            # CPU list; exiting here would break the "always emit the
+            # JSON line" invariant — run the fallback list instead.
+            print(f"--only {cli.only!r} matches no CPU-fallback variant; "
+                  "running the full fallback list", file=sys.stderr)
         return idx
 
     variants, _ = build_variants(on_tpu)
-    indices = select(variants)
+    # Strict matching only makes sense against the TPU list the filter
+    # was written for; on a probe-failed CPU start the line must still
+    # be emitted.
+    indices = select(variants, strict=on_tpu)
 
     best = None
     sweep = []  # every variant's numbers, persisted on a TPU run
@@ -484,7 +520,7 @@ def main():
             force_cpu_backend()
             on_tpu = False
             variants, _ = build_variants(False)
-            indices = select(variants)
+            indices = select(variants, strict=False)
 
     if not on_tpu:
         import jax
@@ -510,12 +546,39 @@ def main():
         raise SystemExit("all bench variants failed")
     record = build_record(best, platform_seen or "unknown")
     if record["platform"] != "tpu":
-        # (On TPU the in-loop persists already wrote the full sweep.)
+        # Tunnel-down fallback (VERDICT r3 item 5): promote the last-good
+        # TPU evidence to the TOP-LEVEL record — a reader (or the driver)
+        # must not see a CPU number as a 40x regression — with explicit
+        # staleness provenance (stale + captured_at) and the live CPU
+        # measurement demoted to a nested field. The full sweep stays in
+        # bench_last_tpu.json; embedding it here is what overflowed the
+        # driver's line parser in round 3 (BENCH_r03 parsed=null).
+        lg = None
         try:
             with open(LAST_GOOD_PATH) as f:
-                record["last_good_tpu"] = json.load(f)
+                lg = json.load(f)
         except (OSError, ValueError):
             pass
+        if lg and lg.get("platform") == "tpu":
+            live = record
+            record = {k: lg[k] for k in
+                      ("metric", "value", "unit", "vs_baseline", "platform",
+                       "variant", "seq_len", "batch") if k in lg}
+            record["stale"] = True
+            # The headline row's OWN measurement time, not the file's
+            # last-merge time — a later partial sweep (e.g. --only
+            # pallas) restamps the file-level captured_at without
+            # re-measuring the headline shape.
+            row_at = next(
+                (r.get("captured_at") for r in lg.get("sweep", [])
+                 if (r.get("variant"), r.get("seq_len"), r.get("batch"))
+                 == (lg.get("variant"), lg.get("seq_len"), lg.get("batch"))),
+                None)
+            record["captured_at"] = row_at or lg.get("captured_at")
+            record["sweep_rows"] = len(lg.get("sweep", []))
+            record["live_fallback"] = {
+                "platform": live["platform"], "value": live["value"],
+                "vs_baseline": live["vs_baseline"]}
     print(json.dumps(record))
 
 

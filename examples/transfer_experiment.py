@@ -116,6 +116,9 @@ def run_cli(args_list, platform=None, env=None):
     # THIS process lives, and PBT_SELF_DESTRUCT_SECS arms a SIGALRM in
     # the child (cli/main.py) so an outer kill of this harness cannot
     # orphan a hung child still holding the single chip's client.
+    # The default bound assumes a tunnel-exposed device platform; a
+    # slow-but-healthy non-tunnel host (ADVICE r3) should set
+    # PBT_TX_PHASE_TIMEOUT=0 (unbounded) or higher explicitly.
     phase_timeout = int(os.environ.get(
         "PBT_TX_PHASE_TIMEOUT", 0 if platform == "cpu" else 3600))
     run_env = dict(env or os.environ)
@@ -127,8 +130,10 @@ def run_cli(args_list, platform=None, env=None):
                            timeout=phase_timeout or None)
     except subprocess.TimeoutExpired:
         raise SystemExit(
-            f"CLI phase exceeded {phase_timeout}s (tunnel drop?): "
-            f"{' '.join(cmd)}")
+            f"CLI phase exceeded {phase_timeout}s — a tunnel drop hangs "
+            "device init/compile forever, but if this host is merely slow "
+            "(no tunnel), rerun with PBT_TX_PHASE_TIMEOUT=0 (unbounded) "
+            f"or a larger bound: {' '.join(cmd)}")
     if r.returncode != 0:
         raise SystemExit(f"CLI failed ({r.returncode}): {' '.join(cmd)}")
 

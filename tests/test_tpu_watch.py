@@ -65,6 +65,54 @@ def test_no_hook_when_sweep_falls_back(monkeypatch, tmp_path):
     assert not proof.exists()
 
 
+def test_stale_promoted_record_is_not_a_capture(monkeypatch, tmp_path):
+    """bench's CPU-fallback line now PROMOTES the last-good TPU row to
+    the top level (platform "tpu" + stale true). That is evidence of a
+    PAST window — treating it as a capture would fire the after-sweep
+    hardware hook on a dead tunnel and exit the watch for nothing."""
+    mod = _load(monkeypatch, tmp_path)
+    monkeypatch.setattr(mod, "DEADLINE_H", 0.0001)
+    monkeypatch.setattr(mod, "probe", lambda: (True, None))
+    proof = tmp_path / "hook_proof"
+    monkeypatch.setenv("PBT_WATCH_AFTER_SWEEP", f"echo chained > {proof}")
+
+    def fake_run(cmd, **kw):
+        return types.SimpleNamespace(
+            returncode=0, stderr="",
+            stdout=json.dumps({"platform": "tpu", "stale": True,
+                               "value": 1.0}) + "\n")
+
+    monkeypatch.setattr(mod.subprocess, "run", fake_run)
+    rc = mod.main()
+    assert rc == 3  # deadline — stale evidence never counts as captured
+    assert not proof.exists()
+    status = json.load(open(tmp_path / "status.json"))
+    assert status["status"] != "captured"
+
+
+def test_sweep_timeout_cap_stops_the_daemon(monkeypatch, tmp_path):
+    """Each sweep timeout burns the whole sweep budget on the shared
+    chip; an unbounded retry loop of SIGKILLed multi-hour sweeps must
+    cap out (ADVICE r3)."""
+    import subprocess as sp
+
+    mod = _load(monkeypatch, tmp_path)
+    monkeypatch.setattr(mod, "probe", lambda: (True, None))
+    monkeypatch.setattr(mod, "SWEEP_TIMEOUT_CAP", 2)
+    # Each timeout drains the orphaned child's self-destruct bound
+    # (variant_timeout()+60) before re-probing; zero it for the test.
+    monkeypatch.setattr(mod, "variant_timeout", lambda: -60)
+
+    def fake_run(cmd, **kw):
+        raise sp.TimeoutExpired(cmd, kw.get("timeout"))
+
+    monkeypatch.setattr(mod.subprocess, "run", fake_run)
+    rc = mod.main()
+    assert rc == 6
+    status = json.load(open(tmp_path / "status.json"))
+    assert status["status"] == "sweep_timeout_cap"
+
+
 def test_hook_timeout_kills_process_group(monkeypatch, tmp_path):
     """A compound hook command that outlives the bound must be killed as
     a GROUP — run(shell=True) would kill only the sh wrapper and leave

@@ -7,14 +7,16 @@ poll cheaply all session, and the MOMENT a probe succeeds run the full
 bench sweep, refreshing bench_last_tpu.json with every variant.
 
 Run detached:  nohup python tools/tpu_watch.py >> tpu_watch.log 2>&1 &
-Exits 0 after a successful sweep, 3 on deadline without ever reaching
-the TPU. To chain the heavier hardware experiments automatically while
-the tunnel is proven up, set PBT_WATCH_AFTER_SWEEP to a shell command
+Exit codes: 0 after a successful sweep; 2 another watcher is alive;
+3 deadline without ever reaching the TPU; 4 repeated non-timeout probe
+failures; 5 repeated on-TPU bench failures; 6 repeated sweep timeouts.
+To chain the heavier hardware experiments automatically while the
+tunnel is proven up, set PBT_WATCH_AFTER_SWEEP to a shell command
 (e.g. "python examples/transfer_experiment.py --scale full"); it runs
 best-effort after the sweep persists, bounded by PBT_WATCH_HOOK_TIMEOUT
-(default 7200 s, process group killed on timeout), BEFORE the daemon
-exits — so do not also start experiments manually on exit 0 when the
-hook is set.
+(default 7200 s, process group killed on timeout; <=0 means UNBOUNDED),
+BEFORE the daemon exits — so do not also start experiments manually on
+exit 0 when the hook is set.
 
 Status is mirrored to tpu_watch_status.json for cheap polling.
 """
@@ -29,14 +31,39 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 STATUS_PATH = os.path.join(REPO, "tpu_watch_status.json")
 
 sys.path.insert(0, REPO)
-from bench import atomic_json_dump, probe_tpu  # noqa: E402
+from bench import (  # noqa: E402
+    atomic_json_dump, build_variants, probe_tpu, variant_timeout,
+)
+
+
+def _default_sweep_timeout():
+    """Sized from the variant list, not a constant (ADVICE r3, medium):
+    each of the TPU variants is individually bounded by
+    PBT_BENCH_VARIANT_TIMEOUT, so a healthy cold-cache first sweep can
+    legitimately take nearly N x that; a fixed 45-min cap SIGKILLed it
+    before 'captured', and the after-sweep hook never fired.
+    gate_pallas=False keeps jax out of this daemon process (the ungated
+    count is an upper bound — exactly right for a timeout)."""
+    try:
+        n = len(build_variants(True, gate_pallas=False)[0])
+    except Exception:
+        n = 16
+    return n * variant_timeout() + 600
+
 
 PROBE_TIMEOUT = int(os.environ.get("PBT_WATCH_PROBE_TIMEOUT", 90))
 POLL_WAIT = int(os.environ.get("PBT_WATCH_POLL_WAIT", 120))
 DEADLINE_H = float(os.environ.get("PBT_WATCH_HOURS", 11))
-SWEEP_TIMEOUT = int(os.environ.get("PBT_WATCH_SWEEP_TIMEOUT", 2700))
+# Env override wins when nonzero; 0/unset derives from the variant list.
+SWEEP_TIMEOUT = (int(os.environ.get("PBT_WATCH_SWEEP_TIMEOUT", 0))
+                 or _default_sweep_timeout())
 HARD_FAIL_CAP = int(os.environ.get("PBT_WATCH_HARD_FAIL_CAP", 10))
 SWEEP_FAIL_CAP = int(os.environ.get("PBT_WATCH_SWEEP_FAIL_CAP", 3))
+# Sweep TIMEOUTS get their own cap (ADVICE r3): each one means the
+# daemon held the chip for the whole sweep budget without finishing —
+# likely a mid-run tunnel drop, worth a few retries but not an
+# unbounded loop of multi-hour SIGKILLed sweeps.
+SWEEP_TIMEOUT_CAP = int(os.environ.get("PBT_WATCH_SWEEP_TIMEOUT_CAP", 4))
 # Parsed at import like every other knob: a malformed value must fail at
 # startup, not at the single success moment hours later.
 HOOK_TIMEOUT = int(os.environ.get("PBT_WATCH_HOOK_TIMEOUT", 7200))
@@ -88,7 +115,8 @@ def main():
     n = 0
     hard_streak = 0
     sweep_failures = 0
-    put_status(status="watching", probes=0)
+    sweep_timeouts = 0
+    put_status(status="watching", probes=0, sweep_timeout_s=SWEEP_TIMEOUT)
     while time.time() - t0 < DEADLINE_H * 3600:
         n += 1
         ok, hard_fail = probe()
@@ -122,10 +150,32 @@ def main():
                     timeout=SWEEP_TIMEOUT)
             except subprocess.TimeoutExpired:
                 # bench.py persists after every variant, so whatever ran
-                # is already in bench_last_tpu.json; keep watching.
-                print("[tpu_watch] sweep timed out (tunnel dropped "
-                      "mid-run?); partial results persisted", flush=True)
-                put_status(status="sweep_timeout", probes=n)
+                # is already in bench_last_tpu.json; keep watching —
+                # but capped: each timeout burned the full sweep budget
+                # on the one shared chip.
+                sweep_timeouts += 1
+                print(f"[tpu_watch] sweep timed out after {SWEEP_TIMEOUT}s "
+                      f"({sweep_timeouts}/{SWEEP_TIMEOUT_CAP}; tunnel "
+                      "dropped mid-run?); partial results persisted",
+                      flush=True)
+                put_status(status="sweep_timeout", probes=n,
+                           timeouts=sweep_timeouts)
+                if sweep_timeouts >= SWEEP_TIMEOUT_CAP:
+                    print("[tpu_watch] repeated sweep timeouts; giving up "
+                          "so the chip stays free", flush=True)
+                    put_status(status="sweep_timeout_cap", probes=n)
+                    return 6
+                # The SIGKILLed sweep's in-flight --run-index child is
+                # NOT in our process group; it self-destructs via its
+                # own SIGALRM up to variant_timeout+60s after ITS start.
+                # Wait that bound out before re-probing so a fresh sweep
+                # never measures under contention with the orphan on the
+                # one shared chip (the skew the single-instance guard
+                # exists to prevent).
+                drain = variant_timeout() + 60
+                print(f"[tpu_watch] draining {drain}s for the orphaned "
+                      "variant child before re-probing", flush=True)
+                time.sleep(drain)
                 continue
             print(out.stderr, flush=True)
             print(out.stdout, flush=True)
@@ -135,7 +185,11 @@ def main():
                 rec = json.loads(lines[-1]) if lines else {}
             except ValueError:
                 pass
-            if rec.get("platform") == "tpu":
+            # "stale" guards against bench's CPU-fallback record, which
+            # now PROMOTES the last-good TPU row to the top level
+            # (platform "tpu" + stale true) — evidence of a PAST window,
+            # not of this sweep having captured anything.
+            if rec.get("platform") == "tpu" and not rec.get("stale"):
                 after = os.environ.get("PBT_WATCH_AFTER_SWEEP")
                 if after:
                     # Chain the heavier hardware experiments while the
@@ -161,11 +215,18 @@ def main():
                             after, shell=True, cwd=REPO,
                             start_new_session=True)
                         try:
-                            proc.wait(timeout=HOOK_TIMEOUT)
+                            # <=0 means unbounded, not instant-kill.
+                            proc.wait(timeout=HOOK_TIMEOUT
+                                      if HOOK_TIMEOUT > 0 else None)
                             print(f"[tpu_watch] hook rc="
                                   f"{proc.returncode}", flush=True)
                         except subprocess.TimeoutExpired:
-                            os.killpg(proc.pid, signal.SIGKILL)
+                            try:
+                                os.killpg(proc.pid, signal.SIGKILL)
+                            except ProcessLookupError:
+                                pass  # group exited in the gap between
+                                # TimeoutExpired and the kill (ADVICE r3)
+                            proc.wait()  # reap — no zombie child
                             print("[tpu_watch] after-sweep hook timed "
                                   "out; process group killed",
                                   flush=True)

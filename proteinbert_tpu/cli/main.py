@@ -34,6 +34,7 @@ import json
 import math
 import os
 import sys
+import time
 from typing import List, Optional
 
 from proteinbert_tpu.utils.logging import log, start_log
@@ -273,7 +274,12 @@ def cmd_pretrain(args) -> int:
             clean = {k: (v if isinstance(v, str) or math.isfinite(v)
                          else None)
                      for k, v in metrics.items()}
-            mf.write(json.dumps({"step": step, **clean}) + "\n")
+            # Wall-clock stamp: lets a slow window in the stream be
+            # correlated offline with checkpoint/eval cadence and with
+            # external events (tunnel flaps) — the r3 sustained run's
+            # collapse was unattributable without it.
+            mf.write(json.dumps({"step": step, "t": round(time.time(), 2),
+                                 **clean}) + "\n")
 
     try:
         if args.profile_dir:
@@ -304,6 +310,11 @@ def cmd_pretrain(args) -> int:
         # EX_TEMPFAIL: tells orchestrators "not done — requeue me".
         log("run was preempted; exiting 75 so a supervisor requeues it")
         return 75
+    if out.get("early_stopped"):
+        # A deliberate, checkpointed stop (eval stalled past
+        # train.early_stop_patience) — done, NOT a requeue case.
+        log("run early-stopped on a stalled eval; final state is "
+            "checkpointed")
     return 0
 
 

@@ -116,6 +116,20 @@ class OptimizerConfig:
     plateau_cooldown: int = 10              # observations to ignore after a cut
                                             # (lets the loss re-baseline before
                                             # another reduction can chain)
+    plateau_metric: str = "train_loss"      # "train_loss" | "eval_loss" — what
+                                            # reduce_on_plateau observes. The
+                                            # reference intended a METRIC-driven
+                                            # ReduceLROnPlateau (utils.py:257-264
+                                            # — it crashed); "eval_loss" feeds
+                                            # the latest cadenced held-out loss
+                                            # to the transform every step, so an
+                                            # eval-only regime shift (train loss
+                                            # falling while eval rises — the
+                                            # r3 sustained run) CAN cut the LR.
+                                            # Set plateau_window ≈ eval_every so
+                                            # one windowed observation covers one
+                                            # eval interval; requires eval_every
+                                            # > 0 and an eval split.
     grad_clip_norm: float = 1.0             # reference clips grads (utils.py:136)
     b1: float = 0.9
     b2: float = 0.999
@@ -172,6 +186,17 @@ class TrainConfig:
     on_nan: str = "halt"                    # "halt" | "warn" | "off" — NaN/Inf
                                             # watch on logged loss/grad_norm
                                             # (train/resilience.py)
+    early_stop_patience: int = 0            # consecutive cadenced evals without
+                                            # eval_loss improvement before the
+                                            # run checkpoints and stops; 0 = off.
+                                            # The best/stalled counters (and the
+                                            # latest eval loss the eval-keyed
+                                            # plateau observes) are CHECKPOINTED
+                                            # with the data position, so a
+                                            # preempt/requeue loop cannot reset
+                                            # the patience baseline.
+    early_stop_min_delta: float = 0.0       # improvement smaller than this
+                                            # still counts as a stall
     seed: int = 0
 
 

@@ -76,6 +76,20 @@ def needs_loss_value(cfg: OptimizerConfig) -> bool:
     return cfg.schedule == "warmup_plateau"
 
 
+def plateau_uses_eval(cfg: OptimizerConfig) -> bool:
+    """True when the plateau transform observes the cadenced EVAL loss
+    instead of per-step train loss — the metric-driven ReduceLROnPlateau
+    the reference intended (utils.py:257-264) and could never run. The
+    trainer then passes the latest eval loss into each train step as
+    `plateau_value`."""
+    if cfg.plateau_metric not in ("train_loss", "eval_loss"):
+        raise ValueError(
+            f"unknown plateau_metric {cfg.plateau_metric!r}; "
+            "expected 'train_loss' or 'eval_loss'")
+    return (cfg.schedule == "warmup_plateau"
+            and cfg.plateau_metric == "eval_loss")
+
+
 def effective_lr(cfg: OptimizerConfig, opt_state, step):
     """The LR in effect at update-count `step` — schedule value times the
     plateau transform's current scale when schedule == 'warmup_plateau'.

@@ -34,7 +34,7 @@ from proteinbert_tpu.train.loss import (
     global_ranking_metrics, global_ranking_stats, pretrain_loss,
 )
 from proteinbert_tpu.train.schedule import (
-    effective_lr, make_optimizer, needs_loss_value,
+    effective_lr, make_optimizer, needs_loss_value, plateau_uses_eval,
 )
 
 
@@ -73,9 +73,17 @@ def create_train_state(key: jax.Array, cfg: PretrainConfig) -> TrainState:
 
 @partial(jax.jit, static_argnames="cfg", donate_argnums=0)
 def train_step(
-    state: TrainState, batch: Dict[str, jax.Array], cfg: PretrainConfig
+    state: TrainState, batch: Dict[str, jax.Array], cfg: PretrainConfig,
+    plateau_value: Any = None,
 ) -> Tuple[TrainState, Dict[str, jax.Array]]:
-    """One fused pretraining step on CLEAN {"tokens","annotations"} batch."""
+    """One fused pretraining step on CLEAN {"tokens","annotations"} batch.
+
+    `plateau_value`: host-provided scalar the reduce_on_plateau transform
+    observes INSTEAD of this step's train loss, when
+    cfg.optimizer.plateau_metric == "eval_loss" (the trainer passes the
+    latest cadenced eval loss; +inf means "no eval yet" and falls back
+    to the train loss so the placeholder can't tick the patience
+    counter)."""
     key, step_key = jax.random.split(state.key)
     X, Y, W = corrupt_batch(
         step_key,
@@ -96,9 +104,16 @@ def train_step(
 
     grads, metrics = jax.grad(loss_fn, has_aux=True)(state.params)
 
+    value = metrics["loss"]
+    if plateau_uses_eval(cfg.optimizer) and plateau_value is not None:
+        # +inf = "no eval yet": observe the train loss until the first
+        # real eval value arrives, so the pre-eval steps cannot tick the
+        # plateau's patience counter on a meaningless placeholder.
+        pv = jnp.asarray(plateau_value, dtype=jnp.float32)
+        value = jnp.where(jnp.isfinite(pv), pv, metrics["loss"])
     params, opt_state = gradient_update(
         make_optimizer(cfg.optimizer), state.params, grads, state.opt_state,
-        metrics["loss"], needs_loss_value(cfg.optimizer),
+        value, needs_loss_value(cfg.optimizer),
     )
 
     metrics = dict(metrics)

@@ -67,13 +67,58 @@ def pretrain(
       log_fn: optional callable(step, metrics_dict) for external loggers.
     """
     batches_consumed = 0
+    # Eval-stream state. last_eval_loss feeds the eval-keyed plateau
+    # (+inf = "no eval yet" → train_step falls back to train loss);
+    # best/stalled drive early stopping. All three are CHECKPOINTED
+    # (below, alongside batches_consumed) and restored here: resetting
+    # them on resume would (a) let the post-resume steps feed train loss
+    # into the restored reduce_on_plateau state — poisoning its
+    # best_value with train-scale values in exactly the train<<eval
+    # regime the feature targets — and (b) make early stop inert under
+    # the exit-75 requeue loop (each requeue would restart the patience
+    # counter from a fresh +inf baseline).
+    last_eval_loss = np.float32(np.inf)
+    best_eval_loss = float("inf")
+    stalled_evals = 0
     if state is None:
         state = ts.create_train_state(jax.random.PRNGKey(cfg.train.seed), cfg)
+        if mesh is not None:
+            # Place the fresh state per the sharding rules BEFORE any
+            # restore: the checkpoint template's shardings tell orbax
+            # where each shard goes (checkpoint.py:49-66) — restoring
+            # into an unsharded template under a mesh would land the
+            # whole state on one device (and under multi-host, make the
+            # collective restore inconsistent). Also makes the fsdp/tp
+            # intent of cfg.mesh actually apply to CLI-created states.
+            from proteinbert_tpu.parallel.sharding import shard_train_state
+
+            state = shard_train_state(state, mesh)
         if checkpointer is not None and checkpointer.latest_step() is not None:
             state, data_state = checkpointer.restore(state)
             batches_consumed = int((data_state or {}).get("batches_consumed", 0))
+            es = (data_state or {}).get("eval_stream") or {}
+            if es:
+                # None encodes +inf (inf is not strict-JSON).
+                last_eval_loss = np.float32(
+                    es["last"] if es.get("last") is not None else np.inf)
+                best_eval_loss = (float(es["best"])
+                                  if es.get("best") is not None
+                                  else float("inf"))
+                stalled_evals = int(es.get("stalled", 0))
             logger.info("resumed from checkpoint at step %d (%d batches consumed)",
                         int(state.step), batches_consumed)
+
+    def data_state_for(consumed: int) -> Dict[str, Any]:
+        d: Dict[str, Any] = {"batches_consumed": consumed}
+        if np.isfinite(last_eval_loss) or stalled_evals:
+            d["eval_stream"] = {
+                "last": (float(last_eval_loss)
+                         if np.isfinite(last_eval_loss) else None),
+                "best": (float(best_eval_loss)
+                         if np.isfinite(best_eval_loss) else None),
+                "stalled": stalled_evals,
+            }
+        return d
 
     if callable(batch_iterator):
         batch_iterator = batch_iterator(batches_consumed)
@@ -100,11 +145,30 @@ def pretrain(
     # kernel under sequence parallelism (a pallas_call is opaque to the
     # partitioner) — that combination runs the explicit shard_map step
     # (parallel/seq_parallel.py).
+    from proteinbert_tpu.train.schedule import plateau_uses_eval
+
+    eval_keyed_plateau = plateau_uses_eval(cfg.optimizer)
+    if eval_keyed_plateau and (eval_batches is None
+                               or not cfg.train.eval_every):
+        raise ValueError(
+            "optimizer.plateau_metric='eval_loss' needs a cadenced eval "
+            "stream: pass eval_batches and set train.eval_every > 0")
+    if cfg.train.early_stop_patience and (eval_batches is None
+                                          or not cfg.train.eval_every):
+        raise ValueError(
+            "train.early_stop_patience needs a cadenced eval stream: "
+            "pass eval_batches and set train.eval_every > 0")
+
     if mesh is not None and cfg.mesh.seq > 1 and cfg.model.use_pallas:
         from proteinbert_tpu.parallel.seq_parallel import (
             make_seq_parallel_train_step,
         )
 
+        if eval_keyed_plateau:
+            raise ValueError(
+                "plateau_metric='eval_loss' is not supported with the "
+                "explicit sequence-parallel pallas step (its shard_map "
+                "step takes no plateau_value input)")
         seq_step = make_seq_parallel_train_step(mesh, cfg)
         step_fn = lambda state, batch, _cfg: seq_step(state, batch)  # noqa: E731
         logger.info("using explicit sequence-parallel train step (pallas)")
@@ -121,6 +185,7 @@ def pretrain(
     )
     history: list = []
     preempted = False
+    early_stopped = False
     diagnostic_saved = False
     metrics = None
 
@@ -136,7 +201,11 @@ def pretrain(
     with GracefulShutdown() as stop:
       for step in range(start_step, cfg.train.max_steps):
         batch = next(batch_iterator)
-        state, metrics = step_fn(state, put(batch), cfg)
+        if eval_keyed_plateau:
+            state, metrics = ts.train_step(state, put(batch), cfg,
+                                           plateau_value=last_eval_loss)
+        else:
+            state, metrics = step_fn(state, put(batch), cfg)
         timer.update()
         if step - start_step + 1 == timer.warmup_steps:
             # Guaranteed drain at the warmup boundary: t0 was just
@@ -188,7 +257,7 @@ def pretrain(
                         checkpointer.directory + "-diagnostic",
                         max_to_keep=1, async_save=False)
                     diag.save(step + 1, state,
-                              {"batches_consumed": step + 1,
+                              {**data_state_for(step + 1),
                                "non_finite": True})
                     diag.close()
                     diagnostic_saved = True
@@ -202,8 +271,13 @@ def pretrain(
                 "step %d loss %.4f (local %.4f global %.4f) acc %.3f %s",
                 step + 1, m["loss"], m["local_loss"], m["global_loss"],
                 m["local_acc"],
-                f"{m['residues_per_sec_per_chip']:.0f} res/s/chip "
-                f"MFU {m['mfu']:.3f}" if "mfu" in m else "",
+                (f"{m['residues_per_sec_per_chip']:.0f} res/s/chip "
+                 f"MFU {m['mfu']:.3f}"
+                 # The since-last-log rate tells a live operator
+                 # "currently slow" apart from "was slow once" — the
+                 # cumulative MFU alone re-reports an old stall forever.
+                 + (f" (window {m['window_mfu']:.3f})"
+                    if "window_mfu" in m else "")) if "mfu" in m else "",
             )
             if log_fn is not None:
                 log_fn(step + 1, m)
@@ -213,8 +287,7 @@ def pretrain(
             # completed step and exit cleanly; resume picks up exactly here.
             drain_and_sync()
             if checkpointer is not None:
-                checkpointer.save(step + 1, state,
-                                  {"batches_consumed": step + 1})
+                checkpointer.save(step + 1, state, data_state_for(step + 1))
                 checkpointer.wait()
             logger.warning("preempted at step %d: state saved, exiting",
                            step + 1)
@@ -244,6 +317,28 @@ def pretrain(
             )
             if log_fn is not None:
                 log_fn(step + 1, em)
+            last_eval_loss = np.float32(em["eval_loss"])
+            if em["eval_loss"] < best_eval_loss - cfg.train.early_stop_min_delta:
+                best_eval_loss = em["eval_loss"]
+                stalled_evals = 0
+            else:
+                stalled_evals += 1
+                if (cfg.train.early_stop_patience
+                        and stalled_evals >= cfg.train.early_stop_patience):
+                    # The regime shift the r3 sustained run exposed: eval
+                    # rising while train loss falls. Checkpoint the state
+                    # and stop — continuing only overfits further.
+                    drain_and_sync()
+                    if checkpointer is not None:
+                        checkpointer.save(step + 1, state,
+                                          data_state_for(step + 1))
+                        checkpointer.wait()
+                    logger.warning(
+                        "early stop at step %d: eval_loss has not improved "
+                        "for %d consecutive evals (best %.4f)",
+                        step + 1, stalled_evals, best_eval_loss)
+                    early_stopped = True
+                    break
 
         if (
             checkpointer is not None
@@ -256,18 +351,18 @@ def pretrain(
             # the window when a later sync() extends it.
             drain_and_sync()
             t_save = time.perf_counter()
-            checkpointer.save(step + 1, state, {"batches_consumed": step + 1})
+            checkpointer.save(step + 1, state, data_state_for(step + 1))
             timer.discount(time.perf_counter() - t_save)
 
-    if not preempted:
+    if not preempted and not early_stopped:
         drain_and_sync()
-    if checkpointer is not None and not preempted:
-        checkpointer.save(cfg.train.max_steps, state,
-                          {"batches_consumed": cfg.train.max_steps})
-        checkpointer.wait()
+        if checkpointer is not None:
+            checkpointer.save(cfg.train.max_steps, state,
+                              data_state_for(cfg.train.max_steps))
+            checkpointer.wait()
 
     return {"state": state, "history": history, "perf": timer.summary(),
-            "preempted": preempted}
+            "preempted": preempted, "early_stopped": early_stopped}
 
 
 def eval_base_key(cfg: PretrainConfig, step: int) -> jax.Array:

@@ -12,14 +12,25 @@ process boundaries — the plan SURVEY §5 (distributed backend bullet)
 prescribes, executed for real.
 
 Usage: python multihost_child.py <process_id> <num_processes> <port> [mode]
+                                 [ckpt_dir] [kill_at]
 mode: "plain" (default) — fixed-shape make_pretrain_iterator;
       "bucketed" — make_bucketed_iterator, exercising the multi-host
       LOCKSTEP invariant (every host must emit the same bucket shape at
       every step or the collective step deadlocks/mismatches) across a
-      real process boundary.
-Prints one line per step: STEP <i> LOSS <float>  (process 0 only).
+      real process boundary;
+      "preempt" — 6-step run with an orbax checkpointer in <ckpt_dir>;
+      on a FRESH directory every process SIGTERMs itself at step
+      <kill_at> (kill_at=0: run straight through), driving the
+      GracefulShutdown → collective orbax save path and exiting 75;
+      re-launched on the now-populated directory it restores (mesh-
+      sharded template), fast-forwards the data stream, and completes —
+      the two-process preemption/resume drill of VERDICT r3 item 7.
+Prints one line per step: STEP <i> LOSS <float>  (process 0 only),
+plus "PREEMPTED <step>" when the drill's SIGTERM fired.
 """
 
+import os
+import signal
 import sys
 
 
@@ -59,6 +70,7 @@ def main() -> None:
     from proteinbert_tpu.train import create_train_state, pretrain
 
     global_batch = 8
+    max_steps = 6 if mode == "preempt" else 3
     cfg = PretrainConfig(
         model=ModelConfig(
             local_dim=16, global_dim=32, key_dim=8, num_heads=4,
@@ -69,7 +81,7 @@ def main() -> None:
         optimizer=OptimizerConfig(
             learning_rate=1e-3, warmup_steps=4, schedule="constant"),
         mesh=MeshConfig(data=n_devices),
-        train=TrainConfig(max_steps=3, log_every=1),
+        train=TrainConfig(max_steps=max_steps, log_every=1),
     )
 
     # Every process builds the same full dataset (same seed); the
@@ -84,18 +96,61 @@ def main() -> None:
                                         crop_seed=7)
         buckets = (16, cfg.data.seq_len)
 
-        def host_iter(pid, pcount, batch):
+        def host_iter(pid, pcount, batch, skip=0):
             return make_bucketed_iterator(
                 ds, batch, buckets, seed=1,
-                process_index=pid, process_count=pcount)
+                process_index=pid, process_count=pcount, skip_batches=skip)
     else:
         seqs, ann = make_random_proteins(16, rng, num_annotations=32,
                                          max_len=40)
         ds = InMemoryPretrainingDataset(seqs, ann, cfg.data.seq_len)
 
-        def host_iter(pid, pcount, batch):
+        def host_iter(pid, pcount, batch, skip=0):
             return make_pretrain_iterator(
-                ds, batch, seed=1, process_index=pid, process_count=pcount)
+                ds, batch, seed=1, process_index=pid, process_count=pcount,
+                skip_batches=skip)
+
+    if mode == "preempt":
+        ckpt_dir, kill_at = sys.argv[5], int(sys.argv[6])
+
+        from proteinbert_tpu.train.checkpoint import Checkpointer
+
+        # Sync save: the drill must be deterministic step-for-step; the
+        # async path's timing is exercised by the hardware sustained run.
+        ckpt = Checkpointer(ckpt_dir, async_save=False)
+        fresh = ckpt.latest_step() is None
+
+        def factory(skip):
+            return host_iter(process_id, num_processes, cfg.data.batch_size,
+                             skip)
+
+        kill_hook = None
+        if fresh and kill_at:
+            # Every process SIGTERMs ITSELF at the same step — the
+            # deterministic stand-in for a pod-wide preemption notice;
+            # GracefulShutdown then drives the collective orbax save.
+            def kill_hook(step, m):
+                if step == kill_at:
+                    os.kill(os.getpid(), signal.SIGTERM)
+
+        mesh = make_mesh(cfg.mesh, jax.devices())
+        losses = []
+
+        def record(step, m):
+            if "loss" in m:
+                losses.append((step, m["loss"]))
+            if kill_hook is not None:
+                kill_hook(step, m)
+
+        out = pretrain(cfg, factory, state=None, checkpointer=ckpt,
+                       mesh=mesh, log_fn=record)
+        ckpt.close()
+        if process_id == 0:
+            for step, loss in losses:
+                print(f"STEP {step} LOSS {loss:.8f}", flush=True)
+            if out["preempted"]:
+                print(f"PREEMPTED {int(out['state'].step)}", flush=True)
+        sys.exit(75 if out["preempted"] else 0)
 
     if num_processes > 1:
         it = host_iter(process_id, num_processes, cfg.data.batch_size)

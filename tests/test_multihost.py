@@ -43,6 +43,67 @@ def _parse_losses(stdout: str):
     return losses
 
 
+def _run_pair(port, env, mode, extra, timeout=600, expect_rc=0):
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _CHILD, str(pid), "2", str(port), mode, *extra],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, cwd=_REPO,
+        )
+        for pid in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=timeout)
+            outs.append((p.returncode, out, err))
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        raise
+    for rc, out, err in outs:
+        assert rc == expect_rc, (
+            f"child rc {rc} (wanted {expect_rc}):\n{err[-3000:]}")
+    return outs
+
+
+@pytest.mark.slow
+def test_two_process_preemption_resume_parity(tmp_path):
+    """VERDICT r3 item 7: SIGTERM both processes mid-run (collective
+    orbax save through GracefulShutdown, exit 75), relaunch the same
+    command (mesh-sharded template restore + data fast-forward), and
+    assert the combined loss stream equals an uninterrupted two-process
+    twin's step for step."""
+    env = _child_env()
+    ckpt = str(tmp_path / "ckpt")
+
+    # Phase 1: fresh dir, self-SIGTERM at step 3 -> both exit 75.
+    outs = _run_pair(_free_port(), env, "preempt", [ckpt, "3"],
+                     expect_rc=75)
+    phase1 = _parse_losses(outs[0][1])
+    assert "PREEMPTED 3" in outs[0][1]
+    assert set(phase1) == {1, 2, 3}
+
+    # Phase 2: identical command on the populated dir -> restore at 3,
+    # fast-forward, complete steps 4-6.
+    outs = _run_pair(_free_port(), env, "preempt", [ckpt, "3"])
+    phase2 = _parse_losses(outs[0][1])
+    assert set(phase2) == {4, 5, 6}
+
+    # Twin: fresh dir, never killed, runs 1-6 uninterrupted.
+    twin_ckpt = str(tmp_path / "twin")
+    outs = _run_pair(_free_port(), env, "preempt", [twin_ckpt, "0"])
+    twin = _parse_losses(outs[0][1])
+    assert set(twin) == {1, 2, 3, 4, 5, 6}
+
+    resumed = {**phase1, **phase2}
+    for step in range(1, 7):
+        # Same topology, same restored RNG/opt state, same data stream
+        # position: the seam must be invisible in the loss stream.
+        assert resumed[step] == pytest.approx(twin[step], rel=1e-6), (
+            step, resumed, twin)
+
+
 @pytest.mark.slow
 @pytest.mark.parametrize("mode", ["plain", "bucketed"])
 def test_two_process_training_matches_single_process(mode):

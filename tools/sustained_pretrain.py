@@ -123,7 +123,17 @@ def main():
            "--set", f"optimizer.warmup_steps={S['warmup']}",
            "--set", f"train.log_every={S['log_every']}",
            "--set", f"train.eval_every={S['eval_every']}",
-           "--set", f"checkpoint.every_steps={S['ckpt_every']}"]
+           "--set", f"checkpoint.every_steps={S['ckpt_every']}",
+           # Eval-keyed plateau (VERDICT r3 Weak #5): the r3 run's eval
+           # rose for 1,500 steps while the train-loss plateau held LR
+           # flat. One observation per eval interval; patience 3 evals
+           # so a sustained-run-scale stall CAN cut within the run.
+           # (early_stop is NOT drilled here — it would end the run
+           # early and break the gapless-stream assertions below.)
+           "--set", "optimizer.plateau_metric=eval_loss",
+           "--set", f"optimizer.plateau_window={S['eval_every']}",
+           "--set", "optimizer.plateau_patience=3",
+           "--set", "optimizer.plateau_cooldown=2"]
 
     # ---- phase 1: run until kill_at, then SIGTERM (preemption drill)
     print("+ " + " ".join(cmd[2:]), file=sys.stderr, flush=True)
@@ -182,6 +192,30 @@ def main():
     assert evals, "no eval records"
 
     first, last = dedup[expect[0]], dedup[expect[-1]]
+    # Windowed (since-last-log) throughput: the per-window stream is
+    # what localizes a transient stall (VERDICT r3 Weak #1/#2 — the r3
+    # collapse was invisible behind the cumulative rate). Slow windows
+    # are reported with their wall-clock stamps so they can be
+    # correlated with ckpt/eval cadence and external (tunnel) events.
+    wins = [(s, dedup[s]["window_mfu"], dedup[s].get("t"))
+            for s in expect if dedup[s].get("window_mfu") is not None]
+    window_report = None
+    if wins:
+        vals = sorted(w for _, w, _ in wins)
+        med = vals[len(vals) // 2]
+        window_report = {
+            "median_mfu": med,
+            "min_mfu": vals[0], "max_mfu": vals[-1],
+            "slow_windows": [(s, round(w, 4), t) for s, w, t in wins
+                             if w < 0.5 * med],
+        }
+    # LR cuts (plateau firing): consecutive post-warmup logged LRs
+    # dropping by ≥2x.
+    lr_cuts = [expect[i] for i in range(1, len(expect))
+               if expect[i] > S["warmup"]
+               and dedup[expect[i - 1]]["lr"] > 0
+               and dedup[expect[i]]["lr"]
+               < 0.55 * dedup[expect[i - 1]]["lr"]]
     summary = {
         "scale": args.scale, "steps": S["steps"], "killed_at": killed_at,
         "resume_rc": (rc1, rc2),
@@ -190,6 +224,8 @@ def main():
         "eval_losses": [(r["step"], r["eval_loss"]) for r in evals],
         "final_mfu": last.get("mfu"),
         "res_per_sec": last.get("residues_per_sec_per_chip"),
+        "windows": window_report,
+        "lr_cuts_at": lr_cuts,
         "seam": {
             "killed_at": killed_at,
             "loss_before": dedup[max(s for s in expect

@@ -68,6 +68,17 @@ class Checkpointer:
     def latest_step(self) -> Optional[int]:
         return self._mngr.latest_step()
 
+    def in_flight(self) -> bool:
+        """True while an async save is still writing. The trainer ORs
+        this with a started-since-last-log latch and stamps the result
+        into each logged metrics record (`ckpt_in_flight`) so a slow
+        window in the stream can be attributed to (or cleared of)
+        checkpoint I/O contending for host/tunnel bandwidth — the
+        leading suspect for the r3 sustained run's collapse. (The latch
+        matters: a point sample alone would miss a save that started
+        and finished between two log points.)"""
+        return bool(self._mngr.is_saving_in_progress())
+
     def wait(self) -> None:
         """Block until pending async saves land (call before process exit)."""
         self._mngr.wait_until_finished()

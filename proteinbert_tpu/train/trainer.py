@@ -187,6 +187,7 @@ def pretrain(
     preempted = False
     early_stopped = False
     diagnostic_saved = False
+    ckpt_since_log = False  # a save started since the last log point
     metrics = None
 
     def drain_and_sync():
@@ -266,6 +267,15 @@ def pretrain(
                 # Raises in halt mode; logs the warning in warn mode.
                 check_finite(m, step + 1, mode=cfg.train.on_nan)
             m.update(timer.summary())
+            if checkpointer is not None:
+                # Attribution flag, not a metric: 1.0 when a checkpoint
+                # save overlapped this log window — still writing now OR
+                # started since the last log point (the latch catches a
+                # save that started AND finished inside the window,
+                # which a point sample at the log instant would miss).
+                m["ckpt_in_flight"] = float(checkpointer.in_flight()
+                                            or ckpt_since_log)
+                ckpt_since_log = False
             history.append({"step": step + 1, **m})
             logger.info(
                 "step %d loss %.4f (local %.4f global %.4f) acc %.3f %s",
@@ -352,6 +362,7 @@ def pretrain(
             drain_and_sync()
             t_save = time.perf_counter()
             checkpointer.save(step + 1, state, data_state_for(step + 1))
+            ckpt_since_log = True
             timer.discount(time.perf_counter() - t_save)
 
     if not preempted and not early_stopped:

@@ -542,6 +542,48 @@ def test_early_stop_on_eval_stall(tmp_path):
     ckpt.close()
 
 
+def test_ckpt_in_flight_flag_logged(tmp_path):
+    """Every logged train record carries the async-save-in-flight flag
+    when a checkpointer is attached (the attribution signal for slow
+    windows); absent without one."""
+    from proteinbert_tpu.data import InMemoryPretrainingDataset, \
+        make_pretrain_iterator
+    from proteinbert_tpu.data.synthetic import make_random_proteins
+    from proteinbert_tpu.train.checkpoint import Checkpointer
+    from proteinbert_tpu.train.trainer import pretrain
+
+    rng = np.random.default_rng(0)
+    seqs, ann = make_random_proteins(32, rng, num_annotations=64)
+    ds = InMemoryPretrainingDataset(seqs, ann, 64)
+    cfg = _early_stop_cfg(max_steps=4, log_every=1)
+    ck = Checkpointer(str(tmp_path / "ck"), async_save=False)
+    out = pretrain(cfg, make_pretrain_iterator(ds, 8, seed=0),
+                   checkpointer=ck)
+    ck.close()
+    train_recs = [h for h in out["history"] if "loss" in h]
+    assert train_recs and all("ckpt_in_flight" in r for r in train_recs)
+    # Default cadence (1000) means no periodic save in 4 steps.
+    assert all(r["ckpt_in_flight"] == 0.0 for r in train_recs)
+    out2 = pretrain(cfg, make_pretrain_iterator(ds, 8, seed=0))
+    assert all("ckpt_in_flight" not in h for h in out2["history"])
+
+    # The latch: a save at step 2 must flag the NEXT log record (step 3)
+    # even if the (async) save already finished — a point sample at the
+    # log instant would report the r3-style save-contended window clean.
+    from proteinbert_tpu.configs import CheckpointConfig
+
+    cfg2 = cfg.replace(checkpoint=CheckpointConfig(
+        directory=str(tmp_path / "ck2"), every_steps=2, async_save=True))
+    ck2 = Checkpointer(str(tmp_path / "ck2"), async_save=True)
+    out3 = pretrain(cfg2, make_pretrain_iterator(ds, 8, seed=0),
+                    checkpointer=ck2)
+    ck2.close()
+    flags = {h["step"]: h["ckpt_in_flight"] for h in out3["history"]
+             if "loss" in h}
+    assert flags[3] == 1.0  # window containing the step-2 save
+    assert flags[2] == 0.0  # stamped before that save starts
+
+
 def test_eval_stream_state_survives_resume(tmp_path):
     """The early-stop baseline and the plateau's observed eval loss are
     checkpointed: a preempt/requeue loop must not reset the patience

@@ -203,11 +203,15 @@ def main():
     if wins:
         vals = sorted(w for _, w, _ in wins)
         med = vals[len(vals) // 2]
+        slow = [(s, w, t) for s, w, t in wins if w < 0.5 * med]
         window_report = {
             "median_mfu": med,
             "min_mfu": vals[0], "max_mfu": vals[-1],
-            "slow_windows": [(s, round(w, 4), t) for s, w, t in wins
-                             if w < 0.5 * med],
+            "slow_windows": [(s, round(w, 4), t) for s, w, t in slow],
+            # Attribution: which slow windows a checkpoint save
+            # overlapped (the r3 collapse suspect).
+            "slow_with_ckpt_in_flight": [
+                s for s, _, _ in slow if dedup[s].get("ckpt_in_flight")],
         }
     # LR cuts (plateau firing): consecutive post-warmup logged LRs
     # dropping by ≥2x.

@@ -68,17 +68,19 @@ def _run_pair(port, env, mode, extra, timeout=600, expect_rc=0):
 
 
 @pytest.mark.slow
-def test_two_process_preemption_resume_parity(tmp_path):
+@pytest.mark.parametrize("mode", ["preempt", "preempt-bucketed"])
+def test_two_process_preemption_resume_parity(tmp_path, mode):
     """VERDICT r3 item 7: SIGTERM both processes mid-run (collective
     orbax save through GracefulShutdown, exit 75), relaunch the same
     command (mesh-sharded template restore + data fast-forward), and
     assert the combined loss stream equals an uninterrupted two-process
-    twin's step for step."""
+    twin's step for step. The bucketed variant crosses the resume seam
+    with the lockstep bucket bookkeeping live."""
     env = _child_env()
     ckpt = str(tmp_path / "ckpt")
 
     # Phase 1: fresh dir, self-SIGTERM at step 3 -> both exit 75.
-    outs = _run_pair(_free_port(), env, "preempt", [ckpt, "3"],
+    outs = _run_pair(_free_port(), env, mode, [ckpt, "3"],
                      expect_rc=75)
     phase1 = _parse_losses(outs[0][1])
     assert "PREEMPTED 3" in outs[0][1]
@@ -86,13 +88,13 @@ def test_two_process_preemption_resume_parity(tmp_path):
 
     # Phase 2: identical command on the populated dir -> restore at 3,
     # fast-forward, complete steps 4-6.
-    outs = _run_pair(_free_port(), env, "preempt", [ckpt, "3"])
+    outs = _run_pair(_free_port(), env, mode, [ckpt, "3"])
     phase2 = _parse_losses(outs[0][1])
     assert set(phase2) == {4, 5, 6}
 
     # Twin: fresh dir, never killed, runs 1-6 uninterrupted.
     twin_ckpt = str(tmp_path / "twin")
-    outs = _run_pair(_free_port(), env, "preempt", [twin_ckpt, "0"])
+    outs = _run_pair(_free_port(), env, mode, [twin_ckpt, "0"])
     twin = _parse_losses(outs[0][1])
     assert set(twin) == {1, 2, 3, 4, 5, 6}
 

@@ -265,15 +265,15 @@ def build_variants(on_tpu, gate_pallas=True):
         base = ModelConfig(local_dim=512, global_dim=512, key_dim=64,
                            num_heads=8, num_blocks=6, dtype="bfloat16")
         convs = dataclasses.replace(base, remat=True, remat_policy="convs")
+        # ORDER = PRIORITY: the tunnel can drop mid-sweep and the parent
+        # persists after every variant, so the variants a short window
+        # must settle come first — the north-star headline (freshness),
+        # then the UNMEASURED scan-boundary levers (VERDICT r3 item 1),
+        # then the large/long provenance rows (item 4); re-confirmations
+        # of shapes that already have rows run last.
         variants = [  # (name, model, seq_len, batch)
             # North-star shape: seq_len 1024 (same tokens/step as 512@512).
-            ("remat-convs", convs, 1024, 128),
             ("remat-convs", convs, 1024, 256),
-            # Batch is the biggest lever (docs/performance.md); push the
-            # north-star shape until HBM says stop — the in-loop skip
-            # keeps an OOM from killing the sweep.
-            ("remat-convs", convs, 1024, 384),
-            ("remat-convs", convs, 1024, 512),
             # Partial scan unroll: XLA sees 2/3 block bodies per scan
             # iteration and can keep activation layouts across them —
             # targeting the measured scan-boundary transpose cost
@@ -289,6 +289,29 @@ def build_variants(on_tpu, gate_pallas=True):
             ("remat-convs-st",
              dataclasses.replace(convs, scan_split_transpose=True),
              1024, 256),
+        ]
+        # Large (12-block/d=1024) and long-context (L=2048) preset shapes
+        # at their measured-best single-chip batches, so the flagship
+        # BASELINE.md claims (0.69 MFU Large, 0.57 long) get timestamped
+        # machine-readable provenance in bench_last_tpu.json instead of
+        # living only in round-2 prose (VERDICT r3 Weak #3). Small
+        # batches keep each row inside the per-variant timeout. The
+        # models come FROM the presets so a preset change can never make
+        # these rows silently certify a different shape than they claim.
+        from proteinbert_tpu.configs import get_preset
+
+        variants += [
+            ("large", get_preset("large").model, 1024, 32),
+            ("large", get_preset("large").model, 1024, 64),
+            ("long", get_preset("long").model, 2048, 32),
+        ]
+        variants += [
+            # Batch is the biggest lever (docs/performance.md); push the
+            # north-star shape until HBM says stop — the in-loop skip
+            # keeps an OOM from killing the sweep.
+            ("remat-convs", convs, 1024, 128),
+            ("remat-convs", convs, 1024, 384),
+            ("remat-convs", convs, 1024, 512),
             # Full remat at the same shape so the convs-policy comparison
             # stays same-batch (ADVICE r1).
             ("xla-remat", dataclasses.replace(base, remat=True), 1024, 256),
@@ -305,21 +328,6 @@ def build_variants(on_tpu, gate_pallas=True):
             ("pallas", dataclasses.replace(base, use_pallas=True), 512, 64),
             ("pallas", dataclasses.replace(base, use_pallas=True), 512, 256),
             ("pallas", dataclasses.replace(base, use_pallas=True), 512, 512),
-        ]
-        # Large (12-block/d=1024) and long-context (L=2048) preset shapes
-        # at their measured-best single-chip batches, so the flagship
-        # BASELINE.md claims (0.69 MFU Large, 0.57 long) get timestamped
-        # machine-readable provenance in bench_last_tpu.json instead of
-        # living only in round-2 prose (VERDICT r3 Weak #3). Small
-        # batches keep each row inside the per-variant timeout. The
-        # models come FROM the presets so a preset change can never make
-        # these rows silently certify a different shape than they claim.
-        from proteinbert_tpu.configs import get_preset
-
-        variants += [
-            ("large", get_preset("large").model, 1024, 32),
-            ("large", get_preset("large").model, 1024, 64),
-            ("long", get_preset("long").model, 2048, 32),
         ]
         steps = 15
         if gate_pallas:

@@ -3,6 +3,7 @@ parent↔child `--run-index` protocol and the persisted-record merge rely
 on, plus the last-good merge semantics themselves."""
 
 import json
+import os
 
 import bench
 
@@ -93,6 +94,48 @@ def test_cpu_fallback_promotes_stale_tpu_record(tmp_path, monkeypatch,
     assert record["live_fallback"]["platform"] == "cpu"
     assert record["live_fallback"]["value"] == 4000.0
     assert "sweep" not in record and len(line) < 600
+
+
+def test_sweep_decision_tool(tmp_path):
+    """tools/sweep_decision.py: the defaults-flip call must be the
+    data's — win only above the noise threshold, null below it,
+    unmeasured when rows are absent."""
+    import subprocess
+    import sys as _sys
+
+    tool = os.path.join(os.path.dirname(__file__), "..", "tools",
+                        "sweep_decision.py")
+
+    def run(rows):
+        p = tmp_path / "lg.json"
+        p.write_text(json.dumps({"platform": "tpu", "sweep": rows}))
+        out = subprocess.run([_sys.executable, tool, str(p)],
+                             capture_output=True, text=True)
+        assert out.returncode in (0, 1), out.stderr
+        return json.loads(out.stdout)
+
+    base = {"variant": "remat-convs", "seq_len": 1024, "batch": 256,
+            "residues_per_sec": 563000.0, "mfu": 0.567}
+
+    def sv(name, rps):
+        return {"variant": name, "seq_len": 1024, "batch": 256,
+                "residues_per_sec": rps, "mfu": 0.57}
+
+    assert run([base])["decision"] == "unmeasured"
+    # +3% u2: clears the 1.5% bar (decisive even with siblings missing).
+    d = run([base, sv("remat-convs-u2", 580000.0)])
+    assert d["decision"] == "flip-default:remat-convs-u2"
+    # +0.5% with a sibling still unmeasured: the question stays OPEN —
+    # a null close needs every lever measured.
+    d = run([base, sv("remat-convs-u2", 565800.0),
+             sv("remat-convs-st", 540000.0)])
+    assert d["decision"] == "partially-measured"
+    # All three measured, none above noise: the recorded null result.
+    d = run([base, sv("remat-convs-u2", 565800.0),
+             sv("remat-convs-u3", 560000.0),
+             sv("remat-convs-st", 540000.0)])
+    assert d["decision"] == "null-result"
+    assert run([])["decision"] == "no-baseline"
 
 
 class _FakeCompleted:

@@ -1,0 +1,102 @@
+"""Decide the scan-boundary-lever question from the persisted sweep.
+
+VERDICT r3 item 1's closure condition: once `bench_last_tpu.json` holds
+rows for `remat-convs-u2/-u3/-st` at the north-star shape (1024/256),
+either a variant WINS — flip the preset defaults and re-run the trace
+attribution — or none does and the null result gets recorded and the
+knobs stay documented as experimental. This tool turns the persisted
+rows into that decision deterministically, so the call is the data's,
+not the operator's mood: a variant must beat the same-shape
+`remat-convs` baseline by >WIN_THRESHOLD (default 1.5% — roughly 3x the
+observed re-measurement noise at this shape, BASELINE.md's 563-565k
+band) to flip anything.
+
+Usage: python tools/sweep_decision.py   # prints one JSON line
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WIN_THRESHOLD = float(os.environ.get("PBT_SWEEP_WIN_THRESHOLD", 0.015))
+
+BASELINE_KEY = ("remat-convs", 1024, 256)
+SCAN_VARIANTS = ("remat-convs-u2", "remat-convs-u3", "remat-convs-st")
+PROVENANCE = (("large", 1024, 32), ("large", 1024, 64), ("long", 2048, 32))
+
+
+def main() -> int:
+    # argv[1] overrides the data path (tests point it at fixtures).
+    path = (sys.argv[1] if len(sys.argv) > 1
+            else os.path.join(REPO, "bench_last_tpu.json"))
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+    except (OSError, ValueError) as e:
+        print(json.dumps({"decision": "no-data", "error": str(e)}))
+        return 1
+    rows = {(r["variant"], r["seq_len"], r["batch"]): r
+            for r in rec.get("sweep", [])}
+    base = rows.get(BASELINE_KEY)
+    out = {
+        "baseline": base,
+        "threshold": WIN_THRESHOLD,
+        "scan_variants": {},
+        "provenance_rows": {
+            "/".join(map(str, k)): (rows[k]["mfu"] if k in rows else None)
+            for k in PROVENANCE},
+    }
+    if base is None:
+        out["decision"] = "no-baseline"
+        print(json.dumps(out))
+        return 1
+    best_name, best_gain = None, 0.0
+    measured = 0
+    for name in SCAN_VARIANTS:
+        r = rows.get((name, 1024, 256))
+        if r is None:
+            out["scan_variants"][name] = None
+            continue
+        measured += 1
+        gain = r["residues_per_sec"] / base["residues_per_sec"] - 1.0
+        out["scan_variants"][name] = {
+            "mfu": r["mfu"], "gain_vs_baseline": round(gain, 4),
+            "captured_at": r.get("captured_at")}
+        if gain > best_gain:
+            best_name, best_gain = name, gain
+    if best_name is not None and best_gain > WIN_THRESHOLD:
+        # A measured winner is decisive even if a sibling variant is
+        # still missing — flipping to a >threshold improvement cannot
+        # be invalidated by the unmeasured row (at worst it wins more).
+        out["decision"] = f"flip-default:{best_name}"
+        out["action"] = (
+            f"{best_name} beats remat-convs by {best_gain:+.1%}: set the "
+            "base/long preset scan knob accordingly, re-run "
+            "tools/trace_attribution.py to confirm the scan-boundary "
+            "cost shrank, and update docs/performance.md")
+    elif measured == 0:
+        out["decision"] = "unmeasured"
+    elif measured < len(SCAN_VARIANTS):
+        # A NULL close needs every lever measured (the docstring's
+        # closure condition): an unmeasured variant could still clear
+        # the bar, so keep the question open.
+        out["decision"] = "partially-measured"
+        out["action"] = (
+            "no measured variant clears the threshold but "
+            f"{len(SCAN_VARIANTS) - measured} of {len(SCAN_VARIANTS)} "
+            "scan rows are still missing — keep the sweep queued")
+    else:
+        out["decision"] = "null-result"
+        out["action"] = (
+            "no scan variant clears the threshold: record the null "
+            "result in docs/performance.md and BASELINE.md; knobs stay "
+            "experimental, defaults stay scan_unroll=1/_st=False")
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -31,6 +31,7 @@ import optax
 from proteinbert_tpu.configs import FinetuneConfig
 from proteinbert_tpu.data.vocab import PAD_ID
 from proteinbert_tpu.models import finetune as ft_model
+from proteinbert_tpu.train.metrics import DeviceMetricAccumulator
 from proteinbert_tpu.train.schedule import make_optimizer, needs_loss_value
 from proteinbert_tpu.train.train_state import gradient_update
 
@@ -138,14 +139,14 @@ def evaluate(
 ) -> Dict[str, float]:
     """Mean metrics over an eval split (the reference's test_step + metric
     aggregation, reference utils.py:171-217)."""
-    sums: Dict[str, float] = {}
-    n = 0
+    # Per-batch scalars stay on device; drained in batched device_gets
+    # (roundtrip-batching + dispatch backpressure + bounded memory —
+    # see metrics.DeviceMetricAccumulator).
+    acc = DeviceMetricAccumulator()
     for batch in batches:
-        m = finetune_eval_step(state, batch, cfg)
-        for k, v in m.items():
-            sums[k] = sums.get(k, 0.0) + float(v)
-        n += 1
-    return {k: v / max(n, 1) for k, v in sums.items()}
+        acc.add(finetune_eval_step(state, batch, cfg))
+    n = acc.count
+    return {k: v / max(n, 1) for k, v in acc.sums().items()}
 
 
 def finetune(
@@ -191,16 +192,18 @@ def finetune(
             logger.info("resumed fine-tune after epoch %d", start_epoch)
 
     for epoch in range(start_epoch, cfg.task.epochs):
-        train_sums: Dict[str, float] = {}
-        n = 0
+        # Same roundtrip batching as evaluate(): the per-step float(v)
+        # fetches made every training step synchronous with the device —
+        # on the tunnel, epoch wall time was dominated by latency, not
+        # compute. Drains are batched and memory-bounded.
+        acc = DeviceMetricAccumulator()
         for batch in train_batches(epoch):
             state, metrics = finetune_step(state, batch, cfg)
-            for k, v in metrics.items():
-                train_sums[k] = train_sums.get(k, 0.0) + float(v)
-            n += 1
+            acc.add(metrics)
+        n = acc.count
         record = {
             "epoch": epoch,
-            **{f"train_{k}": v / max(n, 1) for k, v in train_sums.items()},
+            **{f"train_{k}": v / max(n, 1) for k, v in acc.sums().items()},
         }
 
         if eval_batches is not None and (

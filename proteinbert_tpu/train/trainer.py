@@ -409,19 +409,15 @@ def evaluate_batches(
     from proteinbert_tpu.train.loss import ranking_metrics_from_stats
 
     pooled = ("global_auroc", "global_p_at_k")
-    # Keep per-batch metric scalars ON DEVICE and fetch them all in ONE
-    # device_get at the end: fetching ~10 scalars + a stats array per
-    # batch costs a device→host roundtrip each, and on the tunneled
-    # single-chip setup an eval bracket of N batches paid ~10N
-    # high-latency roundtrips (pure wall time — the bracket is
-    # discounted from throughput but still delays training). The final
-    # summation runs in float64 on host, preserving the old
-    # accumulation numerics on long sweeps. Every 8th batch one scalar
-    # IS fetched as backpressure: without it the loop would dispatch
-    # ahead to the PJRT in-flight limit and keep tens of input batches
-    # resident in HBM at once.
-    contribs: list = []  # one {key: device scalar} dict per batch
-    b_rows_list: list = []
+    # Per-batch metric scalars stay ON DEVICE; the accumulator fetches
+    # them in one device_get per drain (bounded memory + dispatch
+    # backpressure) instead of ~10 high-latency roundtrips per batch on
+    # the tunneled single-chip setup. Row-weighting and the pooled-key
+    # rename fold in at drain time on host (float64 numerics).
+    from proteinbert_tpu.train.metrics import DeviceMetricAccumulator
+
+    acc = DeviceMetricAccumulator()
+    rename = lambda k: f"{k}_batch_mean" if k in pooled else k  # noqa: E731
     rank_stats = None
     n = 0
     rows = 0
@@ -432,20 +428,13 @@ def evaluate_batches(
         stats = m.pop("ranking_stats")
         rank_stats = stats if rank_stats is None else jax.tree.map(
             lambda a, b: a + b, rank_stats, stats)
-        contribs.append(m)
-        b_rows_list.append(b_rows)
+        acc.add(m, weight=b_rows, key_fn=rename)
         n += 1
         rows += b_rows
-        if n % 8 == 0:
-            jax.device_get(m["loss"])  # bound in-flight eval batches
-    contribs, rank_stats = jax.device_get((contribs, rank_stats))
-    sums: Dict[str, float] = {}
-    for m, b_rows in zip(contribs, b_rows_list):
-        for k, v in m.items():
-            key = f"{k}_batch_mean" if k in pooled else k
-            sums[key] = sums.get(key, 0.0) + float(v) * b_rows
-    metrics = {f"{prefix}{k}": v / max(rows, 1) for k, v in sums.items()}
+    metrics = {f"{prefix}{k}": v / max(rows, 1)
+               for k, v in acc.sums().items()}
     if rank_stats is not None:
+        rank_stats = jax.device_get(rank_stats)
         metrics.update({f"{prefix}{k}": v for k, v in
                         ranking_metrics_from_stats(rank_stats).items()})
     return metrics, n, rows

@@ -374,6 +374,29 @@ def test_step_timer_sync_extends_window():
     assert warm.summary()["step_ms"] < 20.0  # sleep not in the window
 
 
+def test_device_metric_accumulator():
+    """Batched-drain accumulation: sums match per-batch float() exactly,
+    weights and key renames apply, and pending buffers stay bounded by
+    drain_every (the memory/backpressure contract)."""
+    from proteinbert_tpu.train.metrics import DeviceMetricAccumulator
+
+    acc = DeviceMetricAccumulator(drain_every=4)
+    expect = {}
+    for i in range(11):
+        m = {"loss": jnp.float32(i * 0.5), "acc": jnp.float32(i)}
+        w = 1.0 + (i % 3)
+        acc.add(m, weight=w, key_fn=lambda k: f"x_{k}")
+        for k, v in m.items():
+            expect[f"x_{k}"] = expect.get(f"x_{k}", 0.0) + float(v) * w
+        assert len(acc._pending) < 4  # drained at the stride, not hoarded
+    got = acc.sums()
+    assert acc.count == 11
+    for k, v in expect.items():
+        assert got[k] == pytest.approx(v, rel=1e-12)
+    # Idempotent final drain.
+    assert acc.sums() == got
+
+
 def test_step_timer_window_rate_recovers_after_stall():
     """VERDICT r3 Weak #2: the cumulative rate re-reports a transient
     stall forever; the window_* rate must cover only the steps since the

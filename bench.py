@@ -476,9 +476,43 @@ def main():
         # One killable subprocess per variant; the parent NEVER touches
         # the backend, so exactly one PJRT client exists at a time and a
         # hung remote compile is bounded by the per-variant timeout.
+        #
+        # Whole-sweep wall budget: a cold-cache 16-variant sweep can run
+        # for hours, and a caller that loses patience and kills this
+        # process gets NO JSON line (the round-3 parsed=null failure,
+        # from the other side). The sweep is ordered by priority and
+        # persists per variant, so stopping early loses only the least
+        # important re-confirmations; at least one variant always runs.
+        try:
+            budget = int(os.environ.get("PBT_BENCH_MAX_SECONDS", 3600))
+        except ValueError:
+            # A malformed knob must not kill the run before its JSON
+            # line — that IS the failure this budget exists to prevent.
+            print("ignoring malformed PBT_BENCH_MAX_SECONDS; using 3600",
+                  file=sys.stderr)
+            budget = 3600
+        budget = max(budget, 0)  # negatives would cap every sweep at 1
+        t_start = time.time()
+        attempted = 0
+        longest = 0.0
         wait_s = variant_timeout()
         for i in indices:
             name = variants[i][0]
+            # Project with the WORST OBSERVED duration once one variant
+            # has run (projecting the per-variant timeout would stop
+            # after one variant whenever it is >= the budget, starving
+            # the rest forever); the timeout bound applies only before
+            # any observation exists.
+            projected = longest if longest else wait_s
+            if (attempted and budget
+                    and time.time() - t_start + projected > budget):
+                print(f"sweep wall budget ({budget}s) would be exceeded "
+                      f"by variant {name} (#{i}); stopping early — "
+                      f"{len(sweep)} rows measured, rest keep their "
+                      "persisted values", file=sys.stderr)
+                break
+            attempted += 1
+            t_variant = time.time()
             try:
                 out = subprocess.run(
                     [sys.executable, os.path.abspath(__file__),
@@ -486,9 +520,11 @@ def main():
                     stdout=subprocess.PIPE, timeout=wait_s,
                 )
             except subprocess.TimeoutExpired:
+                longest = max(longest, time.time() - t_variant)
                 print(f"variant {name} (#{i}) timed out after "
                       f"{wait_s}s; skipped", file=sys.stderr)
                 continue
+            longest = max(longest, time.time() - t_variant)
             if out.returncode != 0:
                 # OOM/Mosaic rejection/tunnel error — the child's trace
                 # already streamed to stderr; the sweep must go on.

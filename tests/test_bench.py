@@ -96,6 +96,40 @@ def test_cpu_fallback_promotes_stale_tpu_record(tmp_path, monkeypatch,
     assert "sweep" not in record and len(line) < 600
 
 
+def test_sweep_wall_budget_stops_early_but_still_emits(
+        tmp_path, monkeypatch, capsys):
+    """PBT_BENCH_MAX_SECONDS: a caller-killed hours-long sweep emits NO
+    line (the r3 parsed=null mode); the budget stops after the current
+    variant instead, emits the line, and keeps the persisted rows. At
+    least one variant always runs."""
+    monkeypatch.setattr(bench, "LAST_GOOD_PATH",
+                        str(tmp_path / "last_good.json"))
+    monkeypatch.setattr(bench, "probe_tpu", lambda: (True, "fake"))
+    monkeypatch.setenv("PBT_BENCH_MAX_SECONDS", "1500")
+
+    clock = {"now": 0.0}
+    monkeypatch.setattr(bench.time, "time", lambda: clock["now"])
+
+    def fake_run(cmd, **kw):
+        clock["now"] += 600.0  # each variant "takes" 10 minutes
+        i = int(cmd[-1])
+        name, _, seq, batch = bench.build_variants(True)[0][i]
+        row = {"variant": name, "seq_len": seq, "batch": batch,
+               "ms_per_step": 1.0, "residues_per_sec": 1000.0 + i,
+               "mfu": 0.5, "platform": "tpu"}
+        return _FakeCompleted(0, json.dumps(row).encode())
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.setattr(bench.sys, "argv", ["bench.py"])
+    bench.main()
+    record = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert record["platform"] == "tpu" and "stale" not in record
+    # Projection uses the observed 600s/variant: variants at t=0 and 600
+    # fit the 1500s budget; the third (1200 + 600 > 1500) does not.
+    persisted = json.load(open(tmp_path / "last_good.json"))
+    assert len(persisted["sweep"]) == 2
+
+
 def test_sweep_decision_tool(tmp_path):
     """tools/sweep_decision.py: the defaults-flip call must be the
     data's — win only above the noise threshold, null below it,

@@ -142,7 +142,12 @@ def main():
             put_status(status="sweeping", probes=n)
             env = dict(os.environ,
                        PBT_BENCH_PROBE_ATTEMPTS="1",
-                       PBT_BENCH_PROBE_TIMEOUT=str(PROBE_TIMEOUT))
+                       PBT_BENCH_PROBE_TIMEOUT=str(PROBE_TIMEOUT),
+                       # The watcher wants the FULL sweep and already
+                       # bounds it with SWEEP_TIMEOUT; bench's own
+                       # default wall budget (for impatient callers
+                       # like the driver) must not cut it short.
+                       PBT_BENCH_MAX_SECONDS="0")
             try:
                 out = subprocess.run(
                     [sys.executable, os.path.join(REPO, "bench.py")],

@@ -355,23 +355,24 @@ def test_step_timer_sync_extends_window():
     for _ in range(4):  # 2 warmup + 2 timed "enqueues"
         timer.update()
     fast = timer.summary()["step_ms"]
-    _time.sleep(0.05)  # the device drain the float() fetch waits on
+    _time.sleep(0.3)  # the device drain the float() fetch waits on
     timer.sync()
     synced = timer.summary()["step_ms"]
-    assert synced >= fast + 20.0  # 50 ms over 2 steps
+    assert synced >= fast + 120.0  # 300 ms over 2 steps
     # sync before timing starts must be a no-op, not a crash
     fresh = StepTimer(smoke_cfg().model, batch=8, seq_len=32)
     fresh.sync()
     assert fresh.summary() == {}
     # A drain at the warmup boundary (t0 set, nothing timed yet) waits
     # on compile/warmup backlog — it must re-anchor the window START,
-    # not charge that wait to the first timed window.
+    # not charge that wait to the first timed window. Margin is wide
+    # for scheduler noise on a loaded 1-core box.
     warm = StepTimer(smoke_cfg().model, batch=8, seq_len=32)
     warm.update(), warm.update()  # warmup done, t0 anchored at enqueue
-    _time.sleep(0.05)  # the log-point fetch draining compile backlog
+    _time.sleep(0.3)  # the log-point fetch draining compile backlog
     warm.sync()
     warm.update(), warm.update()
-    assert warm.summary()["step_ms"] < 20.0  # sleep not in the window
+    assert warm.summary()["step_ms"] < 100.0  # sleep not in the window
 
 
 def test_device_metric_accumulator():
@@ -409,25 +410,26 @@ def test_step_timer_window_rate_recovers_after_stall():
     timer = StepTimer(smoke_cfg().model, batch=8, seq_len=32)
     for _ in range(4):  # 2 warmup + 2 timed
         timer.update()
-    _time.sleep(0.08)  # a transient stall inside the first window
+    _time.sleep(0.4)  # a transient stall inside the first window
     timer.sync()
     first = timer.summary()
-    assert first["window_step_ms"] >= 35.0  # stall lands in window 1
+    assert first["window_step_ms"] >= 150.0  # stall lands in window 1
     # Next window: fast steps only — the window rate must recover while
-    # the cumulative rate stays depressed by the old stall.
+    # the cumulative rate stays depressed by the old stall. Thresholds
+    # leave generous margin for scheduler noise on a loaded 1-core box.
     timer.update(), timer.update()
     second = timer.summary()
-    assert second["window_step_ms"] < 20.0
-    assert second["step_ms"] >= 15.0  # cumulative still carries the stall
+    assert second["window_step_ms"] < 100.0
+    assert second["step_ms"] >= 80.0  # cumulative still carries the stall
     assert second["window_steps_per_sec"] > second["steps_per_sec"]
     # An eval/save discount inside a window must not be charged to it
     # (trainer order: steps, eval bracket + discount, more steps, log).
     timer.update(), timer.update()
-    _time.sleep(0.06)  # the eval bracket
-    timer.discount(0.06)
+    _time.sleep(0.3)  # the eval bracket
+    timer.discount(0.3)
     timer.update(), timer.update()
     third = timer.summary()
-    assert third["window_step_ms"] < 20.0
+    assert third["window_step_ms"] < 100.0
     # Back-to-back summary() (trainer's final perf right after a log
     # point): zero new steps -> no window keys, cumulative intact.
     fourth = timer.summary()

@@ -342,37 +342,57 @@ def test_flops_model_positive_and_monotone():
     assert 0 < f1 < f2
 
 
-def test_step_timer_sync_extends_window():
+def _fake_clock(monkeypatch):
+    """Deterministic perf_counter for StepTimer tests: each update()
+    costs 10 ms of 'virtual' time; stalls are explicit advances. No
+    real sleeps -> no scheduler-noise flakes on a loaded host."""
+    import proteinbert_tpu.train.metrics as metrics_mod
+
+    clock = {"now": 0.0}
+    monkeypatch.setattr(metrics_mod.time, "perf_counter",
+                        lambda: clock["now"])
+
+    def advance(seconds):
+        clock["now"] += seconds
+
+    return advance
+
+
+def test_step_timer_sync_extends_window(monkeypatch):
     # Async dispatch: update() timestamps measure host enqueue rate.
     # sync() (called after the log-point device fetch) must fold the
     # fetch wait into the window so reported throughput is device rate,
     # not enqueue rate — the tunneled backend otherwise logs MFUs > 1.
-    import time as _time
-
     from proteinbert_tpu.train.metrics import StepTimer
+
+    advance = _fake_clock(monkeypatch)
+
+    def step(t):
+        advance(0.01)
+        t.update()
 
     timer = StepTimer(smoke_cfg().model, batch=8, seq_len=32)
     for _ in range(4):  # 2 warmup + 2 timed "enqueues"
-        timer.update()
+        step(timer)
     fast = timer.summary()["step_ms"]
-    _time.sleep(0.3)  # the device drain the float() fetch waits on
+    assert fast == pytest.approx(10.0)
+    advance(0.3)  # the device drain the float() fetch waits on
     timer.sync()
     synced = timer.summary()["step_ms"]
-    assert synced >= fast + 120.0  # 300 ms over 2 steps
+    assert synced == pytest.approx(fast + 150.0)  # 300 ms over 2 steps
     # sync before timing starts must be a no-op, not a crash
     fresh = StepTimer(smoke_cfg().model, batch=8, seq_len=32)
     fresh.sync()
     assert fresh.summary() == {}
     # A drain at the warmup boundary (t0 set, nothing timed yet) waits
     # on compile/warmup backlog — it must re-anchor the window START,
-    # not charge that wait to the first timed window. Margin is wide
-    # for scheduler noise on a loaded 1-core box.
+    # not charge that wait to the first timed window.
     warm = StepTimer(smoke_cfg().model, batch=8, seq_len=32)
-    warm.update(), warm.update()  # warmup done, t0 anchored at enqueue
-    _time.sleep(0.3)  # the log-point fetch draining compile backlog
+    step(warm), step(warm)  # warmup done, t0 anchored at enqueue
+    advance(0.3)  # the log-point fetch draining compile backlog
     warm.sync()
-    warm.update(), warm.update()
-    assert warm.summary()["step_ms"] < 100.0  # sleep not in the window
+    step(warm), step(warm)
+    assert warm.summary()["step_ms"] == pytest.approx(10.0)
 
 
 def test_device_metric_accumulator():
@@ -398,38 +418,41 @@ def test_device_metric_accumulator():
     assert acc.sums() == got
 
 
-def test_step_timer_window_rate_recovers_after_stall():
+def test_step_timer_window_rate_recovers_after_stall(monkeypatch):
     """VERDICT r3 Weak #2: the cumulative rate re-reports a transient
     stall forever; the window_* rate must cover only the steps since the
     last summary() so a live operator can tell 'currently slow' from
     'was slow once'."""
-    import time as _time
-
     from proteinbert_tpu.train.metrics import StepTimer
+
+    advance = _fake_clock(monkeypatch)
+
+    def step(t):
+        advance(0.01)
+        t.update()
 
     timer = StepTimer(smoke_cfg().model, batch=8, seq_len=32)
     for _ in range(4):  # 2 warmup + 2 timed
-        timer.update()
-    _time.sleep(0.4)  # a transient stall inside the first window
+        step(timer)
+    advance(0.4)  # a transient stall inside the first window
     timer.sync()
     first = timer.summary()
-    assert first["window_step_ms"] >= 150.0  # stall lands in window 1
+    assert first["window_step_ms"] == pytest.approx(210.0)  # stall in w1
     # Next window: fast steps only — the window rate must recover while
-    # the cumulative rate stays depressed by the old stall. Thresholds
-    # leave generous margin for scheduler noise on a loaded 1-core box.
-    timer.update(), timer.update()
+    # the cumulative rate stays depressed by the old stall.
+    step(timer), step(timer)
     second = timer.summary()
-    assert second["window_step_ms"] < 100.0
-    assert second["step_ms"] >= 80.0  # cumulative still carries the stall
+    assert second["window_step_ms"] == pytest.approx(10.0)
+    assert second["step_ms"] == pytest.approx(110.0)  # carries the stall
     assert second["window_steps_per_sec"] > second["steps_per_sec"]
     # An eval/save discount inside a window must not be charged to it
     # (trainer order: steps, eval bracket + discount, more steps, log).
-    timer.update(), timer.update()
-    _time.sleep(0.3)  # the eval bracket
+    step(timer), step(timer)
+    advance(0.3)  # the eval bracket
     timer.discount(0.3)
-    timer.update(), timer.update()
+    step(timer), step(timer)
     third = timer.summary()
-    assert third["window_step_ms"] < 100.0
+    assert third["window_step_ms"] == pytest.approx(10.0)
     # Back-to-back summary() (trainer's final perf right after a log
     # point): zero new steps -> no window keys, cumulative intact.
     fourth = timer.summary()

@@ -11,6 +11,7 @@ from proteinbert_tpu.utils.profiling import (
 )
 from proteinbert_tpu.utils.stats import (
     benjamini_hochberg,
+    benjamini_hochberg_with_nulls,
     drop_redundant_columns,
     fisher_enrichment,
     liftover_positions,
@@ -35,7 +36,8 @@ __all__ = [
     "monitor_memory", "device_memory_report",
     "to_chunks", "shard_range", "shard_items", "task_identity",
     "shard_file_name", "all_shard_file_names",
-    "benjamini_hochberg", "drop_redundant_columns", "fisher_enrichment",
+    "benjamini_hochberg", "benjamini_hochberg_with_nulls",
+    "drop_redundant_columns", "fisher_enrichment",
     "one_hot", "qq_plot", "scatter_plot", "manhattan_plot",
     "write_excel", "liftover_positions",
 ]

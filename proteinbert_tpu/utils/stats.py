@@ -58,6 +58,30 @@ def benjamini_hochberg(pvals: Sequence[float]) -> np.ndarray:
     return out
 
 
+def benjamini_hochberg_with_nulls(
+    pvals: Sequence[float], alpha: float = 0.05,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """NaN-tolerant FDR adjustment (reference
+    shared_utils/util.py:888-898, `multipletests_with_nulls`).
+
+    Entries that are NaN (e.g. tests that could not be run) are excluded
+    from the BH ranking — so they neither consume rank slots nor dilute
+    the correction for the real p-values — and come back as
+    (significance=False, qval=NaN). Returns ``(significance, qvals)``
+    where ``significance = qvals <= alpha`` on the non-null subset,
+    matching statsmodels' ``multipletests(..., method='fdr_bh')``
+    convention the reference delegates to."""
+    p = np.asarray(pvals, dtype=np.float64)
+    significance = np.zeros(p.shape, dtype=bool)
+    qvals = np.full(p.shape, np.nan)
+    mask = ~np.isnan(p)
+    if mask.any():
+        q = benjamini_hochberg(p[mask])
+        qvals[mask] = q
+        significance[mask] = q <= alpha
+    return significance, qvals
+
+
 def fisher_enrichment(
     n_overlap: int, n_set1: int, n_set2: int, n_total: int,
 ) -> Tuple[float, float]:

@@ -120,6 +120,30 @@ def test_benjamini_hochberg():
     assert (benjamini_hochberg(np.ones(5)) == 1.0).all()
 
 
+def test_benjamini_hochberg_with_nulls():
+    from proteinbert_tpu.utils.stats import (
+        benjamini_hochberg, benjamini_hochberg_with_nulls)
+
+    # NaN holes are excluded from the ranking (reference
+    # shared_utils/util.py:888-898): the 4 real p-values must get the
+    # SAME q-values as if the NaNs were never there.
+    p = np.array([0.01, np.nan, 0.04, 0.03, np.nan, 0.005])
+    sig, q = benjamini_hochberg_with_nulls(p, alpha=0.05)
+    mask = ~np.isnan(p)
+    np.testing.assert_allclose(q[mask], benjamini_hochberg(p[mask]))
+    assert np.isnan(q[~mask]).all()
+    assert sig[mask].all() and not sig[~mask].any()
+    # Significance respects alpha on the adjusted values.
+    sig_tight, q_tight = benjamini_hochberg_with_nulls(p, alpha=0.03)
+    np.testing.assert_array_equal(sig_tight, q_tight <= 0.03,
+                                  err_msg="holes compare False vs NaN")
+    # All-NaN and empty inputs degrade gracefully.
+    sig_n, q_n = benjamini_hochberg_with_nulls([np.nan, np.nan])
+    assert not sig_n.any() and np.isnan(q_n).all()
+    sig_e, q_e = benjamini_hochberg_with_nulls([])
+    assert sig_e.size == 0 and q_e.size == 0
+
+
 def test_fisher_enrichment():
     from proteinbert_tpu.utils.stats import fisher_enrichment
 

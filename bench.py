@@ -54,6 +54,48 @@ def variant_timeout() -> int:
     return int(os.environ.get("PBT_BENCH_VARIANT_TIMEOUT", 900))
 
 
+def stale_warn_hours() -> float:
+    """Age past which a promoted stale TPU headline is shouted about
+    (VERDICT r4 weak #5): promote-last-good is right for a flapping
+    tunnel, but a `stale:true, vs_baseline 1.42` that stays green
+    forever could mask a regression introduced after the capture."""
+    try:
+        return float(os.environ.get("PBT_STALE_WARN_HOURS", 48))
+    except ValueError:
+        return 48.0
+
+
+def last_good_captured_at(lg):
+    """The HEADLINE row's own measurement stamp from a last-good record,
+    falling back to the file-level stamp. A later partial sweep (e.g.
+    --only pallas) restamps the file-level captured_at without
+    re-measuring the headline shape, so age must be judged from the
+    row that actually backs the promoted numbers."""
+    row_at = next(
+        (r.get("captured_at") for r in lg.get("sweep", [])
+         if (r.get("variant"), r.get("seq_len"), r.get("batch"))
+         == (lg.get("variant"), lg.get("seq_len"), lg.get("batch"))),
+        None)
+    return row_at or lg.get("captured_at")
+
+
+def stale_age_hours(captured_at, now=None):
+    """Hours since a `captured_at` stamp (bench's
+    %Y-%m-%dT%H:%M:%S%z format), or None when absent/unparseable —
+    an unreadable stamp must degrade to 'age unknown', not crash the
+    one code path whose whole job is emitting the JSON line."""
+    if not captured_at:
+        return None
+    from datetime import datetime, timezone
+
+    try:
+        t = datetime.strptime(captured_at, "%Y-%m-%dT%H:%M:%S%z")
+    except (ValueError, TypeError):
+        return None
+    now = now if now is not None else datetime.now(timezone.utc)
+    return max(0.0, (now - t).total_seconds() / 3600.0)
+
+
 def atomic_json_dump(obj, path):
     """Write-then-rename so a killed writer can't truncate the target —
     bench_last_tpu.json guards the only TPU evidence across tunnel flaps
@@ -511,18 +553,29 @@ def main():
                       f"{len(sweep)} rows measured, rest keep their "
                       "persisted values", file=sys.stderr)
                 break
+            # Make the budget a hard bound (ADVICE r4): after the first
+            # variant, clamp the child's timeout to the remaining budget
+            # so a HUNG variant after fast ones can't overshoot by a
+            # full variant_timeout. The first variant keeps the full
+            # timeout — "at least one row" beats budget purity — and a
+            # 60s floor keeps a near-exhausted budget from burning a
+            # child launch on a sub-compile-time window.
+            child_wait = wait_s
+            if attempted and budget:
+                remaining = budget - (time.time() - t_start)
+                child_wait = min(wait_s, max(int(remaining), 60))
             attempted += 1
             t_variant = time.time()
             try:
                 out = subprocess.run(
                     [sys.executable, os.path.abspath(__file__),
                      "--run-index", str(i)],
-                    stdout=subprocess.PIPE, timeout=wait_s,
+                    stdout=subprocess.PIPE, timeout=child_wait,
                 )
             except subprocess.TimeoutExpired:
                 longest = max(longest, time.time() - t_variant)
                 print(f"variant {name} (#{i}) timed out after "
-                      f"{wait_s}s; skipped", file=sys.stderr)
+                      f"{child_wait}s; skipped", file=sys.stderr)
                 continue
             longest = max(longest, time.time() - t_variant)
             if out.returncode != 0:
@@ -609,16 +662,21 @@ def main():
                       ("metric", "value", "unit", "vs_baseline", "platform",
                        "variant", "seq_len", "batch") if k in lg}
             record["stale"] = True
-            # The headline row's OWN measurement time, not the file's
-            # last-merge time — a later partial sweep (e.g. --only
-            # pallas) restamps the file-level captured_at without
-            # re-measuring the headline shape.
-            row_at = next(
-                (r.get("captured_at") for r in lg.get("sweep", [])
-                 if (r.get("variant"), r.get("seq_len"), r.get("batch"))
-                 == (lg.get("variant"), lg.get("seq_len"), lg.get("batch"))),
-                None)
-            record["captured_at"] = row_at or lg.get("captured_at")
+            record["captured_at"] = last_good_captured_at(lg)
+            # Age guard (VERDICT r4 weak #5): carry the record's age in
+            # the headline and warn loudly when it exceeds the bound, so
+            # a long capture gap reads as "unverified", never as a
+            # standing 1.42x.
+            age = stale_age_hours(record.get("captured_at"))
+            if age is not None:
+                record["stale_age_hours"] = round(age, 1)
+                if age > stale_warn_hours():
+                    print(
+                        f"WARNING: promoted TPU headline is {age:.0f}h "
+                        f"old (> {stale_warn_hours():.0f}h bound); its "
+                        "vs_baseline predates recent commits — treat as "
+                        "unverified until a fresh capture",
+                        file=sys.stderr)
             record["sweep_rows"] = len(lg.get("sweep", []))
             record["live_fallback"] = {
                 "platform": live["platform"], "value": live["value"],

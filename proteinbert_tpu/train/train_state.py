@@ -83,7 +83,11 @@ def train_step(
     cfg.optimizer.plateau_metric == "eval_loss" (the trainer passes the
     latest cadenced eval loss; +inf means "no eval yet" and falls back
     to the train loss so the placeholder can't tick the patience
-    counter)."""
+    counter). The trainer seeds the stream with an up-front eval
+    bracket, so under `train()` the fallback never fires — it exists
+    for direct callers of this function, and such callers should know
+    the fallback mixes train-scale values into the plateau window
+    (ADVICE r4)."""
     key, step_key = jax.random.split(state.key)
     X, Y, W = corrupt_batch(
         step_key,

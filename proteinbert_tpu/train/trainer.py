@@ -68,7 +68,9 @@ def pretrain(
     """
     batches_consumed = 0
     # Eval-stream state. last_eval_loss feeds the eval-keyed plateau
-    # (+inf = "no eval yet" → train_step falls back to train loss);
+    # (+inf = "no eval yet" — a fresh run replaces it with a seed eval
+    # bracket below, so the plateau window never mixes train-scale
+    # values; train_step's train-loss fallback remains as a net);
     # best/stalled drive early stopping. All three are CHECKPOINTED
     # (below, alongside batches_consumed) and restored here: resetting
     # them on resume would (a) let the post-resume steps feed train loss
@@ -176,6 +178,27 @@ def pretrain(
         step_fn = ts.train_step
 
     start_step = int(state.step)
+    history: list = []
+
+    if eval_keyed_plateau and not np.isfinite(last_eval_loss):
+        # Seed the plateau stream with ONE up-front eval bracket
+        # (ADVICE r4): without it, the pre-first-eval steps feed TRAIN
+        # losses into reduce_on_plateau's accumulation window via the
+        # +inf fallback, and in the overfit regime this feature targets
+        # (train << eval) that mixed-scale window seeds an unreachably
+        # low best_value — a premature LR cut right after the first
+        # real eval. One eval pass before the timer starts keeps every
+        # observed value eval-scale from step 0. The in-step fallback
+        # stays as a safety net for direct train_step callers.
+        em = _evaluate(state, eval_batches(), put, cfg, start_step)
+        last_eval_loss = np.float32(em["eval_loss"])
+        best_eval_loss = min(best_eval_loss, float(em["eval_loss"]))
+        history.append({"step": start_step, **em})
+        logger.info("seed eval at step %d: eval loss %.4f (plateau "
+                    "baseline)", start_step, em["eval_loss"])
+        if log_fn is not None:
+            log_fn(start_step, em)
+
     n_chips = mesh.size if mesh is not None else jax.device_count()
     timer = StepTimer(
         cfg.model,
@@ -183,7 +206,6 @@ def pretrain(
         seq_len=cfg.data.seq_len,
         n_chips=n_chips,
     )
-    history: list = []
     preempted = False
     early_stopped = False
     diagnostic_saved = False

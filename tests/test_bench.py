@@ -5,6 +5,8 @@ on, plus the last-good merge semantics themselves."""
 import json
 import os
 
+import pytest
+
 import bench
 
 
@@ -94,6 +96,93 @@ def test_cpu_fallback_promotes_stale_tpu_record(tmp_path, monkeypatch,
     assert record["live_fallback"]["platform"] == "cpu"
     assert record["live_fallback"]["value"] == 4000.0
     assert "sweep" not in record and len(line) < 600
+
+
+def test_stale_age_hours_helper():
+    """Unparseable/absent stamps degrade to None (age unknown) — the
+    fallback path must never crash before its JSON line."""
+    from datetime import datetime, timezone
+
+    now = datetime(2026, 8, 1, 12, 0, 0, tzinfo=timezone.utc)
+    assert bench.stale_age_hours("2026-08-01T00:00:00+0000",
+                                 now=now) == pytest.approx(12.0)
+    # A future stamp (clock skew) clamps to 0, not negative.
+    assert bench.stale_age_hours("2026-08-02T00:00:00+0000", now=now) == 0.0
+    assert bench.stale_age_hours(None) is None
+    assert bench.stale_age_hours("not-a-date") is None
+
+
+def test_stale_promotion_carries_age_and_warns(tmp_path, monkeypatch,
+                                               capsys):
+    """VERDICT r4 weak #5: a promoted stale headline must carry its age
+    and shout once it exceeds the bound, so a long capture gap reads as
+    'unverified' instead of a standing vs_baseline."""
+    monkeypatch.setattr(bench, "LAST_GOOD_PATH",
+                        str(tmp_path / "last_good.json"))
+    monkeypatch.setattr(bench, "probe_tpu", lambda: (False, "fake down"))
+    monkeypatch.setenv("PBT_STALE_WARN_HOURS", "48")
+    bench.persist_last_good([
+        {"variant": "remat-convs", "seq_len": 1024, "batch": 256,
+         "ms_per_step": 465.0, "residues_per_sec": 563000.0,
+         "mfu": 0.567}])
+    # Age the record: rewrite both the row-level and file-level stamps.
+    lg = json.load(open(tmp_path / "last_good.json"))
+    lg["captured_at"] = "2026-07-01T00:00:00+0000"
+    for r in lg["sweep"]:
+        r["captured_at"] = "2026-07-01T00:00:00+0000"
+    json.dump(lg, open(tmp_path / "last_good.json", "w"))
+    capsys.readouterr()
+
+    def fake_run_variant(i, on_tpu):
+        return {"variant": "xla", "seq_len": 128, "batch": 8,
+                "ms_per_step": 200.0, "residues_per_sec": 4000.0,
+                "mfu": 0.009, "platform": "cpu"}
+
+    monkeypatch.setattr(bench, "run_variant", fake_run_variant)
+    monkeypatch.setattr(bench, "force_cpu_backend", lambda: None)
+    monkeypatch.setattr(bench.sys, "argv", ["bench.py"])
+    bench.main()
+    cap = capsys.readouterr()
+    record = json.loads(cap.out.strip().splitlines()[-1])
+    assert record["stale"] is True
+    assert record["stale_age_hours"] > 24 * 30  # a month old
+    assert "WARNING" in cap.err and "unverified" in cap.err
+
+
+def test_sweep_budget_clamps_child_timeout(tmp_path, monkeypatch, capsys):
+    """ADVICE r4: once the budget is set, a hung variant after fast
+    ones must not overshoot it by a full variant_timeout — the child
+    timeout is clamped to the remaining budget (first variant keeps the
+    full timeout so at least one row always lands)."""
+    monkeypatch.setattr(bench, "LAST_GOOD_PATH",
+                        str(tmp_path / "last_good.json"))
+    monkeypatch.setattr(bench, "probe_tpu", lambda: (True, "fake"))
+    monkeypatch.setenv("PBT_BENCH_MAX_SECONDS", "2000")
+
+    clock = {"now": 0.0}
+    monkeypatch.setattr(bench.time, "time", lambda: clock["now"])
+    timeouts = []
+
+    def fake_run(cmd, **kw):
+        timeouts.append(kw["timeout"])
+        clock["now"] += 300.0  # each variant "takes" 5 minutes
+        i = int(cmd[-1])
+        name, _, seq, batch = bench.build_variants(True)[0][i]
+        row = {"variant": name, "seq_len": seq, "batch": batch,
+               "ms_per_step": 1.0, "residues_per_sec": 1000.0 + i,
+               "mfu": 0.5, "platform": "tpu"}
+        return _FakeCompleted(0, json.dumps(row).encode())
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.setattr(bench.sys, "argv", ["bench.py"])
+    bench.main()
+    assert json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    # First child gets the full 900s timeout; later children are capped
+    # by what's left of the 2000s budget (t=1200 -> 800, t=1500 -> 500);
+    # nothing ever exceeds the per-variant timeout.
+    assert timeouts[0] == 900
+    assert timeouts[-1] == 500 and timeouts[-2] == 800
+    assert all(t <= 900 for t in timeouts)
 
 
 def test_sweep_wall_budget_stops_early_but_still_emits(

@@ -657,7 +657,8 @@ def test_eval_stream_state_survives_resume(tmp_path):
     factory = lambda skip: make_pretrain_iterator(  # noqa: E731
         train_ds, 8, seed=0, skip_batches=skip)
 
-    # Segment 1: two evals land (steps 3, 6), both stalled under the
+    # Segment 1: the seed eval (step 0) claims the best-loss baseline,
+    # then the two cadenced evals (steps 3, 6) both stall under the
     # unreachable min_delta bar; patience 3 keeps the run alive.
     cfg = _early_stop_cfg(max_steps=6, eval_every=3,
                           early_stop_patience=3, early_stop_min_delta=1e9)
@@ -667,20 +668,25 @@ def test_eval_stream_state_survives_resume(tmp_path):
     ck = Checkpointer(str(tmp_path / "ckpt"), async_save=False)
     out1 = pretrain(cfg, factory, checkpointer=ck, eval_batches=evb)
     assert not out1["early_stopped"]
+    # The seed eval is recorded in history at the start step.
+    assert [h for h in out1["history"] if "eval_loss" in h][0]["step"] == 0
     _, ds1 = ck.restore(out1["state"])
     es = ds1["eval_stream"]
-    assert es["stalled"] == 1 and es["best"] is not None
+    assert es["stalled"] == 2 and es["best"] is not None
     assert es["last"] == pytest.approx(
         [h for h in out1["history"] if "eval_loss" in h][-1]["eval_loss"])
 
-    # Segment 2 (the requeue): max_steps extended. With the restored
-    # baseline (best set, stalled=1), evals at 9 and 12 reach patience 3
-    # -> stop at step 12. A reset baseline would count the step-9 eval
-    # as an improvement over fresh +inf and not stop before step 18.
+    # Segment 2 (the requeue): max_steps extended. last_eval_loss is
+    # restored finite, so NO second seed eval runs; with the restored
+    # baseline (best set, stalled=2) the eval at step 9 reaches
+    # patience 3 -> stop at step 9. A reset baseline would count the
+    # step-9 eval as an improvement over fresh +inf and run much longer.
     cfg2 = cfg.replace(train=dataclasses.replace(cfg.train, max_steps=20))
     out2 = pretrain(cfg2, factory, checkpointer=ck, eval_batches=evb)
     assert out2["early_stopped"]
-    assert int(out2["state"].step) == 12
+    assert int(out2["state"].step) == 9
+    assert not any(h["step"] == 6 and "eval_loss" in h
+                   for h in out2["history"])  # no re-seed on resume
     ck.close()
 
 

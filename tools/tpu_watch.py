@@ -9,7 +9,8 @@ bench sweep, refreshing bench_last_tpu.json with every variant.
 Run detached:  nohup python tools/tpu_watch.py >> tpu_watch.log 2>&1 &
 Exit codes: 0 after a successful sweep; 2 another watcher is alive;
 3 deadline without ever reaching the TPU; 4 repeated non-timeout probe
-failures; 5 repeated on-TPU bench failures; 6 repeated sweep timeouts.
+failures; 5 repeated on-TPU bench failures; 6 sweep timeouts (repeated,
+or one whose orphan drain would cross the deadline).
 To chain the heavier hardware experiments automatically while the
 tunnel is proven up, set PBT_WATCH_AFTER_SWEEP to a shell command
 (e.g. "python examples/transfer_experiment.py --scale full"); it runs
@@ -32,7 +33,9 @@ STATUS_PATH = os.path.join(REPO, "tpu_watch_status.json")
 
 sys.path.insert(0, REPO)
 from bench import (  # noqa: E402
-    atomic_json_dump, build_variants, probe_tpu, variant_timeout,
+    LAST_GOOD_PATH, atomic_json_dump, build_variants,
+    last_good_captured_at, probe_tpu, stale_age_hours, stale_warn_hours,
+    variant_timeout,
 )
 
 
@@ -42,8 +45,12 @@ def _default_sweep_timeout():
     PBT_BENCH_VARIANT_TIMEOUT, so a healthy cold-cache first sweep can
     legitimately take nearly N x that; a fixed 45-min cap SIGKILLed it
     before 'captured', and the after-sweep hook never fired.
-    gate_pallas=False keeps jax out of this daemon process (the ungated
-    count is an upper bound — exactly right for a timeout)."""
+    gate_pallas=False gives the UNGATED variant count — an upper bound,
+    exactly right for a timeout. (It does NOT avoid the jax import:
+    build_variants pulls in configs and transitively jax. That import is
+    a one-time startup cost and creates no PJRT client — backend init is
+    lazy — so the one-client-per-chip invariant still holds; ADVICE r4.)
+    """
     try:
         n = len(build_variants(True, gate_pallas=False)[0])
     except Exception:
@@ -116,7 +123,30 @@ def main():
     hard_streak = 0
     sweep_failures = 0
     sweep_timeouts = 0
-    put_status(status="watching", probes=0, sweep_timeout_s=SWEEP_TIMEOUT)
+    # Age guard (VERDICT r4 weak #5): if the only TPU evidence on disk
+    # is old, say so LOUDLY at startup — the whole point of this daemon
+    # is that a fresh capture is overdue, and the operator reading this
+    # log must not mistake a stale 1.4x for current truth.
+    last_good_age_h = None
+    try:
+        with open(LAST_GOOD_PATH) as f:
+            lg = json.load(f)
+        # Judge age from the HEADLINE row's own stamp (shared helper):
+        # a recent partial sweep restamps the file-level captured_at
+        # without re-measuring the headline shape.
+        age = stale_age_hours(last_good_captured_at(lg))
+        if age is not None:
+            last_good_age_h = round(age, 1)
+            if age > stale_warn_hours():
+                print(f"[tpu_watch] WARNING: last-good TPU record is "
+                      f"{age:.0f}h old (> {stale_warn_hours():.0f}h) — "
+                      "its numbers predate recent commits; a fresh "
+                      "sweep capture is REQUIRED to trust vs_baseline",
+                      flush=True)
+    except (OSError, ValueError):
+        pass
+    put_status(status="watching", probes=0, sweep_timeout_s=SWEEP_TIMEOUT,
+               last_good_age_h=last_good_age_h)
     while time.time() - t0 < DEADLINE_H * 3600:
         n += 1
         ok, hard_fail = probe()
@@ -177,9 +207,31 @@ def main():
                 # never measures under contention with the orphan on the
                 # one shared chip (the skew the single-instance guard
                 # exists to prevent).
+                # Bound the drain by the remaining deadline (ADVICE r4:
+                # an unconditional 960s sleep can overstay DEADLINE_H)
+                # and tell status pollers we're draining, not stalled.
+                # If the deadline can't absorb a FULL drain, exit
+                # instead: a truncated drain followed by another loop
+                # iteration would probe-succeed and launch a fresh
+                # multi-hour sweep under contention with the orphan —
+                # the exact skew the drain exists to prevent — while
+                # overstaying the deadline by up to SWEEP_TIMEOUT.
                 drain = variant_timeout() + 60
+                remaining = DEADLINE_H * 3600 - (time.time() - t0)
+                if remaining <= drain:
+                    print("[tpu_watch] deadline inside the orphan-drain "
+                          "window; exiting rather than sweeping under "
+                          "contention", flush=True)
+                    put_status(status="deadline_during_drain", probes=n,
+                               timeouts=sweep_timeouts)
+                    return 6
                 print(f"[tpu_watch] draining {drain}s for the orphaned "
                       "variant child before re-probing", flush=True)
+                put_status(status="draining", probes=n,
+                           timeouts=sweep_timeouts, drain_s=drain,
+                           wake_at=time.strftime(
+                               "%Y-%m-%dT%H:%M:%S%z",
+                               time.localtime(time.time() + drain)))
                 time.sleep(drain)
                 continue
             print(out.stderr, flush=True)

@@ -17,6 +17,7 @@ What changed vs the reference `pretrain()`:
 from __future__ import annotations
 
 import logging
+import os
 import time
 from typing import Any, Dict, Optional
 
@@ -30,6 +31,31 @@ from proteinbert_tpu.train.metrics import DeviceMetricAccumulator, StepTimer
 from proteinbert_tpu.train.resilience import GracefulShutdown, check_finite
 
 logger = logging.getLogger(__name__)
+
+
+def _fault_stall_spec():
+    """Observability-drill fault injection (VERDICT r4 item 3): parse
+    PBT_FAULT_STALL_AT="<1-based step>:<seconds>" into (step, secs).
+    The trainer sleeps that long at the top of the named step — INSIDE
+    the timed window, like a real host-side stall (slow async-save
+    serialization, input starvation, a tunnel hiccup) — so a drill can
+    assert the window_* metrics and the slow-window summary localize it.
+    Never set in production; the spec is logged loudly when active."""
+    spec = os.environ.get("PBT_FAULT_STALL_AT")
+    if not spec:
+        return None
+    try:
+        step_s, _, secs_s = spec.partition(":")
+        step, secs = int(step_s), float(secs_s)
+        # Reject what time.sleep would crash or hang on: the contract
+        # is "malformed specs are ignored, not fatal" — a drill knob
+        # must never be able to kill an uncheckpointed run.
+        if step < 1 or not (0 <= secs < float("inf")):
+            raise ValueError(spec)
+        return step, secs
+    except ValueError:
+        logger.warning("ignoring malformed PBT_FAULT_STALL_AT=%r", spec)
+        return None
 
 
 def pretrain(
@@ -221,9 +247,19 @@ def pretrain(
             float(metrics["loss"])
             timer.sync()
 
+    fault_stall = _fault_stall_spec()
+    if fault_stall:
+        logger.warning("FAULT INJECTION ACTIVE: %.1fs stall at step %d "
+                       "(PBT_FAULT_STALL_AT)", fault_stall[1],
+                       fault_stall[0])
+
     with GracefulShutdown() as stop:
       for step in range(start_step, cfg.train.max_steps):
         batch = next(batch_iterator)
+        if fault_stall and step + 1 == fault_stall[0]:
+            # Injected host stall, deliberately NOT discounted from the
+            # timing window — the drill asserts it shows up there.
+            time.sleep(fault_stall[1])
         if eval_keyed_plateau:
             state, metrics = ts.train_step(state, put(batch), cfg,
                                            plateau_value=last_eval_loss)

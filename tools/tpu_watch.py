@@ -76,9 +76,19 @@ SWEEP_TIMEOUT_CAP = int(os.environ.get("PBT_WATCH_SWEEP_TIMEOUT_CAP", 4))
 HOOK_TIMEOUT = int(os.environ.get("PBT_WATCH_HOOK_TIMEOUT", 7200))
 
 
+# The headline row's captured_at, resolved once at startup; every
+# status write derives a CURRENT age from it so pollers always see the
+# staleness signal (a startup-only field was erased by the first
+# in-loop put_status and pollers almost never saw it).
+LAST_GOOD_STAMP = [None]
+
+
 def put_status(**kv):
     kv["at"] = time.strftime("%Y-%m-%dT%H:%M:%S%z")
     kv["pid"] = os.getpid()  # lets the single-instance guard see us
+    age = stale_age_hours(LAST_GOOD_STAMP[0])
+    if age is not None:
+        kv.setdefault("last_good_age_h", round(age, 1))
     try:
         atomic_json_dump(kv, STATUS_PATH)
     except OSError as e:  # status mirror is best-effort; never die on it
@@ -126,27 +136,25 @@ def main():
     # Age guard (VERDICT r4 weak #5): if the only TPU evidence on disk
     # is old, say so LOUDLY at startup — the whole point of this daemon
     # is that a fresh capture is overdue, and the operator reading this
-    # log must not mistake a stale 1.4x for current truth.
-    last_good_age_h = None
+    # log must not mistake a stale 1.4x for current truth. The stamp is
+    # resolved from the HEADLINE row (shared helper: a recent partial
+    # sweep restamps the file-level captured_at without re-measuring
+    # the headline shape) and cached so EVERY status write carries a
+    # current last_good_age_h for pollers.
     try:
         with open(LAST_GOOD_PATH) as f:
             lg = json.load(f)
-        # Judge age from the HEADLINE row's own stamp (shared helper):
-        # a recent partial sweep restamps the file-level captured_at
-        # without re-measuring the headline shape.
-        age = stale_age_hours(last_good_captured_at(lg))
-        if age is not None:
-            last_good_age_h = round(age, 1)
-            if age > stale_warn_hours():
-                print(f"[tpu_watch] WARNING: last-good TPU record is "
-                      f"{age:.0f}h old (> {stale_warn_hours():.0f}h) — "
-                      "its numbers predate recent commits; a fresh "
-                      "sweep capture is REQUIRED to trust vs_baseline",
-                      flush=True)
+        LAST_GOOD_STAMP[0] = last_good_captured_at(lg)
+        age = stale_age_hours(LAST_GOOD_STAMP[0])
+        if age is not None and age > stale_warn_hours():
+            print(f"[tpu_watch] WARNING: last-good TPU record is "
+                  f"{age:.0f}h old (> {stale_warn_hours():.0f}h) — "
+                  "its numbers predate recent commits; a fresh "
+                  "sweep capture is REQUIRED to trust vs_baseline",
+                  flush=True)
     except (OSError, ValueError):
         pass
-    put_status(status="watching", probes=0, sweep_timeout_s=SWEEP_TIMEOUT,
-               last_good_age_h=last_good_age_h)
+    put_status(status="watching", probes=0, sweep_timeout_s=SWEEP_TIMEOUT)
     while time.time() - t0 < DEADLINE_H * 3600:
         n += 1
         ok, hard_fail = probe()

@@ -61,6 +61,16 @@ def _leaf_spec(path, leaf, mesh: Mesh) -> P:
         if len(shape) >= 2 and shape[-2] % model_n == 0:
             return P(*([None] * (len(shape) - 2) + ["model", None]))
 
+    # The token-embedding table is REPLICATED: at 26 x local_dim it is
+    # a few KB at every preset, so FSDP-sharding it saves nothing — and
+    # a feature-sharded table makes the token-lookup gather produce
+    # feature-sharded (B, L, D) activations that must be resharded to
+    # batch sharding, which the partitioner can only do by replicating
+    # at fsdp extents > 2 (involuntary full remat on the gather; caught
+    # by the 16-device tier, tests/test_parallel16.py).
+    if _path_has(path, "embedding"):
+        return P()
+
     # FSDP: shard one axis of big tensors; never the stacked-blocks
     # leading axis (it is num_blocks-sized). Stacked-block leaves take
     # the LAST divisible axis, not the largest: the lax.scan over blocks

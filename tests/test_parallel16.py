@@ -1,0 +1,113 @@
+"""16-virtual-device tier (VERDICT r4 weak #4 / round-5 item 5).
+
+The in-process suite is pinned to 8 virtual devices at backend init, so
+every fsdp/model axis it can build caps at extent 2 — and extent-2
+meshes cannot catch off-by-N bugs in gather/reduce-scatter sharding
+rules. These tests spawn `tests/multidevice16_child.py` (and the
+driver's own `dryrun_multichip`) in fresh processes with 16 virtual CPU
+devices and assert numerical parity at fsdp=4, model=4, and
+data=2 x fsdp=2 x seq=4 with bucketed lockstep batches.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _child_env():
+    """The child forces 16 devices via the config API; scrub the
+    conftest's 8-device XLA flag so the two mechanisms can't fight."""
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = re.sub(
+        r"--xla_force_host_platform_device_count=\d+", "",
+        env.get("XLA_FLAGS", "")).strip()
+    return env
+
+
+def _run(args, timeout=600):
+    out = subprocess.run(
+        [sys.executable, *args], env=_child_env(), cwd=REPO,
+        capture_output=True, text=True, timeout=timeout)
+    assert out.returncode == 0, (out.stdout[-2000:], out.stderr[-3000:])
+    return out.stdout
+
+
+@pytest.mark.parametrize("scenario", ["fsdp4", "model4", "sp4-bucketed"])
+def test_sixteen_device_parity(scenario):
+    stdout = _run([os.path.join(REPO, "tests", "multidevice16_child.py"),
+                   scenario])
+    rec = json.loads(stdout.strip().splitlines()[-1])
+    assert rec["ok"] and rec["scenario"] == scenario
+    if scenario == "sp4-bucketed":
+        assert {r["L"] for r in rec["buckets"]} == {32, 128}
+    else:
+        axis = "fsdp" if scenario == "fsdp4" else "model"
+        assert rec["mesh"][axis] == 4
+        assert rec["max_param_err"] < 2e-5
+
+
+def test_fsdp4_compile_has_no_involuntary_remat_warning():
+    """This tier's first catch: with the embedding table FSDP-sharded,
+    the token-lookup gather's feature-sharded output forced the SPMD
+    partitioner's replicate-and-repartition fallback at fsdp=4 (fine at
+    fsdp=2 — exactly the extent>2 class this tier exists for). Fixed by
+    replicating the few-KB table (parallel/sharding.py); this grep keeps
+    it fixed. The marker text's positive control (GSPMD arm) lives in
+    tests/test_parallel.py::test_fsdp_compile_has_no_involuntary_remat_warning."""
+    code = """
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 16)
+jax.config.update("jax_enable_compilation_cache", False)
+import numpy as np
+from proteinbert_tpu.configs import (DataConfig, MeshConfig, ModelConfig,
+    OptimizerConfig, PretrainConfig, TrainConfig)
+from proteinbert_tpu.parallel import batch_sharding, make_mesh
+from proteinbert_tpu.parallel.sharding import state_sharding
+from proteinbert_tpu.train import create_train_state
+import proteinbert_tpu.train.train_state as TS
+
+mesh_cfg = MeshConfig(data=2, fsdp=4, model=2, seq=1)
+cfg = PretrainConfig(
+    model=ModelConfig(local_dim=32, global_dim=64, key_dim=16, num_heads=4,
+                      num_blocks=2, num_annotations=128, dtype="bfloat16",
+                      remat=True, remat_policy="convs"),
+    data=DataConfig(seq_len=64, batch_size=16),
+    optimizer=OptimizerConfig(warmup_steps=10),
+    mesh=mesh_cfg, train=TrainConfig(max_steps=1))
+mesh = make_mesh(mesh_cfg, jax.devices()[:16])
+abstract = jax.eval_shape(lambda: create_train_state(jax.random.PRNGKey(0), cfg))
+sh = state_sharding(mesh, abstract)
+bsh = batch_sharding(mesh)
+bat = {"tokens": jax.ShapeDtypeStruct((16, 64), np.int32, sharding=bsh["tokens"]),
+       "annotations": jax.ShapeDtypeStruct((16, 128), np.float32,
+                                           sharding=bsh["annotations"])}
+st = jax.tree.map(lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
+                  abstract, sh)
+TS.train_step.lower(st, bat, cfg).compile()
+print("COMPILED-OK")
+"""
+    out = subprocess.run([sys.executable, "-c", code], env=_child_env(),
+                         cwd=REPO, capture_output=True, text=True,
+                         timeout=600)
+    assert "COMPILED-OK" in out.stdout, out.stderr[-3000:]
+    assert "Involuntary full rematerialization" not in out.stderr, \
+        out.stderr[-3000:]
+
+
+def test_dryrun_multichip_16():
+    """The driver's dry run at 16 devices must cover every axis at
+    extent >2 in some mesh (fsdp=4, model=4, seq=4) and keep losses
+    equal across meshes."""
+    stdout = _run(
+        ["-c", "import __graft_entry__; __graft_entry__.dryrun_multichip(16)"])
+    lines = [ln for ln in stdout.splitlines() if "dryrun_multichip" in ln]
+    assert len(lines) == 3, stdout
+    for ax in ("'fsdp': 4", "'model': 4", "'seq': 4"):
+        assert any(ax in ln for ln in lines), (ax, lines)

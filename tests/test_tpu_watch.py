@@ -95,6 +95,41 @@ def test_stale_promoted_record_is_not_a_capture(monkeypatch, tmp_path):
     assert status["status"] != "captured"
 
 
+def test_captured_status_reports_fresh_age(monkeypatch, tmp_path):
+    """After a successful sweep the terminal status must report the
+    FRESH capture's age, not the weeks-old pre-sweep stamp resolved at
+    startup — else a poller reads status=captured paired with a huge
+    last_good_age_h and distrusts a just-measured number."""
+    mod = _load(monkeypatch, tmp_path)
+    monkeypatch.delenv("PBT_WATCH_AFTER_SWEEP", raising=False)
+    old = "2026-07-01T00:00:00+0000"
+    rec = {"platform": "tpu", "variant": "v", "seq_len": 1, "batch": 1,
+           "captured_at": old,
+           "sweep": [{"variant": "v", "seq_len": 1, "batch": 1,
+                      "captured_at": old}]}
+    json.dump(rec, open(tmp_path / "last_good.json", "w"))
+    monkeypatch.setattr(mod, "probe", lambda: (True, None))
+
+    def fake_run(cmd, **kw):
+        import time as _t
+
+        now = _t.strftime("%Y-%m-%dT%H:%M:%S%z")
+        fresh = json.loads(json.dumps(rec))
+        fresh["captured_at"] = now
+        fresh["sweep"][0]["captured_at"] = now
+        json.dump(fresh, open(tmp_path / "last_good.json", "w"))
+        return types.SimpleNamespace(
+            returncode=0, stderr="",
+            stdout=json.dumps({"platform": "tpu", "value": 1.0}) + "\n")
+
+    monkeypatch.setattr(mod.subprocess, "run", fake_run)
+    rc = mod.main()
+    assert rc == 0
+    status = json.load(open(tmp_path / "status.json"))
+    assert status["status"] == "captured"
+    assert status["last_good_age_h"] < 1.0
+
+
 def test_stale_age_warns_at_startup_and_persists_in_status(
         monkeypatch, tmp_path, capsys):
     """VERDICT r4 weak #5: an old last-good record must produce a loud

@@ -76,11 +76,20 @@ SWEEP_TIMEOUT_CAP = int(os.environ.get("PBT_WATCH_SWEEP_TIMEOUT_CAP", 4))
 HOOK_TIMEOUT = int(os.environ.get("PBT_WATCH_HOOK_TIMEOUT", 7200))
 
 
-# The headline row's captured_at, resolved once at startup; every
-# status write derives a CURRENT age from it so pollers always see the
-# staleness signal (a startup-only field was erased by the first
-# in-loop put_status and pollers almost never saw it).
+# The headline row's captured_at; every status write derives a CURRENT
+# age from it so pollers always see the staleness signal (a startup-only
+# field was erased by the first in-loop put_status and pollers almost
+# never saw it). Refreshed after any sweep that may have rewritten the
+# record — else a just-captured sweep would be reported weeks stale.
 LAST_GOOD_STAMP = [None]
+
+
+def refresh_last_good_stamp():
+    try:
+        with open(LAST_GOOD_PATH) as f:
+            LAST_GOOD_STAMP[0] = last_good_captured_at(json.load(f))
+    except (OSError, ValueError):
+        pass
 
 
 def put_status(**kv):
@@ -141,19 +150,14 @@ def main():
     # sweep restamps the file-level captured_at without re-measuring
     # the headline shape) and cached so EVERY status write carries a
     # current last_good_age_h for pollers.
-    try:
-        with open(LAST_GOOD_PATH) as f:
-            lg = json.load(f)
-        LAST_GOOD_STAMP[0] = last_good_captured_at(lg)
-        age = stale_age_hours(LAST_GOOD_STAMP[0])
-        if age is not None and age > stale_warn_hours():
-            print(f"[tpu_watch] WARNING: last-good TPU record is "
-                  f"{age:.0f}h old (> {stale_warn_hours():.0f}h) — "
-                  "its numbers predate recent commits; a fresh "
-                  "sweep capture is REQUIRED to trust vs_baseline",
-                  flush=True)
-    except (OSError, ValueError):
-        pass
+    refresh_last_good_stamp()
+    age = stale_age_hours(LAST_GOOD_STAMP[0])
+    if age is not None and age > stale_warn_hours():
+        print(f"[tpu_watch] WARNING: last-good TPU record is "
+              f"{age:.0f}h old (> {stale_warn_hours():.0f}h) — "
+              "its numbers predate recent commits; a fresh "
+              "sweep capture is REQUIRED to trust vs_baseline",
+              flush=True)
     put_status(status="watching", probes=0, sweep_timeout_s=SWEEP_TIMEOUT)
     while time.time() - t0 < DEADLINE_H * 3600:
         n += 1
@@ -197,6 +201,7 @@ def main():
                 # but capped: each timeout burned the full sweep budget
                 # on the one shared chip.
                 sweep_timeouts += 1
+                refresh_last_good_stamp()  # partial rows persisted
                 print(f"[tpu_watch] sweep timed out after {SWEEP_TIMEOUT}s "
                       f"({sweep_timeouts}/{SWEEP_TIMEOUT_CAP}; tunnel "
                       "dropped mid-run?); partial results persisted",
@@ -242,6 +247,11 @@ def main():
                                time.localtime(time.time() + drain)))
                 time.sleep(drain)
                 continue
+            # The sweep (even a failed one) may have rewritten the
+            # last-good record; re-resolve so the terminal "captured"
+            # status reports the FRESH capture's age, not the pre-sweep
+            # record's.
+            refresh_last_good_stamp()
             print(out.stderr, flush=True)
             print(out.stdout, flush=True)
             lines = out.stdout.strip().splitlines()

@@ -129,7 +129,11 @@ class OptimizerConfig:
                                             # Set plateau_window ≈ eval_every so
                                             # one windowed observation covers one
                                             # eval interval; requires eval_every
-                                            # > 0 and an eval split.
+                                            # > 0 and an eval split. The trainer
+                                            # seeds the stream with an up-front
+                                            # eval bracket so the plateau window
+                                            # never mixes train-scale values
+                                            # (ADVICE r4).
     grad_clip_norm: float = 1.0             # reference clips grads (utils.py:136)
     b1: float = 0.9
     b2: float = 0.999

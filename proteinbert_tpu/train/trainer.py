@@ -33,6 +33,17 @@ from proteinbert_tpu.train.resilience import GracefulShutdown, check_finite
 logger = logging.getLogger(__name__)
 
 
+def _parse_fault_secs(secs_s):
+    """Seconds for a drill knob, or ValueError. Rejects what time.sleep
+    would crash or hang on (negative, NaN, inf): the drill contract is
+    "malformed specs are ignored, not fatal" — a drill knob must never
+    be able to kill an uncheckpointed run."""
+    secs = float(secs_s)
+    if not (0 <= secs < float("inf")):
+        raise ValueError(secs_s)
+    return secs
+
+
 def _fault_stall_spec():
     """Observability-drill fault injection (VERDICT r4 item 3): parse
     PBT_FAULT_STALL_AT="<1-based step>:<seconds>" into (step, secs).
@@ -46,15 +57,28 @@ def _fault_stall_spec():
         return None
     try:
         step_s, _, secs_s = spec.partition(":")
-        step, secs = int(step_s), float(secs_s)
-        # Reject what time.sleep would crash or hang on: the contract
-        # is "malformed specs are ignored, not fatal" — a drill knob
-        # must never be able to kill an uncheckpointed run.
-        if step < 1 or not (0 <= secs < float("inf")):
+        step = int(step_s)
+        if step < 1:
             raise ValueError(spec)
-        return step, secs
+        return step, _parse_fault_secs(secs_s)
     except ValueError:
         logger.warning("ignoring malformed PBT_FAULT_STALL_AT=%r", spec)
+        return None
+
+
+def _fault_eval_stall_secs():
+    """Companion drill knob: PBT_FAULT_EVAL_STALL="<seconds>" sleeps
+    inside every eval bracket — INSIDE the discounted region, so the
+    drill can assert a slow eval does NOT masquerade as a training
+    stall in the window metrics (the negative control for the
+    PBT_FAULT_STALL_AT positive). Same ignore-malformed contract."""
+    spec = os.environ.get("PBT_FAULT_EVAL_STALL")
+    if not spec:
+        return None
+    try:
+        return _parse_fault_secs(spec)
+    except ValueError:
+        logger.warning("ignoring malformed PBT_FAULT_EVAL_STALL=%r", spec)
         return None
 
 
@@ -252,6 +276,10 @@ def pretrain(
         logger.warning("FAULT INJECTION ACTIVE: %.1fs stall at step %d "
                        "(PBT_FAULT_STALL_AT)", fault_stall[1],
                        fault_stall[0])
+    fault_eval_stall = _fault_eval_stall_secs()
+    if fault_eval_stall:
+        logger.warning("FAULT INJECTION ACTIVE: %.1fs stall per eval "
+                       "bracket (PBT_FAULT_EVAL_STALL)", fault_eval_stall)
 
     with GracefulShutdown() as stop:
       for step in range(start_step, cfg.train.max_steps):
@@ -375,6 +403,10 @@ def pretrain(
             # from the window, inflating throughput/MFU.
             drain_and_sync()
             t_eval = time.perf_counter()
+            if fault_eval_stall:
+                # Injected INSIDE the discounted bracket: the drill
+                # asserts this does NOT surface as a slow window.
+                time.sleep(fault_eval_stall)
             # Key the eval by the 1-based step recorded in history, so
             # `evaluate --like-step <history step>` reproduces it.
             em = _evaluate(state, eval_batches(), put, cfg, step + 1)

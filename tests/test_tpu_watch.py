@@ -55,6 +55,9 @@ def test_after_sweep_hook_runs_on_capture(monkeypatch, tmp_path):
 def test_no_hook_when_sweep_falls_back(monkeypatch, tmp_path):
     mod = _load(monkeypatch, tmp_path)
     monkeypatch.setattr(mod, "DEADLINE_H", 0.0001)  # one loop, then out
+    # Defeat the no-fit skip (the near-zero deadline would otherwise
+    # exit 7 before sweeping — this test needs the sweep to RUN).
+    monkeypatch.setattr(mod, "variant_timeout", lambda: -120)
     monkeypatch.setattr(mod, "probe", lambda: (True, None))
     proof = tmp_path / "hook_proof"
     monkeypatch.setenv("PBT_WATCH_AFTER_SWEEP", f"echo chained > {proof}")
@@ -77,6 +80,7 @@ def test_stale_promoted_record_is_not_a_capture(monkeypatch, tmp_path):
     hardware hook on a dead tunnel and exit the watch for nothing."""
     mod = _load(monkeypatch, tmp_path)
     monkeypatch.setattr(mod, "DEADLINE_H", 0.0001)
+    monkeypatch.setattr(mod, "variant_timeout", lambda: -120)  # no-fit off
     monkeypatch.setattr(mod, "probe", lambda: (True, None))
     proof = tmp_path / "hook_proof"
     monkeypatch.setenv("PBT_WATCH_AFTER_SWEEP", f"echo chained > {proof}")
@@ -93,6 +97,50 @@ def test_stale_promoted_record_is_not_a_capture(monkeypatch, tmp_path):
     assert not proof.exists()
     status = json.load(open(tmp_path / "status.json"))
     assert status["status"] != "captured"
+
+
+def test_sweep_budget_clamped_to_remaining_deadline(monkeypatch, tmp_path):
+    """A sweep that starts near the watcher deadline must not hold the
+    shared chip past it (the round driver's own bench follows): the
+    subprocess timeout is clamped to the remaining deadline and bench
+    gets a NONZERO wall budget (0 would mean unbounded) so it winds
+    down between variants instead of being SIGKILLed mid-variant."""
+    mod = _load(monkeypatch, tmp_path)
+    monkeypatch.setattr(mod, "DEADLINE_H", 0.5)  # 1800s of deadline left
+    monkeypatch.setattr(mod, "probe", lambda: (True, None))
+    seen = {}
+
+    def fake_run(cmd, **kw):
+        seen["timeout"] = kw["timeout"]
+        seen["budget"] = kw["env"]["PBT_BENCH_MAX_SECONDS"]
+        return types.SimpleNamespace(
+            returncode=0, stderr="",
+            stdout=json.dumps({"platform": "tpu", "value": 1.0}) + "\n")
+
+    monkeypatch.setattr(mod.subprocess, "run", fake_run)
+    rc = mod.main()
+    assert rc == 0
+    assert seen["timeout"] <= 1800
+    assert 1 <= int(seen["budget"]) <= 1800  # clamped => nonzero bound
+
+
+def test_sweep_skipped_when_deadline_inside_one_variant(monkeypatch,
+                                                        tmp_path):
+    """With less deadline than one variant's budget, even a clamped
+    sweep would be SIGKILLed mid-first-variant with nothing persisted —
+    the watcher must leave the chip to the round driver's bench."""
+    mod = _load(monkeypatch, tmp_path)
+    monkeypatch.setattr(mod, "DEADLINE_H", 0.05)  # 180s < variant+120
+    monkeypatch.setattr(mod, "probe", lambda: (True, None))
+
+    def fake_run(cmd, **kw):  # pragma: no cover - must not be reached
+        raise AssertionError("sweep launched inside the no-fit window")
+
+    monkeypatch.setattr(mod.subprocess, "run", fake_run)
+    rc = mod.main()
+    assert rc == 7
+    status = json.load(open(tmp_path / "status.json"))
+    assert status["status"] == "deadline_before_sweep"
 
 
 def test_captured_status_reports_fresh_age(monkeypatch, tmp_path):

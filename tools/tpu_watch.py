@@ -10,7 +10,9 @@ Run detached:  nohup python tools/tpu_watch.py >> tpu_watch.log 2>&1 &
 Exit codes: 0 after a successful sweep; 2 another watcher is alive;
 3 deadline without ever reaching the TPU; 4 repeated non-timeout probe
 failures; 5 repeated on-TPU bench failures; 6 sweep timeouts (repeated,
-or one whose orphan drain would cross the deadline).
+or one whose orphan drain would cross the deadline); 7 tunnel up but
+too little deadline left to land even one variant (the window is left
+to the round driver's own bench).
 To chain the heavier hardware experiments automatically while the
 tunnel is proven up, set PBT_WATCH_AFTER_SWEEP to a shell command
 (e.g. "python examples/transfer_experiment.py --scale full"); it runs
@@ -181,20 +183,46 @@ def main():
         if ok:
             print(f"[tpu_watch] probe {n}: TPU UP — running full sweep",
                   flush=True)
-            put_status(status="sweeping", probes=n)
+            # A sweep that STARTS near the watcher deadline must not
+            # run its full budget past it: on a shared chip the round
+            # driver's own bench follows the deadline, and an overhang
+            # sweep would contend with (and skew) that measurement.
+            # bench's first variant always gets the full
+            # variant_timeout (uncapped by its wall budget), so with
+            # less deadline than that even a clamped sweep would be
+            # SIGKILLed mid-first-variant with NOTHING persisted and
+            # the kill misdiagnosed as a tunnel drop — leave such a
+            # window to the driver's own bench instead.
+            remaining_dl = DEADLINE_H * 3600 - (time.time() - t0)
+            if remaining_dl < variant_timeout() + 120:
+                print("[tpu_watch] tunnel is up but the deadline is "
+                      "inside one variant's budget; leaving the chip "
+                      "to the round driver's bench", flush=True)
+                put_status(status="deadline_before_sweep", probes=n)
+                return 7
+            sweep_to = min(SWEEP_TIMEOUT, int(remaining_dl))
+            put_status(status="sweeping", probes=n, sweep_budget_s=sweep_to)
             env = dict(os.environ,
                        PBT_BENCH_PROBE_ATTEMPTS="1",
                        PBT_BENCH_PROBE_TIMEOUT=str(PROBE_TIMEOUT),
-                       # The watcher wants the FULL sweep and already
-                       # bounds it with SWEEP_TIMEOUT; bench's own
-                       # default wall budget (for impatient callers
-                       # like the driver) must not cut it short.
-                       PBT_BENCH_MAX_SECONDS="0")
+                       # The watcher wants the FULL sweep when time
+                       # allows: its bound is the clamped sweep budget,
+                       # not bench's impatient-caller default. When
+                       # clamped, hand bench the budget minus a small
+                       # stop margin so it winds down BETWEEN variants
+                       # (persisting rows): bench's own child-timeout
+                       # clamp bounds any overshoot past its budget to
+                       # ~60s, so 120s suffices — a bigger margin would
+                       # forfeit measurement time from exactly the
+                       # scarce capture windows this daemon exists for.
+                       PBT_BENCH_MAX_SECONDS=str(
+                           max(1, sweep_to - 120)
+                           if sweep_to < SWEEP_TIMEOUT else 0))
             try:
                 out = subprocess.run(
                     [sys.executable, os.path.join(REPO, "bench.py")],
                     cwd=REPO, env=env, capture_output=True, text=True,
-                    timeout=SWEEP_TIMEOUT)
+                    timeout=sweep_to)
             except subprocess.TimeoutExpired:
                 # bench.py persists after every variant, so whatever ran
                 # is already in bench_last_tpu.json; keep watching —
@@ -202,7 +230,7 @@ def main():
                 # on the one shared chip.
                 sweep_timeouts += 1
                 refresh_last_good_stamp()  # partial rows persisted
-                print(f"[tpu_watch] sweep timed out after {SWEEP_TIMEOUT}s "
+                print(f"[tpu_watch] sweep timed out after {sweep_to}s "
                       f"({sweep_timeouts}/{SWEEP_TIMEOUT_CAP}; tunnel "
                       "dropped mid-run?); partial results persisted",
                       flush=True)

@@ -331,6 +331,15 @@ def build_variants(on_tpu, gate_pallas=True):
             ("remat-convs-st",
              dataclasses.replace(convs, scan_split_transpose=True),
              1024, 256),
+            # The two levers act on different parts of the same
+            # scan-boundary cost (unroll keeps layouts across bodies;
+            # split-transpose schedules the saves' layout traffic apart
+            # from grad math) — if each wins alone the combination may
+            # compound, and one capture window can settle all three.
+            ("remat-convs-u2st",
+             dataclasses.replace(convs, scan_unroll=2,
+                                 scan_split_transpose=True),
+             1024, 256),
         ]
         # Large (12-block/d=1024) and long-context (L=2048) preset shapes
         # at their measured-best single-chip batches, so the flagship

@@ -253,10 +253,12 @@ def test_sweep_decision_tool(tmp_path):
     d = run([base, sv("remat-convs-u2", 565800.0),
              sv("remat-convs-st", 540000.0)])
     assert d["decision"] == "partially-measured"
-    # All three measured, none above noise: the recorded null result.
+    # All four measured (incl. the u2+st combo), none above noise: the
+    # recorded null result.
     d = run([base, sv("remat-convs-u2", 565800.0),
              sv("remat-convs-u3", 560000.0),
-             sv("remat-convs-st", 540000.0)])
+             sv("remat-convs-st", 540000.0),
+             sv("remat-convs-u2st", 562000.0)])
     assert d["decision"] == "null-result"
     assert run([])["decision"] == "no-baseline"
 

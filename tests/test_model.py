@@ -173,6 +173,36 @@ def test_scan_unroll_matches(key):
         )
 
 
+def test_scan_unroll_plus_split_transpose_matches(key):
+    """Both scheduling knobs TOGETHER (the bench's remat-convs-u2st
+    variant) must still be value- and gradient-equivalent to the
+    knob-off baseline — the sharded parity test alone compares the
+    combo against itself on both sides and would miss a numerics
+    change common to both paths."""
+    cfg1 = tiny_cfg(remat=True, remat_policy="convs", num_blocks=5)
+    cfg_c = tiny_cfg(remat=True, remat_policy="convs", num_blocks=5,
+                     scan_unroll=2, scan_split_transpose=True)
+    params = proteinbert.init(key, cfg1)
+    tokens, ann = make_batch(key, cfg1)
+
+    def loss(p, c):
+        l, g = proteinbert.apply(p, tokens, ann, c)
+        return jnp.abs(l).mean() + jnp.abs(g).mean()
+
+    out1 = proteinbert.apply(params, tokens, ann, cfg1)
+    out_c = proteinbert.apply(params, tokens, ann, cfg_c)
+    for a, b in zip(out1, out_c):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5
+        ),
+        jax.grad(loss)(params, cfg1),
+        jax.grad(loss)(params, cfg_c),
+    )
+
+
 def test_scan_split_transpose_matches(key):
     """_split_transpose restructures only the scan's TRANSPOSE (the
     backward); forward values must be identical and gradients must match

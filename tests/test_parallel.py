@@ -138,7 +138,10 @@ def _assert_sharded_step_matches(cfg):
     # loop-plus-unroll pattern this test exists to cover.
     dict(scan_unroll=2, num_blocks=5, remat=True, remat_policy="convs"),
     dict(scan_split_transpose=True, remat=True, remat_policy="convs"),
-], ids=["u2-remat-convs", "st-remat-convs"])
+    # Both levers together — the bench's remat-convs-u2st variant.
+    dict(scan_unroll=2, num_blocks=5, scan_split_transpose=True,
+         remat=True, remat_policy="convs"),
+], ids=["u2-remat-convs", "st-remat-convs", "u2st-remat-convs"])
 def test_scan_knobs_match_single_device_under_fsdp(model_kw):
     """The scan scheduling knobs (partial unroll / split transpose) on
     the implicit-SPMD path must stay numerically equivalent to the

@@ -1,7 +1,8 @@
 """Decide the scan-boundary-lever question from the persisted sweep.
 
 VERDICT r3 item 1's closure condition: once `bench_last_tpu.json` holds
-rows for `remat-convs-u2/-u3/-st` at the north-star shape (1024/256),
+rows for every SCAN_VARIANTS lever (`remat-convs-u2/-u3/-st/-u2st`) at
+the north-star shape (1024/256),
 either a variant WINS — flip the preset defaults and re-run the trace
 attribution — or none does and the null result gets recorded and the
 knobs stay documented as experimental. This tool turns the persisted
@@ -24,7 +25,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WIN_THRESHOLD = float(os.environ.get("PBT_SWEEP_WIN_THRESHOLD", 0.015))
 
 BASELINE_KEY = ("remat-convs", 1024, 256)
-SCAN_VARIANTS = ("remat-convs-u2", "remat-convs-u3", "remat-convs-st")
+SCAN_VARIANTS = ("remat-convs-u2", "remat-convs-u3", "remat-convs-st",
+                 "remat-convs-u2st")
 PROVENANCE = (("large", 1024, 32), ("large", 1024, 64), ("long", 2048, 32))
 
 

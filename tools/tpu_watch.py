@@ -56,7 +56,11 @@ def _default_sweep_timeout():
     try:
         n = len(build_variants(True, gate_pallas=False)[0])
     except Exception:
-        n = 16
+        # Generous upper bound, deliberately ABOVE the current list
+        # size: an exact count here silently under-times the sweep the
+        # moment a variant is added (the mid-sweep SIGKILL this dynamic
+        # sizing exists to prevent).
+        n = 24
     return n * variant_timeout() + 600
 
 

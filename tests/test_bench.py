@@ -263,6 +263,24 @@ def test_sweep_decision_tool(tmp_path):
     assert run([])["decision"] == "no-baseline"
 
 
+def test_post_capture_report_smoke(tmp_path):
+    """The report generator must render whatever artifacts exist and
+    name the missing ones explicitly — never fail, never go silent."""
+    import subprocess
+    import sys as _sys
+
+    tool = os.path.join(os.path.dirname(__file__), "..", "tools",
+                        "post_capture_report.py")
+    out_md = tmp_path / "report.md"
+    p = subprocess.run([_sys.executable, tool, "--out", str(out_md)],
+                       capture_output=True, text=True)
+    assert p.returncode == 0, p.stderr
+    text = out_md.read_text()
+    for header in ("## Bench sweep", "## Scan-lever decision",
+                   "## Transfer", "## Sustained run"):
+        assert header in text, text[:500]
+
+
 class _FakeCompleted:
     def __init__(self, rc, stdout=b""):
         self.returncode = rc

@@ -1,0 +1,62 @@
+"""tools/reconstruct_windows.py — the cumulative→window inversion that
+attributed the r3 sustained-run collapse (BASELINE.md round-5 section).
+
+Two tiers: a synthetic stream with a KNOWN injected slow window (the
+inversion must recover it exactly), and the real committed r3 stream
+(the attribution's headline numbers are pinned so a tool regression
+cannot silently rewrite the evidence)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOL = os.path.join(REPO, "tools", "reconstruct_windows.py")
+
+
+def _run(args):
+    p = subprocess.run([sys.executable, TOOL, *args],
+                       capture_output=True, text=True, timeout=60)
+    assert p.returncode == 0, p.stderr
+    return json.loads(p.stdout)
+
+
+def test_inversion_recovers_known_window_rates(tmp_path):
+    # Build a cumulative stream: 10 steps/s everywhere except one
+    # 25-step window that takes 25s (1 step/s), logged every 25 steps.
+    path = tmp_path / "m.jsonl"
+    t, lines = 0.0, []
+    for s in range(25, 501, 25):
+        t += 25.0 if s == 275 else 2.5  # the 251-275 window stalls
+        lines.append(json.dumps({
+            "step": s, "loss": 1.0, "lr": 1e-4,
+            "steps_per_sec": s / t}))
+    path.write_text("\n".join(lines))
+    out = _run([str(path), "--log-every", "25"])
+    slow = {w["step"]: w for w in out["slow_windows"]}
+    assert list(slow) == [275]
+    assert slow[275]["rate"] == pytest.approx(1.0, rel=1e-6)
+    assert slow[275]["dt_s"] == pytest.approx(25.0, rel=1e-6)
+    assert out["median_rate"] == pytest.approx(10.0, rel=1e-6)
+
+
+def test_r3_collapse_attribution_is_stable():
+    """The recorded r3 stream's reconstruction: every one of the nine
+    in-run eval+ckpt boundaries produced a slow following window, and
+    the slow windows carry ~half the run's wall time — the numbers
+    BASELINE.md's round-5 attribution cites."""
+    out = _run([os.path.join(REPO, "experiments", "sustained_r3",
+                             "metrics.jsonl"),
+                "--seam", "2600", "--cadence", "500", "--log-every", "25"])
+    assert out["windows"] == 197
+    assert out["median_rate"] == pytest.approx(7.89, abs=0.05)
+    # All nine boundaries (525 ... 4525) flagged, none missing.
+    assert out["boundary_adjacent"] == [525 + 500 * i for i in range(9)]
+    assert out["slow_time_frac"] == pytest.approx(0.49, abs=0.02)
+    assert out["excess_time_s"] == pytest.approx(503, abs=10)
+    # The one-time post-first-boundary stretch exists in phase 1.
+    slow_steps = {w["step"] for w in out["slow_windows"]}
+    assert {650, 700, 750, 800} <= slow_steps

@@ -1,0 +1,122 @@
+"""Recover per-window step rates from a CUMULATIVE metrics stream.
+
+The r3 sustained run (experiments/sustained_r3/) recorded only the
+cumulative-since-warmup `steps_per_sec` at each log point — the very
+limitation that left its throughput collapse unattributed for two
+rounds (BASELINE.md). But the cumulative stream DETERMINES the window
+stream: with anchor a and cumulative rate c_i at step s_i, the wall
+time since anchor is (s_i - a)/c_i, so the i-th window's duration is
+
+    dt_i = (s_i - a)/c_i - (s_{i-1} - a)/c_{i-1}
+
+and its rate is (s_i - s_{i-1})/dt_i. This tool applies that inversion
+per phase (a preemption seam re-anchors the timer in the resumed
+process), flags windows slower than half the median, and reports how
+many of them are adjacent to an eval/checkpoint cadence boundary —
+turning the already-recorded r3 stream into an attribution, no
+hardware required. (Runs recorded from round 4 on carry native
+window_* rates and don't need this inversion; it remains the tool for
+auditing any cumulative-only stream.)
+
+Usage:
+  python tools/reconstruct_windows.py METRICS_JSONL \
+      [--seam STEP] [--cadence N] [--log-every N]
+Prints one JSON line; exit 0 on success.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+
+def load_train_records(path):
+    ded = {}
+    with open(path) as f:
+        for line in f:
+            try:
+                r = json.loads(line)
+            except ValueError:
+                continue
+            if "loss" in r and "lr" in r and r.get("steps_per_sec"):
+                ded[r["step"]] = r  # keep LAST record per step (seam re-log)
+    return ded
+
+
+def phase_windows(ded, phase_steps, anchor):
+    out = []
+    for s0, s1 in zip(phase_steps, phase_steps[1:]):
+        c0, c1 = ded[s0]["steps_per_sec"], ded[s1]["steps_per_sec"]
+        if not (c0 > 0 and c1 > 0):
+            continue
+        dt = (s1 - anchor) / c1 - (s0 - anchor) / c0
+        if dt > 0:
+            out.append({"step": s1, "n_steps": s1 - s0, "dt_s": dt,
+                        "rate": (s1 - s0) / dt})
+    return out
+
+
+def reconstruct(path, seam=None, cadence=None, log_every=None):
+    ded = load_train_records(path)
+    steps = sorted(ded)
+    if len(steps) < 3:
+        return {"error": f"too few usable records in {path}"}
+    phases = ([[s for s in steps if s <= seam], [s for s in steps if s > seam]]
+              if seam else [steps])
+    windows = []
+    for ph in phases:
+        if len(ph) < 2:
+            continue
+        # The timer's anchor is the phase's start (warmup excluded); the
+        # first logged step minus one log interval approximates it, and
+        # any anchor error decays as 1/c_i with distance from the start.
+        anchor = (ph[0] - (log_every or (ph[1] - ph[0]))
+                  if ph is phases[0] or not seam else seam)
+        windows += phase_windows(ded, ph, anchor)
+    rates = sorted(w["rate"] for w in windows)
+    med = rates[len(rates) // 2]
+    total_t = sum(w["dt_s"] for w in windows)
+    slow = [w for w in windows if w["rate"] < 0.5 * med]
+    excess = sum(w["dt_s"] - w["n_steps"] / med for w in slow)
+    out = {
+        "path": path,
+        "windows": len(windows),
+        "median_rate": round(med, 3),
+        "total_time_s": round(total_t, 1),
+        "overall_rate": round(sum(w["n_steps"] for w in windows) / total_t, 3),
+        "slow_windows": [
+            {"step": w["step"], "rate": round(w["rate"], 2),
+             "dt_s": round(w["dt_s"], 1)} for w in slow],
+        "slow_time_s": round(sum(w["dt_s"] for w in slow), 1),
+        "slow_time_frac": round(sum(w["dt_s"] for w in slow) / total_t, 3),
+        "excess_time_s": round(excess, 1),
+    }
+    if cadence and log_every:
+        adj = [w["step"] for w in slow
+               if (w["step"] - log_every) % cadence == 0]
+        out["boundary_adjacent"] = adj
+        out["boundary_adjacent_frac"] = (round(len(adj) / len(slow), 3)
+                                         if slow else None)
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("metrics_jsonl")
+    ap.add_argument("--seam", type=int,
+                    help="preemption step: the resumed process re-anchors "
+                         "its timer, so windows are reconstructed per phase")
+    ap.add_argument("--cadence", type=int,
+                    help="eval/checkpoint cadence for boundary-adjacency")
+    ap.add_argument("--log-every", type=int, dest="log_every")
+    args = ap.parse_args()
+    out = reconstruct(args.metrics_jsonl, seam=args.seam,
+                      cadence=args.cadence, log_every=args.log_every)
+    print(json.dumps(out))
+    return 1 if "error" in out else 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

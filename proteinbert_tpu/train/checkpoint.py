@@ -40,11 +40,18 @@ class Checkpointer:
             },
         )
 
-    def save(self, step: int, state: Any, data_state: Optional[Dict] = None) -> None:
+    def save(self, step: int, state: Any, data_state: Optional[Dict] = None) -> bool:
+        """Returns orbax's outcome: False means the manager SILENTLY
+        skipped (it does so for any step <= latest_step, not only
+        exact duplicates) — callers that need the save to have
+        happened (warm start, preemption) must check, not assume."""
         args = {"state": ocp.args.StandardSave(state)}
         if data_state is not None:
             args["data"] = ocp.args.JsonSave(data_state)
-        self._mngr.save(step, args=ocp.args.Composite(**args))
+        return bool(self._mngr.save(step, args=ocp.args.Composite(**args)))
+
+    def all_steps(self):
+        return list(self._mngr.all_steps())
 
     def restore(self, state_like: Any, step: Optional[int] = None):
         """Restore (state, data_state) at `step` (default: latest).

@@ -249,6 +249,27 @@ def pretrain(
         if log_fn is not None:
             log_fn(start_step, em)
 
+    if (cfg.checkpoint.warm_start and checkpointer is not None
+            and checkpointer.latest_step() is None):
+        # Warm-start save (r3 collapse attribution, BASELINE.md): the
+        # FIRST save of a run pays orbax directory init, thread-pool
+        # spinup, and the first full device->host state fetch — in r3
+        # that one-time cost landed inside the timed stream as the
+        # 650-800 stretch. Paying it here, before the StepTimer
+        # anchors, keeps the timed windows showing only the steady
+        # per-boundary cost. Only on a PRISTINE directory: with any
+        # checkpoint present the restore already walked the orbax
+        # machinery, and orbax silently skips saves at step <=
+        # latest_step anyway — the outcome is checked so "warm" is
+        # never logged for a save that did not happen.
+        if checkpointer.save(start_step, state, data_state_for(start_step)):
+            checkpointer.wait()
+            logger.info("warm-start checkpoint at step %d (pre-timer)",
+                        start_step)
+        else:
+            logger.warning("warm-start save at step %d was skipped by "
+                           "the checkpoint manager", start_step)
+
     n_chips = mesh.size if mesh is not None else jax.device_count()
     timer = StepTimer(
         cfg.model,

@@ -292,6 +292,22 @@ def pretrain(
             float(metrics["loss"])
             timer.sync()
 
+    def checked_save(save_step, save_state):
+        # Orbax SILENTLY skips saves at step <= the directory's latest
+        # (checkpoint.py) — at the preemption/early-stop/final sites a
+        # skipped save must at least be loud, or a "state saved,
+        # exiting" log could cover for lost progress (e.g. a run
+        # started with an explicit `state` against a mismatched
+        # directory whose newest checkpoint is ahead of it).
+        if not checkpointer.save(save_step, save_state,
+                                 data_state_for(save_step)):
+            logger.warning(
+                "checkpoint save at step %d was SKIPPED by the manager "
+                "(directory already holds a step >= %d) — state was NOT "
+                "written", save_step, save_step)
+            return False
+        return True
+
     fault_stall = _fault_stall_spec()
     if fault_stall:
         logger.warning("FAULT INJECTION ACTIVE: %.1fs stall at step %d "
@@ -405,11 +421,12 @@ def pretrain(
             # Preemption (SIGTERM) / operator interrupt: checkpoint at the
             # completed step and exit cleanly; resume picks up exactly here.
             drain_and_sync()
+            saved = False
             if checkpointer is not None:
-                checkpointer.save(step + 1, state, data_state_for(step + 1))
+                saved = checked_save(step + 1, state)
                 checkpointer.wait()
-            logger.warning("preempted at step %d: state saved, exiting",
-                           step + 1)
+            logger.warning("preempted at step %d: %s, exiting", step + 1,
+                           "state saved" if saved else "state NOT saved")
             preempted = True
             break
 
@@ -453,8 +470,7 @@ def pretrain(
                     # and stop — continuing only overfits further.
                     drain_and_sync()
                     if checkpointer is not None:
-                        checkpointer.save(step + 1, state,
-                                          data_state_for(step + 1))
+                        checked_save(step + 1, state)
                         checkpointer.wait()
                     logger.warning(
                         "early stop at step %d: eval_loss has not improved "
@@ -474,15 +490,14 @@ def pretrain(
             # the window when a later sync() extends it.
             drain_and_sync()
             t_save = time.perf_counter()
-            checkpointer.save(step + 1, state, data_state_for(step + 1))
+            checked_save(step + 1, state)
             ckpt_since_log = True
             timer.discount(time.perf_counter() - t_save)
 
     if not preempted and not early_stopped:
         drain_and_sync()
         if checkpointer is not None:
-            checkpointer.save(cfg.train.max_steps, state,
-                              data_state_for(cfg.train.max_steps))
+            checked_save(cfg.train.max_steps, state)
             checkpointer.wait()
 
     return {"state": state, "history": history, "perf": timer.summary(),

@@ -133,7 +133,14 @@ def main():
            "--set", "optimizer.plateau_metric=eval_loss",
            "--set", f"optimizer.plateau_window={S['eval_every']}",
            "--set", "optimizer.plateau_patience=3",
-           "--set", "optimizer.plateau_cooldown=2"]
+           "--set", "optimizer.plateau_cooldown=2",
+           # Warm-start save (round 5): the r3 attribution charged the
+           # first cadenced save's one-time orbax setup + device→host
+           # fetch with the 650-800 collapse stretch; paying it
+           # pre-timer makes this run a direct test of the mitigation —
+           # its window stream should show only the steady per-boundary
+           # cost, ckpt_in_flight-latched.
+           "--set", "checkpoint.warm_start=true"]
 
     # ---- phase 1: run until kill_at, then SIGTERM (preemption drill)
     print("+ " + " ".join(cmd[2:]), file=sys.stderr, flush=True)

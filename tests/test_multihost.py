@@ -43,7 +43,8 @@ def _parse_losses(stdout: str):
     return losses
 
 
-def _run_pair(port, env, mode, extra, timeout=600, expect_rc=0):
+def _run_pair(port, env, mode, extra, timeout=600, expect_rc=0,
+              _retry=True):
     procs = [
         subprocess.Popen(
             [sys.executable, _CHILD, str(pid), "2", str(port), mode, *extra],
@@ -61,6 +62,15 @@ def _run_pair(port, env, mode, extra, timeout=600, expect_rc=0):
         for p in procs:
             p.kill()
         raise
+    if _retry and any(rc != expect_rc and "Gloo context initialization"
+                      in err for rc, _, err in outs):
+        # Gloo's first-collective context setup has a fixed internal 30s
+        # GetKeyValue deadline with no public knob; on a loaded host the
+        # peer can miss it (observed under a concurrent corpus build).
+        # One retry distinguishes that environmental flake from a real
+        # coordination bug, which fails identically both times.
+        return _run_pair(port, env, mode, extra, timeout=timeout,
+                         expect_rc=expect_rc, _retry=False)
     for rc, out, err in outs:
         assert rc == expect_rc, (
             f"child rc {rc} (wanted {expect_rc}):\n{err[-3000:]}")
@@ -116,25 +126,7 @@ def test_two_process_training_matches_single_process(mode):
     port = _free_port()
     env = _child_env()
 
-    procs = [
-        subprocess.Popen(
-            [sys.executable, _CHILD, str(pid), "2", str(port), mode],
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-            env=env, cwd=_REPO,
-        )
-        for pid in range(2)
-    ]
-    outs = []
-    try:
-        for p in procs:
-            out, err = p.communicate(timeout=600)
-            outs.append((p.returncode, out, err))
-    except subprocess.TimeoutExpired:
-        for p in procs:
-            p.kill()
-        raise
-    for rc, out, err in outs:
-        assert rc == 0, f"child failed (rc {rc}):\n{err[-3000:]}"
+    outs = _run_pair(port, env, mode, [])
     dist_losses = _parse_losses(outs[0][1])
 
     single = subprocess.run(

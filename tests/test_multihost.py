@@ -68,8 +68,10 @@ def _run_pair(port, env, mode, extra, timeout=600, expect_rc=0,
         # GetKeyValue deadline with no public knob; on a loaded host the
         # peer can miss it (observed under a concurrent corpus build).
         # One retry distinguishes that environmental flake from a real
-        # coordination bug, which fails identically both times.
-        return _run_pair(port, env, mode, extra, timeout=timeout,
+        # coordination bug, which fails identically both times. Fresh
+        # port: the loaded host that caused the flake may have claimed
+        # the old one in the meantime.
+        return _run_pair(_free_port(), env, mode, extra, timeout=timeout,
                          expect_rc=expect_rc, _retry=False)
     for rc, out, err in outs:
         assert rc == expect_rc, (

@@ -43,6 +43,81 @@ def test_inversion_recovers_known_window_rates(tmp_path):
     assert out["median_rate"] == pytest.approx(10.0, rel=1e-6)
 
 
+def test_wall_mode_finds_t_gaps_and_detects_relog_seam(tmp_path):
+    """--wall reads the recorded wall clock `t` directly: brackets the
+    discounted rate stream excludes must surface as t gaps, tagged with
+    cadence adjacency and the ckpt_in_flight latch. The preemption seam
+    is the REALISTIC re-log shape — killed at 750, restored from the
+    ckpt at 500, resumed process re-logs 525 onward — and must be
+    detected from the file-order step reset and reported separately,
+    never as a (boundary-adjacent!) gap. Pre-warmup records carry no
+    steps_per_sec; their `t` must still bound intervals."""
+    path = tmp_path / "m.jsonl"
+    t, lines = 0.0, []
+
+    def rec(s, extra=None):
+        lines.append(json.dumps({
+            "step": s, "loss": 1.0, "lr": 1e-4, "t": t, **(extra or {})}))
+
+    for s in range(25, 751, 25):   # phase 1: killed after 750
+        t += 2.5
+        if s == 525:               # bracket after the eval/ckpt at 500
+            t += 30.0
+        # First log point pre-warmup: no steps_per_sec yet.
+        rec(s, None if s == 25 else
+            {"steps_per_sec": 10.0,
+             "ckpt_in_flight": 1.0 if s == 525 else 0.0})
+    t += 120.0                     # restart + restore + recompile
+    for s in range(525, 1001, 25):  # phase 2 re-logs from the restore
+        t += 2.5
+        rec(s, {"steps_per_sec": 10.0, "ckpt_in_flight": 0.0})
+    path.write_text("\n".join(lines))
+    out = _run([str(path), "--wall", "--cadence", "500",
+                "--log-every", "25"])
+    assert [g["step"] for g in out["gaps"]] == [525]
+    assert out["gaps"][0]["dt_s"] == pytest.approx(32.5, abs=0.1)
+    assert out["gaps"][0]["ckpt_in_flight"] is True
+    assert out["boundary_adjacent"] == [525]
+    assert out["seams"] == [{"after_step": 750, "resumed_at": 525,
+                             "dt_s": pytest.approx(122.5, abs=0.1)}]
+    assert out["median_interval_s"] == pytest.approx(2.5, abs=0.01)
+    assert out["gap_excess_s"] == pytest.approx(30.0, abs=0.1)
+    # Total spans the pre-warmup first record through the last.
+    assert out["total_wall_s"] == pytest.approx(
+        29 * 2.5 + 30.0 + 122.5 + 19 * 2.5, abs=0.1)
+    # With a reset detected, an explicit --seam must NOT re-classify
+    # the resumed segment's normal crossing of the kill step.
+    out2 = _run([str(path), "--wall", "--seam", "750",
+                 "--cadence", "500", "--log-every", "25"])
+    assert out2["gaps"] == out["gaps"]
+    assert out2["seams"] == out["seams"]
+
+
+def test_wall_mode_declared_monotonic_seam(tmp_path):
+    """The OTHER real resume shape (the round-5 sustained run's): the
+    preemption save wrote at the kill step, phase 2's steps strictly
+    advance, no reset exists — the restart interval can only be kept
+    out of the gap list by declaring --seam."""
+    path = tmp_path / "m.jsonl"
+    t, lines = 0.0, []
+    for s in range(25, 1001, 25):
+        t += 2.5
+        if s == 625:  # restart right after the kill at 600
+            t += 100.0
+        lines.append(json.dumps({
+            "step": s, "loss": 1.0, "lr": 1e-4, "t": t}))
+    path.write_text("\n".join(lines))
+    out = _run([str(path), "--wall", "--seam", "600",
+                "--cadence", "500", "--log-every", "25"])
+    assert out["gaps"] == []
+    assert out["seams"] == [{"after_step": 600, "resumed_at": 625,
+                             "dt_s": pytest.approx(102.5, abs=0.1)}]
+    # Undeclared, the same stream misattributes the restart as a gap.
+    out2 = _run([str(path), "--wall", "--cadence", "500",
+                 "--log-every", "25"])
+    assert [g["step"] for g in out2["gaps"]] == [625]
+
+
 def test_r3_collapse_attribution_is_stable():
     """The recorded r3 stream's reconstruction: every one of the nine
     in-run eval+ckpt boundaries produced a slow following window, and

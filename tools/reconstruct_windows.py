@@ -18,9 +18,23 @@ hardware required. (Runs recorded from round 4 on carry native
 window_* rates and don't need this inversion; it remains the tool for
 auditing any cumulative-only stream.)
 
+A second mode, `--wall`, reads the wall-clock `t` stamped on every log
+record (round 4+) instead of inverting rates. The two views are
+complementary BY DESIGN: `window_mfu`/`steps_per_sec` DISCOUNT
+eval/checkpoint brackets (StepTimer.discount — the train-rate numbers
+stay honest), so a bracket that blocks the host shows up ONLY as a gap
+in `t`. `--wall` finds log intervals whose wall duration exceeds the
+run's median by >THRESH seconds, and tags each with whether it sits on
+the eval/ckpt cadence and whether the next window latched
+ckpt_in_flight — the full wall-time attribution the discounted stream
+cannot give. Preemption seams are reported separately from gaps:
+re-log seams auto-detected from the file-order step reset, monotonic
+seams (preemption save at the kill step itself) declared via --seam.
+
 Usage:
   python tools/reconstruct_windows.py METRICS_JSONL \
-      [--seam STEP] [--cadence N] [--log-every N]
+      [--seam STEP] [--cadence N] [--log-every N] \
+      [--wall [--gap-thresh S]]
 Prints one JSON line; exit 0 on success.
 """
 
@@ -73,8 +87,7 @@ def reconstruct(path, seam=None, cadence=None, log_every=None):
         anchor = (ph[0] - (log_every or (ph[1] - ph[0]))
                   if ph is phases[0] or not seam else seam)
         windows += phase_windows(ded, ph, anchor)
-    rates = sorted(w["rate"] for w in windows)
-    med = rates[len(rates) // 2]
+    med = _median([w["rate"] for w in windows])
     total_t = sum(w["dt_s"] for w in windows)
     slow = [w for w in windows if w["rate"] < 0.5 * med]
     excess = sum(w["dt_s"] - w["n_steps"] / med for w in slow)
@@ -92,11 +105,112 @@ def reconstruct(path, seam=None, cadence=None, log_every=None):
         "excess_time_s": round(excess, 1),
     }
     if cadence and log_every:
-        adj = [w["step"] for w in slow
-               if (w["step"] - log_every) % cadence == 0]
-        out["boundary_adjacent"] = adj
-        out["boundary_adjacent_frac"] = (round(len(adj) / len(slow), 3)
-                                         if slow else None)
+        _boundary_adjacency(out, [w["step"] for w in slow],
+                            cadence, log_every)
+    return out
+
+
+def _median(vals):
+    return sorted(vals)[len(vals) // 2]
+
+
+def _boundary_adjacency(out, steps, cadence, log_every):
+    """Tag which flagged steps sit one log interval past an eval/ckpt
+    cadence boundary — shared by both modes so they cannot diverge in
+    how they classify the same boundary."""
+    adj = [s for s in steps if (s - log_every) % cadence == 0]
+    out["boundary_adjacent"] = adj
+    out["boundary_adjacent_frac"] = (round(len(adj) / len(steps), 3)
+                                     if steps else None)
+
+
+def wall_gaps(path, cadence=None, log_every=None, gap_thresh=10.0,
+              seam=None):
+    """Attribute wall-clock gaps the discounted rate stream excludes.
+
+    A gap is a log interval whose `t` span exceeds median + gap_thresh.
+    Preemption seams (restart + restore + recompile — not brackets) are
+    kept out of the gap list two ways, covering both real resume shapes:
+
+    - RE-LOG seams are detected from file order: a step that does not
+      advance starts a new segment (the resumed process restored from a
+      cadence checkpoint BELOW the kill step and re-logs forward).
+      Intervals are computed within segments only; each between-segment
+      span goes under `seams`. No dedup — it would splice phase-2 wall
+      clocks onto phase-1 steps and misattribute the restart to a
+      bracket (often a boundary-adjacent one, since cadence checkpoints
+      are where restores land).
+    - MONOTONIC seams (the preemption save wrote at the kill step
+      itself, so phase 2's steps strictly advance and no reset exists
+      in the stream) cannot be detected and must be declared: the span
+      containing the caller's `seam` step is moved to `seams`.
+
+    Unlike the inversion mode, this needs no rate fields: records are
+    kept on `loss`+`lr`+`t` alone, so pre-warmup log points (which
+    carry no steps_per_sec yet) still bound their intervals.
+    """
+    recs = []
+    with open(path) as f:
+        for line in f:
+            try:
+                r = json.loads(line)
+            except ValueError:
+                continue
+            if "loss" in r and "lr" in r and r.get("t") is not None:
+                recs.append(r)
+    if len(recs) < 3:
+        return {"error": f"too few t-stamped records in {path}"}
+    segments, cur = [], [recs[0]]
+    for r in recs[1:]:
+        if r["step"] <= cur[-1]["step"]:
+            segments.append(cur)
+            cur = [r]
+        else:
+            cur.append(r)
+    segments.append(cur)
+    # An explicit seam only applies to a reset-free stream: with a
+    # detected re-log reset the restart is already under `seams`, and
+    # the RESUMED segment re-crosses the kill step as a normal
+    # interval that must not be re-classified.
+    if len(segments) > 1:
+        seam = None
+    spans, seams = [], []
+    for i, seg in enumerate(segments):
+        if i:
+            prev = segments[i - 1][-1]
+            seams.append({"after_step": prev["step"],
+                          "resumed_at": seg[0]["step"],
+                          "dt_s": round(seg[0]["t"] - prev["t"], 1)})
+        for r0, r1 in zip(seg, seg[1:]):
+            if seam is not None and r0["step"] <= seam < r1["step"]:
+                seams.append({"after_step": r0["step"],
+                              "resumed_at": r1["step"],
+                              "dt_s": round(r1["t"] - r0["t"], 1)})
+                continue
+            spans.append({"step": r1["step"], "dt_s": r1["t"] - r0["t"],
+                          "ckpt_in_flight":
+                              bool(r1.get("ckpt_in_flight"))})
+    if not spans:
+        return {"error": f"no within-segment intervals in {path}"}
+    med = _median([sp["dt_s"] for sp in spans])
+    gaps = [sp for sp in spans if sp["dt_s"] > med + gap_thresh]
+    total = (sum(sp["dt_s"] for sp in spans)
+             + sum(sm["dt_s"] for sm in seams))
+    gap_excess = sum(sp["dt_s"] - med for sp in gaps)
+    out = {
+        "path": path, "intervals": len(spans),
+        "median_interval_s": round(med, 2),
+        "total_wall_s": round(total, 1),
+        "gaps": [{"step": sp["step"], "dt_s": round(sp["dt_s"], 1),
+                  "ckpt_in_flight": sp["ckpt_in_flight"]}
+                 for sp in gaps],
+        "gap_excess_s": round(gap_excess, 1),
+        "gap_excess_frac": round(gap_excess / total, 3) if total else None,
+        "seams": seams,
+    }
+    if cadence and log_every:
+        _boundary_adjacency(out, [g["step"] for g in out["gaps"]],
+                            cadence, log_every)
     return out
 
 
@@ -109,9 +223,21 @@ def main():
     ap.add_argument("--cadence", type=int,
                     help="eval/checkpoint cadence for boundary-adjacency")
     ap.add_argument("--log-every", type=int, dest="log_every")
+    ap.add_argument("--wall", action="store_true",
+                    help="attribute wall-clock t gaps instead of "
+                         "inverting the discounted rate stream")
+    ap.add_argument("--gap-thresh", type=float, default=10.0,
+                    dest="gap_thresh",
+                    help="seconds over the median interval that makes "
+                         "a wall gap (--wall mode)")
     args = ap.parse_args()
-    out = reconstruct(args.metrics_jsonl, seam=args.seam,
-                      cadence=args.cadence, log_every=args.log_every)
+    if args.wall:
+        out = wall_gaps(args.metrics_jsonl, cadence=args.cadence,
+                        log_every=args.log_every,
+                        gap_thresh=args.gap_thresh, seam=args.seam)
+    else:
+        out = reconstruct(args.metrics_jsonl, seam=args.seam,
+                          cadence=args.cadence, log_every=args.log_every)
     print(json.dumps(out))
     return 1 if "error" in out else 0
 

@@ -355,6 +355,13 @@ def build_variants(on_tpu, gate_pallas=True):
             ("large", get_preset("large").model, 1024, 32),
             ("large", get_preset("large").model, 1024, 64),
             ("long", get_preset("long").model, 2048, 32),
+            ("long", get_preset("long").model, 2048, 64),
+            # L=4096 at the same tokens/step as the 2048/32 headline:
+            # the model is position-embedding-free (conv local track +
+            # global attention), so L extends freely — this row is the
+            # single-chip anchor for the long-context claim before the
+            # seq-parallel path splits L across chips.
+            ("long", get_preset("long").model, 4096, 16),
         ]
         variants += [
             # Batch is the biggest lever (docs/performance.md); push the
@@ -528,7 +535,7 @@ def main():
         # the backend, so exactly one PJRT client exists at a time and a
         # hung remote compile is bounded by the per-variant timeout.
         #
-        # Whole-sweep wall budget: a cold-cache 16-variant sweep can run
+        # Whole-sweep wall budget: a cold-cache ~20-variant sweep can run
         # for hours, and a caller that loses patience and kills this
         # process gets NO JSON line (the round-3 parsed=null failure,
         # from the other side). The sweep is ordered by priority and

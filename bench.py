@@ -356,12 +356,15 @@ def build_variants(on_tpu, gate_pallas=True):
             ("large", get_preset("large").model, 1024, 64),
             ("long", get_preset("long").model, 2048, 32),
             ("long", get_preset("long").model, 2048, 64),
-            # L=4096 at the same tokens/step as the 2048/32 headline:
-            # the model is position-embedding-free (conv local track +
-            # global attention), so L extends freely — this row is the
-            # single-chip anchor for the long-context claim before the
-            # seq-parallel path splits L across chips.
+            # L=4096/8192/16384 at the same tokens/step as 2048/32: the
+            # model is position-embedding-free (conv local track +
+            # global attention), so L extends freely — these rows are
+            # the single-chip long-context curve (flat MFU through 8192;
+            # the 16384 row marks the B=4 batch floor where the
+            # seq-parallel path takes over).
             ("long", get_preset("long").model, 4096, 16),
+            ("long", get_preset("long").model, 8192, 8),
+            ("long", get_preset("long").model, 16384, 4),
         ]
         variants += [
             # Batch is the biggest lever (docs/performance.md); push the

@@ -309,37 +309,14 @@ def build_variants(on_tpu, gate_pallas=True):
         convs = dataclasses.replace(base, remat=True, remat_policy="convs")
         # ORDER = PRIORITY: the tunnel can drop mid-sweep and the parent
         # persists after every variant, so the variants a short window
-        # must settle come first — the north-star headline (freshness),
-        # then the UNMEASURED scan-boundary levers (VERDICT r3 item 1),
-        # then the large/long provenance rows (item 4); re-confirmations
-        # of shapes that already have rows run last.
+        # must refresh come first — the north-star shape and the
+        # headline long-context row, then the large/long provenance
+        # rows, then the settled scan-boundary levers (measured round 5,
+        # null result — kept as regression rows, no longer urgent) and
+        # the re-confirmation shapes.
         variants = [  # (name, model, seq_len, batch)
             # North-star shape: seq_len 1024 (same tokens/step as 512@512).
             ("remat-convs", convs, 1024, 256),
-            # Partial scan unroll: XLA sees 2/3 block bodies per scan
-            # iteration and can keep activation layouts across them —
-            # targeting the measured scan-boundary transpose cost
-            # (docs/performance.md attribution) at bounded compile cost
-            # (full unroll was compile-prohibitive, round 2).
-            ("remat-convs-u2",
-             dataclasses.replace(convs, scan_unroll=2), 1024, 256),
-            ("remat-convs-u3",
-             dataclasses.replace(convs, scan_unroll=3), 1024, 256),
-            # The other lever on the same scan-boundary cost: transpose
-            # the block scan as two passes (lax.scan _split_transpose) so
-            # the saves' layout traffic schedules apart from grad math.
-            ("remat-convs-st",
-             dataclasses.replace(convs, scan_split_transpose=True),
-             1024, 256),
-            # The two levers act on different parts of the same
-            # scan-boundary cost (unroll keeps layouts across bodies;
-            # split-transpose schedules the saves' layout traffic apart
-            # from grad math) — if each wins alone the combination may
-            # compound, and one capture window can settle all three.
-            ("remat-convs-u2st",
-             dataclasses.replace(convs, scan_unroll=2,
-                                 scan_split_transpose=True),
-             1024, 256),
         ]
         # Large (12-block/d=1024) and long-context (L=2048) preset shapes
         # at their measured-best single-chip batches, so the flagship
@@ -352,21 +329,40 @@ def build_variants(on_tpu, gate_pallas=True):
         from proteinbert_tpu.configs import get_preset
 
         variants += [
+            # The repo headline row (fastest measured shape) right after
+            # the north-star: a short window refreshes both.
+            ("long", get_preset("long").model, 8192, 8),
             ("large", get_preset("large").model, 1024, 32),
             ("large", get_preset("large").model, 1024, 64),
+            # The rest of the single-chip long-context curve — 2048/32,
+            # 4096/16, and 16384/4 are iso-tokens/step with 8192/8
+            # (65,536; the 2048/64 row is the double-batch point, NOT
+            # part of the iso curve): the model is position-embedding-
+            # free (conv local track + global attention), so L extends
+            # freely (flat MFU through 8192; the 16384 row marks the
+            # B=4 batch floor where the seq-parallel path takes over).
             ("long", get_preset("long").model, 2048, 32),
             ("long", get_preset("long").model, 2048, 64),
-            # L=4096/8192/16384 at the same tokens/step as 2048/32: the
-            # model is position-embedding-free (conv local track +
-            # global attention), so L extends freely — these rows are
-            # the single-chip long-context curve (flat MFU through 8192;
-            # the 16384 row marks the B=4 batch floor where the
-            # seq-parallel path takes over).
             ("long", get_preset("long").model, 4096, 16),
-            ("long", get_preset("long").model, 8192, 8),
             ("long", get_preset("long").model, 16384, 4),
         ]
         variants += [
+            # Scan-boundary levers: measured round 5 at the north-star
+            # shape, NULL result (st -0.1%, u2 -5.4%, u3 -6.8%, u2st
+            # -5.2% — sweep_decision.py records the call). Kept as
+            # regression rows so a compiler upgrade that flips the
+            # trade shows up in the sweep; no longer priority-ordered.
+            ("remat-convs-u2",
+             dataclasses.replace(convs, scan_unroll=2), 1024, 256),
+            ("remat-convs-u3",
+             dataclasses.replace(convs, scan_unroll=3), 1024, 256),
+            ("remat-convs-st",
+             dataclasses.replace(convs, scan_split_transpose=True),
+             1024, 256),
+            ("remat-convs-u2st",
+             dataclasses.replace(convs, scan_unroll=2,
+                                 scan_split_transpose=True),
+             1024, 256),
             # Batch is the biggest lever (docs/performance.md); push the
             # north-star shape until HBM says stop — the in-loop skip
             # keeps an OOM from killing the sweep.

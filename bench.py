@@ -459,11 +459,20 @@ def run_variant(index, on_tpu):
     }
 
 
+def variant_matches(pat, variant):
+    """--only matching: the bare name AND the 'name:seq/batch' shape
+    key, so anchored name patterns ('u2st$') and row-targeted ones
+    ('remat-convs:1024/512$') both work."""
+    name, _, seq, batch = variant
+    return bool(pat.search(name) or pat.search(f"{name}:{seq}/{batch}"))
+
+
 def main():
-    # Optional variant filter (substring/regex on the variant name, e.g.
-    # `bench.py --only 'u[23]'`): lets a tunnel-up window be spent on
-    # exactly the unmeasured variants instead of re-running the whole
-    # ~25-min sweep. The driver invokes bench.py with no args, so the
+    # Optional variant filter (regex on the variant name or its
+    # 'name:seq/batch' shape key — `bench.py --only 'u[23]'`, or one
+    # row via `--only 'remat-convs:1024/512$'`): lets a tunnel-up
+    # window be spent on exactly the rows that need refreshing instead
+    # of re-running the whole ~25-min sweep. The driver invokes bench.py with no args, so the
     # default (everything) and the emitted JSON contract are unchanged;
     # persist_last_good merges per-shape, so a filtered run can only add
     # or refresh rows, never drop evidence.
@@ -472,7 +481,10 @@ def main():
 
     ap = argparse.ArgumentParser()
     ap.add_argument("--only", default=None, metavar="REGEX",
-                    help="run only variants whose name matches REGEX")
+                    help="run only variants whose name OR shape key "
+                         "'name:seq/batch' matches REGEX (e.g. "
+                         "'remat-convs:1024/512$' for one row; name-"
+                         "only patterns keep working unchanged)")
     ap.add_argument("--run-index", type=int, default=None, metavar="N",
                     help="internal: run ONE variant of the TPU list "
                          "in-process and print its row as JSON")
@@ -507,7 +519,7 @@ def main():
     def select(variant_list, strict=True):
         idx = list(range(len(variant_list)))
         if pat is not None:
-            hit = [i for i in idx if pat.search(variant_list[i][0])]
+            hit = [i for i in idx if variant_matches(pat, variant_list[i])]
             if hit:
                 return hit
             if strict:

@@ -21,6 +21,28 @@ def test_variant_rows_unique():
     assert keys == [(name, seq, b) for name, _, seq, b in v2]
 
 
+def test_only_filter_matches_names_and_shape_keys():
+    """--only matches the bare variant name (backward compat, anchored
+    patterns included) and the 'name:seq/batch' shape key (so one row
+    of a multi-shape variant can be refreshed in a short window)."""
+    import re
+
+    variants, _ = bench.build_variants(True, gate_pallas=False)
+
+    def hits(pattern):
+        pat = re.compile(pattern)
+        return [(v[0], v[2], v[3]) for v in variants
+                if bench.variant_matches(pat, v)]
+
+    # Name-anchored pattern keeps matching despite the shape-key text.
+    assert hits("u2st$") == [("remat-convs-u2st", 1024, 256)]
+    # Row-targeted: exactly one shape of a six-shape variant.
+    assert hits("remat-convs:1024/512$") == [("remat-convs", 1024, 512)]
+    # Plain substring still matches every shape of the variant family.
+    assert len(hits("pallas")) == 3
+    assert hits("nonexistent") == []
+
+
 def test_cpu_fallback_variant_is_tiny():
     (name, model, seq, batch), steps = bench.build_variants(False)[0][0], \
         bench.build_variants(False)[1]

@@ -178,6 +178,17 @@ class CheckpointConfig:
     every_steps: int = 1000
     max_to_keep: int = 3
     async_save: bool = True
+    overlap: bool = True                    # overlapped boundary: snapshot
+                                            # the state on device and run
+                                            # the device→host fetch + save
+                                            # on a stager thread while the
+                                            # train stream keeps
+                                            # dispatching — the boundary
+                                            # costs ~zero wall time instead
+                                            # of drain→fetch→save
+                                            # (single-process runs only;
+                                            # multi-host falls back to the
+                                            # synchronous collective save)
     warm_start: bool = False                # save once at the start step,
                                             # BEFORE the perf timer anchors:
                                             # pays orbax setup + the first
@@ -211,6 +222,18 @@ class TrainConfig:
                                             # the patience baseline.
     early_stop_min_delta: float = 0.0       # improvement smaller than this
                                             # still counts as a stall
+    overlap_eval: bool = True               # dispatch the periodic eval
+                                            # bracket asynchronously and
+                                            # resolve its metrics after the
+                                            # next train step has been
+                                            # dispatched, instead of a
+                                            # synchronous fetch-per-batch
+                                            # bracket. Applied only where
+                                            # legal: an eval-keyed plateau
+                                            # or early stopping needs the
+                                            # eval value BEFORE the next
+                                            # step and keeps the
+                                            # synchronous bracket.
     seed: int = 0
 
 

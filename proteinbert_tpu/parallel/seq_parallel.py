@@ -198,13 +198,15 @@ def seq_parallel_apply(
     interpret = jax.default_backend() != "tpu"
     fn = partial(_shard_forward, cfg=cfg, axis_size=axis_size,
                  interpret=interpret)
-    return jax.shard_map(
+    from proteinbert_tpu.parallel.mesh import shard_map
+
+    return shard_map(
         fn,
         mesh=mesh,
         in_specs=(P(), P(_BATCH_AXES, _SEQ_AXIS), P(_BATCH_AXES, None)),
         out_specs=(P(_BATCH_AXES, _SEQ_AXIS, None), P(_BATCH_AXES, None)),
         # pallas_call's out_shape carries no varying-mesh-axes metadata,
-        # so the vma checker cannot type the fused-kernel path.
+        # so the vma/rep checker cannot type the fused-kernel path.
         check_vma=False,
     )(params, tokens, annotations)
 
@@ -250,4 +252,4 @@ def make_seq_parallel_train_step(mesh: Mesh, cfg: PretrainConfig):
         return ts.TrainState(step=state.step + 1, params=params,
                              opt_state=opt_state, key=key), metrics
 
-    return jax.jit(step, donate_argnums=0)
+    return jax.jit(step, donate_argnums=ts.DONATE_STATE)

@@ -1,7 +1,8 @@
 from proteinbert_tpu.train.loss import pretrain_loss
 from proteinbert_tpu.train.schedule import make_schedule, make_optimizer, needs_loss_value
 from proteinbert_tpu.train.train_state import (
-    TrainState, create_train_state, train_step, eval_step,
+    TrainState, create_train_state, snapshot_train_state, train_step,
+    eval_step,
 )
 from proteinbert_tpu.train.metrics import (
     forward_flops, train_flops, peak_flops_per_chip, StepTimer,
@@ -15,7 +16,8 @@ from proteinbert_tpu.train.finetune import (
 
 __all__ = [
     "pretrain_loss", "make_schedule", "make_optimizer", "needs_loss_value",
-    "TrainState", "create_train_state", "train_step", "eval_step",
+    "TrainState", "create_train_state", "snapshot_train_state",
+    "train_step", "eval_step",
     "forward_flops", "train_flops", "peak_flops_per_chip", "StepTimer",
     "Checkpointer", "pretrain",
     "FinetuneState", "create_finetune_state", "finetune", "finetune_step",

@@ -8,15 +8,29 @@ key, step) plus the data-iterator position is saved through orbax:
 sharded (each host writes its own shards), optionally async (save
 overlaps the next train steps), with automatic retention of the last
 `max_to_keep` checkpoints.
+
+On top of orbax's async write, `save_staged` overlaps the part orbax
+keeps synchronous — the device→host state fetch: the trainer snapshots
+the state on device (train_state.snapshot_train_state), hands the copy
+here, and a stager thread fetches + saves it while the train stream
+keeps dispatching. One stage in flight (backpressure via flush);
+worker errors re-raise at the next flush/poll/wait; orbax's silent
+skip-at-old-step stays loudly surfaced.
 """
 
 from __future__ import annotations
 
+import logging
 import os
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, Optional
 
 import jax
 import orbax.checkpoint as ocp
+
+logger = logging.getLogger(__name__)
 
 
 class Checkpointer:
@@ -24,6 +38,21 @@ class Checkpointer:
 
     def __init__(self, directory: str, max_to_keep: int = 3, async_save: bool = True):
         self.directory = os.path.abspath(directory)
+        # Staged (overlapped) save slot: at most ONE in flight — the
+        # double-buffer is {the device-side snapshot} + {the host copy
+        # the stager fetches into}; a second boundary arriving while a
+        # stage is in flight back-pressures through flush_staged().
+        self._staged: Optional[tuple] = None  # (future, holder dict)
+        # ONE dedicated saver thread for every manager.save call, staged
+        # or direct: orbax's CheckpointManager requires all saves to
+        # originate from the SAME thread — its wait-for-previous-
+        # finalize bookkeeping only resets `_finalize_thread` when the
+        # waiter IS the thread that requested the previous save, so a
+        # save from any other thread trips `assert _finalize_thread is
+        # None` whenever an async finalize is still alive.
+        self._saver = ThreadPoolExecutor(max_workers=1,
+                                         thread_name_prefix="ckpt-saver")
+        self._saver_thread: Optional[threading.Thread] = None
         self._mngr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
@@ -40,15 +69,100 @@ class Checkpointer:
             },
         )
 
+    def _on_saver(self, fn):
+        """Run `fn` on the dedicated saver thread (directly when already
+        on it — the staged work function calls save() from there) and
+        return its result; exceptions propagate to the caller."""
+        if threading.current_thread() is self._saver_thread:
+            return fn()
+
+        def run():
+            self._saver_thread = threading.current_thread()
+            return fn()
+
+        return self._saver.submit(run).result()
+
     def save(self, step: int, state: Any, data_state: Optional[Dict] = None) -> bool:
         """Returns orbax's outcome: False means the manager SILENTLY
         skipped (it does so for any step <= latest_step, not only
         exact duplicates) — callers that need the save to have
-        happened (warm start, preemption) must check, not assume."""
+        happened (warm start, preemption) must check, not assume.
+        Blocks the caller for the synchronous part of the save (the
+        write itself is async when async_save); the manager call runs
+        on the saver thread (see __init__)."""
         args = {"state": ocp.args.StandardSave(state)}
         if data_state is not None:
             args["data"] = ocp.args.JsonSave(data_state)
-        return bool(self._mngr.save(step, args=ocp.args.Composite(**args)))
+        composite = ocp.args.Composite(**args)
+        return bool(self._on_saver(
+            lambda: self._mngr.save(step, args=composite)))
+
+    # ---------------------------------------------- overlapped (staged) saves
+
+    def _stage_fetch(self, snapshot: Any) -> Any:
+        """Device→host fetch of an (already device-copied) snapshot; runs
+        on the stager thread. A method so tests can interpose latency."""
+        return jax.device_get(snapshot)
+
+    def save_staged(self, step: int, snapshot: Any,
+                    data_state: Optional[Dict] = None) -> None:
+        """Hand a DEVICE-SIDE snapshot (train_state.snapshot_train_state)
+        to a background fetch+save and return immediately — the caller's
+        train stream keeps dispatching while the device→host transfer
+        and the orbax write run on the stager thread (the transfer has
+        no data dependency on later train steps, so it costs ~zero wall
+        time instead of the 19–47 s stop-the-world of a synchronous
+        boundary).
+
+        Backpressure rule: one stage in flight. If a previous stage has
+        not landed when the next boundary arrives, this call BLOCKS in
+        flush_staged() first — that wait is real stall and the trainer
+        deliberately leaves it inside the timed window.
+
+        Error/skip semantics: a stager exception is re-raised at the
+        next flush_staged()/poll_staged()/wait() (never swallowed); an
+        orbax silent skip (step <= latest) is surfaced with the same
+        loud warning the synchronous path logs."""
+        self.flush_staged()
+        holder: Dict[str, Any] = {"step": step}
+
+        def work():
+            self._saver_thread = threading.current_thread()
+            t0 = time.perf_counter()
+            try:
+                host_state = self._stage_fetch(snapshot)
+                holder["saved"] = self.save(step, host_state, data_state)
+            finally:
+                holder["overlap_s"] = time.perf_counter() - t0
+
+        self._staged = (self._saver.submit(work), holder)
+
+    def flush_staged(self) -> Optional[Dict[str, Any]]:
+        """Join the in-flight staged save (no-op when none). Re-raises a
+        stager exception; logs the loud SKIPPED warning when orbax
+        silently refused the step. Returns the stage's stats
+        ({step, saved, overlap_s}) or None."""
+        if self._staged is None:
+            return None
+        fut, holder = self._staged
+        self._staged = None
+        fut.result()  # joins; re-raises a stager exception
+        if not holder.get("saved"):
+            logger.warning(
+                "staged checkpoint save at step %d was SKIPPED by the "
+                "manager (directory already holds a step >= %d) — state "
+                "was NOT written", holder["step"], holder["step"])
+        return holder
+
+    def poll_staged(self) -> Optional[Dict[str, Any]]:
+        """Non-blocking flush: stats if the in-flight stage has finished
+        (errors/skips surfaced exactly as flush_staged), else None."""
+        if self._staged is None or not self._staged[0].done():
+            return None
+        return self.flush_staged()
+
+    def staged_in_flight(self) -> bool:
+        return self._staged is not None and not self._staged[0].done()
 
     def all_steps(self):
         return list(self._mngr.all_steps())
@@ -70,25 +184,39 @@ class Checkpointer:
         if "data" in (self._mngr.item_metadata(step) or {}):
             args["data"] = ocp.args.JsonRestore()
         restored = self._mngr.restore(step, args=ocp.args.Composite(**args))
-        return restored["state"], restored.get("data")
+        # Donation-safety canonicalization — never return orbax's
+        # arrays directly (copy_pytree's docstring has the jax-0.4.37
+        # warm-cache segfault repro this guards against).
+        from proteinbert_tpu.train.train_state import copy_pytree
+
+        return copy_pytree(restored["state"]), restored.get("data")
 
     def latest_step(self) -> Optional[int]:
         return self._mngr.latest_step()
 
     def in_flight(self) -> bool:
-        """True while an async save is still writing. The trainer ORs
-        this with a started-since-last-log latch and stamps the result
-        into each logged metrics record (`ckpt_in_flight`) so a slow
-        window in the stream can be attributed to (or cleared of)
+        """True while an async OR staged save is still writing. The
+        trainer ORs this with a started-since-last-log latch and stamps
+        the result into each logged metrics record (`ckpt_in_flight`) so
+        a slow window in the stream can be attributed to (or cleared of)
         checkpoint I/O contending for host/tunnel bandwidth — the
-        leading suspect for the r3 sustained run's collapse. (The latch
-        matters: a point sample alone would miss a save that started
-        and finished between two log points.)"""
-        return bool(self._mngr.is_saving_in_progress())
+        leading suspect for the r3 sustained run's collapse. Under the
+        overlapped boundary this latch marks a REAL overlap window (the
+        staged fetch+write running behind training), not contention.
+        (The latch matters: a point sample alone would miss a save that
+        started and finished between two log points.)"""
+        return bool(self.staged_in_flight()
+                    or self._mngr.is_saving_in_progress())
 
     def wait(self) -> None:
-        """Block until pending async saves land (call before process exit)."""
+        """Block until pending staged AND async saves land (call before
+        process exit); staged-worker errors propagate from here."""
+        self.flush_staged()
         self._mngr.wait_until_finished()
 
     def close(self) -> None:
-        self._mngr.close()
+        try:
+            self.flush_staged()
+        finally:
+            self._saver.shutdown(wait=True)
+            self._mngr.close()

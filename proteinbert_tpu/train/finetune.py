@@ -33,7 +33,7 @@ from proteinbert_tpu.data.vocab import PAD_ID
 from proteinbert_tpu.models import finetune as ft_model
 from proteinbert_tpu.train.metrics import DeviceMetricAccumulator
 from proteinbert_tpu.train.schedule import make_optimizer, needs_loss_value
-from proteinbert_tpu.train.train_state import gradient_update
+from proteinbert_tpu.train.train_state import DONATE_STATE, gradient_update
 
 logger = logging.getLogger(__name__)
 
@@ -102,7 +102,7 @@ def task_loss(
     raise ValueError(f"unknown task kind {kind!r}")
 
 
-@partial(jax.jit, static_argnames="cfg", donate_argnums=0)
+@partial(jax.jit, static_argnames="cfg", donate_argnums=DONATE_STATE)
 def finetune_step(
     state: FinetuneState, batch: Dict[str, jax.Array], cfg: FinetuneConfig
 ) -> Tuple[FinetuneState, Dict[str, jax.Array]]:

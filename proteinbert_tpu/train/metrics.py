@@ -87,6 +87,11 @@ class DeviceMetricAccumulator:
     """
 
     def __init__(self, drain_every: int = 8):
+        # drain_every=0 defers EVERY fetch to sums(): the overlapped-eval
+        # dispatch path wants zero mid-loop device syncs (the single
+        # resolve-time device_get is the only host block). Memory then
+        # grows with the batch count — fine for eval splits, do not use
+        # for unbounded streams.
         self.drain_every = drain_every
         self._pending: list = []
         self._sums: Dict[str, float] = {}
@@ -96,7 +101,7 @@ class DeviceMetricAccumulator:
             key_fn=None) -> None:
         self._pending.append((m, weight, key_fn))
         self.count += 1
-        if len(self._pending) >= self.drain_every:
+        if self.drain_every and len(self._pending) >= self.drain_every:
             self._drain()
 
     def _drain(self) -> None:
@@ -152,6 +157,15 @@ class StepTimer:
         # after warmup); advanced to the last summary()'s snapshot after.
         self._win_t = None
         self._win_steps = 0
+        # Overlap account: boundary seconds that ran HIDDEN behind the
+        # train stream (staged checkpoint fetch+write). Unlike
+        # discount(), these do NOT shift the anchors — the wall clock
+        # never stopped for them, so the window stays honest with them
+        # in; the account exists so the hidden cost is REPORTED (the
+        # counterfactual stall a synchronous boundary would have paid),
+        # not bookkept away.
+        self._overlap_s = 0.0
+        self._win_overlap_s = 0.0
 
     def discount(self, seconds: float) -> None:
         """Remove non-training wall time (an eval pass, a blocking save)
@@ -164,6 +178,17 @@ class StepTimer:
                 # window charges the eval/save the cumulative rate
                 # just excluded.
                 self._win_t += seconds
+
+    def overlap(self, seconds: float) -> None:
+        """Record boundary work that executed CONCURRENTLY with training
+        (a staged checkpoint's device→host fetch + write). The anchors
+        do not move — hidden seconds cost no wall time — but summary()
+        reports them (`overlap_s` / `window_overlap_s`) so the overlap
+        win is measured, not assumed, and the wall-gap attribution tool
+        can tell an overlapped boundary from a stop-the-world one."""
+        if seconds > 0:
+            self._overlap_s += seconds
+            self._win_overlap_s += seconds
 
     def sync(self) -> None:
         """Extend the measured window to now. Call right after a
@@ -215,7 +240,11 @@ class StepTimer:
                                  else self._t0)
         if win_steps > 0 and win_dt > 0:
             out.update(self._rates(win_steps, win_dt, "window_"))
+        if self._overlap_s:
+            out["overlap_s"] = self._overlap_s
+            out["window_overlap_s"] = self._win_overlap_s
         # Close the window: the next summary() measures from here.
         self._win_t = self._t_last
         self._win_steps = self._steps_timed
+        self._win_overlap_s = 0.0
         return out

@@ -15,6 +15,12 @@ looks at the logs. Two mechanisms, both wired into train/trainer.py:
   logged metrics; on trigger the trainer saves a diagnostic checkpoint
   and raises `NonFiniteLossError` (cfg.train.on_nan="halt", default) or
   logs and continues ("warn").
+
+Both paths interact with the overlapped checkpoint boundary: a staged
+snapshot may be mid-flight (device→host fetch on the stager thread)
+when the SIGTERM or the NaN lands, and it must be flushed to disk
+before the exit-75 requeue / halt — `flush_inflight_checkpoint` is the
+shared best-effort flush both trainer paths call.
 """
 
 from __future__ import annotations
@@ -74,6 +80,23 @@ class GracefulShutdown:
             signal.signal(s, prev)
         self._previous.clear()
         return False
+
+
+def flush_inflight_checkpoint(checkpointer, context: str) -> None:
+    """Best-effort flush of staged/async checkpoint work on a failure
+    path (SIGTERM → exit-75 requeue, NaN halt): an overlapped boundary
+    may have a snapshot mid-fetch when the run dies, and abandoning it
+    would lose the newest durable state a requeued run could resume
+    from. Flush errors are LOGGED, never raised — the original failure
+    (the signal, the NaN) must stay the reported cause of death."""
+    if checkpointer is None:
+        return
+    try:
+        checkpointer.wait()
+    except Exception:
+        logger.exception(
+            "flushing in-flight checkpoint state during %s failed "
+            "(continuing with the original failure path)", context)
 
 
 def check_finite(metrics: Dict[str, float], step: int, mode: str = "halt",

@@ -19,6 +19,7 @@ the reference never had (SURVEY C18).
 
 from __future__ import annotations
 
+import os
 from functools import partial
 from typing import Any, Dict, Tuple
 
@@ -26,6 +27,19 @@ import flax.struct
 import jax
 import jax.numpy as jnp
 import optax
+
+# Buffer-donation opt-out, honored by every donating step in the
+# framework (train_step here, finetune_step, the explicit seq-parallel
+# step). On jax 0.4.x, executables DESERIALIZED from the persistent
+# compilation cache mis-handle donated buffers on the CPU backend —
+# observed as both segfaults and silently dropped parameter updates;
+# without donation the same warm-cache runs are bit-correct
+# (tests/conftest.py documents the repro). The test harness therefore
+# sets PBT_DISABLE_DONATION=1 and keeps the compile cache: donation is
+# worthless on CPU smoke shapes but vital for HBM headroom on TPU, so
+# it stays on by default. Read at import time — it must be set before
+# the first `proteinbert_tpu` import to take effect.
+DONATE_STATE = () if os.environ.get("PBT_DISABLE_DONATION") else (0,)
 
 from proteinbert_tpu.configs import PretrainConfig
 from proteinbert_tpu.models import proteinbert
@@ -59,6 +73,40 @@ def gradient_update(
     return params, opt_state
 
 
+@jax.jit
+def copy_pytree(tree):
+    """Jitted identity copy of a pytree — fresh XLA-produced buffers.
+
+    Two consumers, one jit cache entry: snapshot_train_state (below)
+    uses it to decouple a checkpoint snapshot from the donated live
+    buffers, and Checkpointer.restore uses it to canonicalize
+    orbax-restored arrays — on jax 0.4.37's CPU backend, restored
+    arrays fed straight into a DONATING jitted step whose executable
+    was DESERIALIZED from the persistent compilation cache segfault
+    (minimal repro: orbax restore + donate_argnums + warm
+    jax_compilation_cache_dir; remove any one, no crash). The copy
+    re-materializes leaves as ordinary XLA outputs, which cached
+    executables donate safely — device_put/host round-trips do NOT."""
+    return jax.tree.map(jnp.copy, tree)
+
+
+def snapshot_train_state(state: TrainState) -> TrainState:
+    """On-device copy of the whole state pytree, dispatched asynchronously.
+
+    The overlapped checkpoint boundary (trainer/checkpoint.py) needs a
+    version of the state whose buffers the training stream can never
+    touch: `train_step` donates its state argument, so the buffers of
+    `state` are REUSED by the very next step — a background device→host
+    fetch reading them directly would either race the overwrite or (at
+    the Python level) hit jax's deleted-buffer guard. The jitted copy
+    returns fresh buffers that capture exactly the boundary step's
+    values; because dispatch is async, this call costs host-enqueue time
+    only, and the copy itself is device-side memcpy ordered BEFORE the
+    next train step on the stream. The staged saver then device_gets the
+    copy from a worker thread while training keeps dispatching."""
+    return copy_pytree(state)
+
+
 def create_train_state(key: jax.Array, cfg: PretrainConfig) -> TrainState:
     k_init, k_state = jax.random.split(key)
     params = proteinbert.init(k_init, cfg.model)
@@ -71,7 +119,7 @@ def create_train_state(key: jax.Array, cfg: PretrainConfig) -> TrainState:
     )
 
 
-@partial(jax.jit, static_argnames="cfg", donate_argnums=0)
+@partial(jax.jit, static_argnames="cfg", donate_argnums=DONATE_STATE)
 def train_step(
     state: TrainState, batch: Dict[str, jax.Array], cfg: PretrainConfig,
     plateau_value: Any = None,

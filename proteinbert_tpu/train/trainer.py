@@ -28,7 +28,9 @@ from proteinbert_tpu.configs import PretrainConfig
 from proteinbert_tpu.train import train_state as ts
 from proteinbert_tpu.train.checkpoint import Checkpointer
 from proteinbert_tpu.train.metrics import DeviceMetricAccumulator, StepTimer
-from proteinbert_tpu.train.resilience import GracefulShutdown, check_finite
+from proteinbert_tpu.train.resilience import (
+    GracefulShutdown, check_finite, flush_inflight_checkpoint,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -282,6 +284,19 @@ def pretrain(
     diagnostic_saved = False
     ckpt_since_log = False  # a save started since the last log point
     metrics = None
+    # Overlapped boundaries: the checkpoint path needs every shard
+    # addressable from this process (device_get assembles the snapshot
+    # host-side); under multi-host the synchronous collective save is
+    # the only correct path. The eval overlap is legal only when
+    # nothing needs the eval value BEFORE the next train step — an
+    # eval-keyed plateau feeds it into the optimizer and early stopping
+    # decides the break at the boundary, so both keep the synchronous
+    # bracket.
+    overlap_ckpt = (checkpointer is not None and cfg.checkpoint.overlap
+                    and jax.process_count() == 1)
+    overlap_eval = (cfg.train.overlap_eval and not eval_keyed_plateau
+                    and not cfg.train.early_stop_patience)
+    pending_eval = None  # (1-based eval step, dispatch_eval handle)
 
     def drain_and_sync():
         # Force the enqueued steps to completion and fold the wait into
@@ -292,6 +307,30 @@ def pretrain(
             float(metrics["loss"])
             timer.sync()
 
+    def flush_staged_overlap():
+        # Join an in-flight staged save (the backpressure rule: at most
+        # one stage, so a second boundary arriving mid-overlap waits
+        # here — that wait is real stall and stays IN the timed window).
+        # The seconds the stage ran hidden behind training go to the
+        # overlap account; worker errors re-raise here.
+        if checkpointer is None:
+            return
+        t0 = time.perf_counter()
+        stats = checkpointer.flush_staged()
+        if stats:
+            stall = time.perf_counter() - t0
+            timer.overlap(max(stats.get("overlap_s", 0.0) - stall, 0.0))
+
+    def harvest_staged():
+        # Non-blocking: fold a COMPLETED staged save into the overlap
+        # account (worker errors surface here too, at the next log
+        # point after the failure instead of silently never).
+        if checkpointer is None:
+            return
+        stats = checkpointer.poll_staged()
+        if stats:
+            timer.overlap(stats.get("overlap_s", 0.0))
+
     def checked_save(save_step, save_state):
         # Orbax SILENTLY skips saves at step <= the directory's latest
         # (checkpoint.py) — at the preemption/early-stop/final sites a
@@ -299,6 +338,7 @@ def pretrain(
         # exiting" log could cover for lost progress (e.g. a run
         # started with an explicit `state` against a mismatched
         # directory whose newest checkpoint is ahead of it).
+        flush_staged_overlap()  # ordering: one save writing at a time
         if not checkpointer.save(save_step, save_state,
                                  data_state_for(save_step)):
             logger.warning(
@@ -307,6 +347,44 @@ def pretrain(
                 "written", save_step, save_step)
             return False
         return True
+
+    def resolve_pending_eval():
+        # Land an overlap-dispatched eval bracket. Called right after
+        # the NEXT train step's dispatch (so the single metrics fetch
+        # waits only out the eval's remaining device time while the
+        # train step is already queued behind it), and at any point
+        # that needs the eval stream current (a checkpoint boundary's
+        # data_state, the end of the run). The fetch wait is eval
+        # device time, not training time — discounted exactly like the
+        # synchronous bracket; the host-side reduction it pays for
+        # (pooled ranking stats) runs while the device crunches the
+        # queued train step.
+        nonlocal pending_eval, last_eval_loss, best_eval_loss, stalled_evals
+        if pending_eval is None:
+            return
+        e_step, handle = pending_eval
+        pending_eval = None
+        t0 = time.perf_counter()
+        em, _, _ = resolve_eval(handle)
+        timer.discount(time.perf_counter() - t0)
+        history.append({"step": e_step, **em})
+        logger.info(
+            "step %d eval loss %.4f (local %.4f global %.4f) acc %.3f",
+            e_step, em["eval_loss"], em["eval_local_loss"],
+            em["eval_global_loss"], em["eval_local_acc"],
+        )
+        if log_fn is not None:
+            log_fn(e_step, em)
+        last_eval_loss = np.float32(em["eval_loss"])
+        # Best/stalled bookkeeping stays identical to the synchronous
+        # bracket so the checkpointed eval_stream state is byte-equal
+        # between the two modes (early stopping itself is never active
+        # here — it is part of the overlap legality gate above).
+        if em["eval_loss"] < best_eval_loss - cfg.train.early_stop_min_delta:
+            best_eval_loss = em["eval_loss"]
+            stalled_evals = 0
+        else:
+            stalled_evals += 1
 
     fault_stall = _fault_stall_spec()
     if fault_stall:
@@ -331,6 +409,10 @@ def pretrain(
         else:
             state, metrics = step_fn(state, put(batch), cfg)
         timer.update()
+        # An overlap-dispatched eval bracket lands HERE — after this
+        # step's dispatch, so its metrics fetch runs with the train
+        # step already queued behind the eval on the device stream.
+        resolve_pending_eval()
         if step - start_step + 1 == timer.warmup_steps:
             # Guaranteed drain at the warmup boundary: t0 was just
             # anchored at host ENQUEUE time, with the compile/warmup
@@ -389,8 +471,16 @@ def pretrain(
                     diagnostic_saved = True
                     logger.warning("non-finite state preserved in %s",
                                    checkpointer.directory + "-diagnostic")
+                if cfg.train.on_nan == "halt":
+                    # About to raise: a staged snapshot mid-fetch is the
+                    # newest durable state a requeued run could resume
+                    # from — flush it before dying (best-effort; the
+                    # NaN stays the reported cause).
+                    flush_inflight_checkpoint(checkpointer,
+                                              "non-finite halt")
                 # Raises in halt mode; logs the warning in warn mode.
                 check_finite(m, step + 1, mode=cfg.train.on_nan)
+            harvest_staged()  # completed overlap lands in this record
             m.update(timer.summary())
             if checkpointer is not None:
                 # Attribution flag, not a metric: 1.0 when a checkpoint
@@ -423,6 +513,11 @@ def pretrain(
             drain_and_sync()
             saved = False
             if checkpointer is not None:
+                # An in-flight staged snapshot must land BEFORE the
+                # exit-75 requeue — best-effort, so a stager failure
+                # cannot turn a clean preemption into a crash.
+                flush_inflight_checkpoint(
+                    checkpointer, "preemption (SIGTERM/SIGINT)")
                 saved = checked_save(step + 1, state)
                 checkpointer.wait()
             logger.warning("preempted at step %d: %s, exiting", step + 1,
@@ -438,66 +533,117 @@ def pretrain(
             # Drain BEFORE starting the eval bracket: otherwise the
             # eval's first device fetch waits out the enqueued train
             # steps and discount() below subtracts that real step time
-            # from the window, inflating throughput/MFU.
+            # from the window, inflating throughput/MFU. (The overlap
+            # path needs the drain too — after it, the eval batches are
+            # the ONLY queued device work, so the deferred resolve-time
+            # fetch waits out eval compute alone and discounting it
+            # cannot swallow real step time.)
             drain_and_sync()
             t_eval = time.perf_counter()
             if fault_eval_stall:
                 # Injected INSIDE the discounted bracket: the drill
                 # asserts this does NOT surface as a slow window.
                 time.sleep(fault_eval_stall)
-            # Key the eval by the 1-based step recorded in history, so
-            # `evaluate --like-step <history step>` reproduces it.
-            em = _evaluate(state, eval_batches(), put, cfg, step + 1)
-            timer.discount(time.perf_counter() - t_eval)
-            history.append({"step": step + 1, **em})
-            logger.info(
-                "step %d eval loss %.4f (local %.4f global %.4f) acc %.3f",
-                step + 1, em["eval_loss"], em["eval_local_loss"],
-                em["eval_global_loss"], em["eval_local_acc"],
-            )
-            if log_fn is not None:
-                log_fn(step + 1, em)
-            last_eval_loss = np.float32(em["eval_loss"])
-            if em["eval_loss"] < best_eval_loss - cfg.train.early_stop_min_delta:
-                best_eval_loss = em["eval_loss"]
-                stalled_evals = 0
+            if overlap_eval:
+                # Overlapped bracket: dispatch every eval batch (host
+                # prep + enqueue — discounted) and defer the metrics
+                # fetch until after the next train step's dispatch; the
+                # eval_step dispatches capture the boundary state's
+                # buffers BEFORE the next (donating) train step reuses
+                # them, so the results are exact. History/log records
+                # and the eval-stream bookkeeping happen at resolve
+                # time — identical values, one step later in the
+                # stream. Keying stays by the 1-based boundary step, so
+                # `evaluate --like-step` reproduces it either way.
+                handle = dispatch_eval(
+                    state, eval_batches(), put, cfg,
+                    eval_base_key(cfg, step + 1), drain_every=0)
+                timer.discount(time.perf_counter() - t_eval)
+                pending_eval = (step + 1, handle)
             else:
-                stalled_evals += 1
-                if (cfg.train.early_stop_patience
-                        and stalled_evals >= cfg.train.early_stop_patience):
-                    # The regime shift the r3 sustained run exposed: eval
-                    # rising while train loss falls. Checkpoint the state
-                    # and stop — continuing only overfits further.
-                    drain_and_sync()
-                    if checkpointer is not None:
-                        checked_save(step + 1, state)
-                        checkpointer.wait()
-                    logger.warning(
-                        "early stop at step %d: eval_loss has not improved "
-                        "for %d consecutive evals (best %.4f)",
-                        step + 1, stalled_evals, best_eval_loss)
-                    early_stopped = True
-                    break
+                # Key the eval by the 1-based step recorded in history,
+                # so `evaluate --like-step <history step>` reproduces it.
+                em = _evaluate(state, eval_batches(), put, cfg, step + 1)
+                timer.discount(time.perf_counter() - t_eval)
+                history.append({"step": step + 1, **em})
+                logger.info(
+                    "step %d eval loss %.4f (local %.4f global %.4f) "
+                    "acc %.3f",
+                    step + 1, em["eval_loss"], em["eval_local_loss"],
+                    em["eval_global_loss"], em["eval_local_acc"],
+                )
+                if log_fn is not None:
+                    log_fn(step + 1, em)
+                last_eval_loss = np.float32(em["eval_loss"])
+                if em["eval_loss"] < best_eval_loss - cfg.train.early_stop_min_delta:
+                    best_eval_loss = em["eval_loss"]
+                    stalled_evals = 0
+                else:
+                    stalled_evals += 1
+                    if (cfg.train.early_stop_patience
+                            and stalled_evals >= cfg.train.early_stop_patience):
+                        # The regime shift the r3 sustained run exposed:
+                        # eval rising while train loss falls. Checkpoint
+                        # the state and stop — continuing only overfits
+                        # further.
+                        drain_and_sync()
+                        if checkpointer is not None:
+                            checked_save(step + 1, state)
+                            checkpointer.wait()
+                        logger.warning(
+                            "early stop at step %d: eval_loss has not "
+                            "improved for %d consecutive evals (best %.4f)",
+                            step + 1, stalled_evals, best_eval_loss)
+                        early_stopped = True
+                        break
 
         if (
             checkpointer is not None
             and cfg.checkpoint.every_steps
             and (step + 1) % cfg.checkpoint.every_steps == 0
         ):
-            # Drain first (so the save's state reads don't swallow real
-            # step time), then discount the save itself — host
-            # serialization is not training time and must not deflate
-            # the window when a later sync() extends it.
-            drain_and_sync()
-            t_save = time.perf_counter()
-            checked_save(step + 1, state)
-            ckpt_since_log = True
-            timer.discount(time.perf_counter() - t_save)
+            if overlap_ckpt:
+                # Overlapped boundary: no drain, no stop-the-world.
+                # The on-device snapshot captures this step's state
+                # before the next (donating) train step can reuse its
+                # buffers; the stager thread runs the device→host fetch
+                # + orbax write behind the train steps the loop keeps
+                # dispatching. The eval stream must be current FIRST —
+                # a same-step overlapped eval is still pending and its
+                # values belong in this boundary's data_state (resume
+                # must restore them byte-identically).
+                resolve_pending_eval()
+                flush_staged_overlap()  # backpressure: one stage in flight
+                snap = ts.snapshot_train_state(state)
+                checkpointer.save_staged(step + 1, snap,
+                                         data_state_for(step + 1))
+                ckpt_since_log = True
+                # Deliberately NOT discounted: the snapshot dispatch +
+                # thread handoff are the boundary's only in-window cost
+                # (~ms). The hidden fetch+write seconds are credited to
+                # the overlap account when the stage lands
+                # (harvest/flush), so summary() reports them as
+                # overlapped rather than vanishing.
+            else:
+                # Drain first (so the save's state reads don't swallow
+                # real step time), then discount the save itself — host
+                # serialization is not training time and must not
+                # deflate the window when a later sync() extends it.
+                drain_and_sync()
+                t_save = time.perf_counter()
+                checked_save(step + 1, state)
+                ckpt_since_log = True
+                timer.discount(time.perf_counter() - t_save)
 
+    # An eval dispatched at the final step resolves here — before the
+    # final save's data_state is built.
+    resolve_pending_eval()
     if not preempted and not early_stopped:
         drain_and_sync()
         if checkpointer is not None:
-            checked_save(cfg.train.max_steps, state)
+            flush_staged_overlap()
+            if checkpointer.latest_step() != cfg.train.max_steps:
+                checked_save(cfg.train.max_steps, state)
             checkpointer.wait()
 
     return {"state": state, "history": history, "perf": timer.summary(),
@@ -511,38 +657,31 @@ def eval_base_key(cfg: PretrainConfig, step: int) -> jax.Array:
     return jax.random.fold_in(jax.random.PRNGKey(cfg.train.seed + 1), step)
 
 
-def evaluate_batches(
+def dispatch_eval(
     state, batches, put, cfg: PretrainConfig, base_key: jax.Array,
-    prefix: str = "eval_", max_batches: int = 0,
+    max_batches: int = 0, drain_every: int = 8,
 ):
-    """Eval metrics over `batches` (each batch keyed by
-    fold_in(base_key, batch_index) → reproducible). Returns
-    (metrics dict, n_batches, n_rows).
+    """Dispatch eval_step over `batches` (each keyed by
+    fold_in(base_key, batch_index) → reproducible) WITHOUT fetching the
+    results; returns an opaque pending handle for resolve_eval.
 
-    Loss/accuracy metrics are the row-weighted mean of the per-batch
-    values (weighting matters only when batch sizes differ — the
-    standalone CLI's tail batch). The ranking metrics global_auroc /
-    global_p_at_k are POOLED at the split level from each batch's
-    mergeable sufficient statistics (loss.global_ranking_stats): a
-    dataset micro-AUROC is a property of the joint score distribution,
-    not a mean of per-batch AUROCs (VERDICT r2 Weak #5). The per-batch
-    means of the exact in-batch values remain available, renamed
-    *_batch_mean."""
+    Per-batch metric scalars stay ON DEVICE; the accumulator fetches
+    them in one device_get per drain (bounded memory + dispatch
+    backpressure) instead of ~10 high-latency roundtrips per batch on
+    the tunneled single-chip setup. drain_every=0 defers EVERY fetch to
+    resolve time — the overlapped eval bracket's mode, where the single
+    resolve-time fetch happens after the next train step has already
+    been dispatched, so the host never stands still inside the bracket.
+    Row-weighting and the pooled-key rename fold in at drain time on
+    host (float64 numerics)."""
     if max_batches:
         # Cap BEFORE pulling: the for-loop must not fetch (and discard)
         # one extra batch's worth of HDF5 reads + tokenization.
         import itertools
 
         batches = itertools.islice(batches, max_batches)
-    from proteinbert_tpu.train.loss import ranking_metrics_from_stats
-
     pooled = ("global_auroc", "global_p_at_k")
-    # Per-batch metric scalars stay ON DEVICE; the accumulator fetches
-    # them in one device_get per drain (bounded memory + dispatch
-    # backpressure) instead of ~10 high-latency roundtrips per batch on
-    # the tunneled single-chip setup. Row-weighting and the pooled-key
-    # rename fold in at drain time on host (float64 numerics).
-    acc = DeviceMetricAccumulator()
+    acc = DeviceMetricAccumulator(drain_every=drain_every)
     rename = lambda k: f"{k}_batch_mean" if k in pooled else k  # noqa: E731
     rank_stats = None
     n = 0
@@ -557,6 +696,24 @@ def evaluate_batches(
         acc.add(m, weight=b_rows, key_fn=rename)
         n += 1
         rows += b_rows
+    return acc, rank_stats, n, rows
+
+
+def resolve_eval(pending, prefix: str = "eval_"):
+    """Fetch + reduce a dispatch_eval handle → (metrics, n, rows).
+
+    Loss/accuracy metrics are the row-weighted mean of the per-batch
+    values (weighting matters only when batch sizes differ — the
+    standalone CLI's tail batch). The ranking metrics global_auroc /
+    global_p_at_k are POOLED at the split level from each batch's
+    mergeable sufficient statistics (loss.global_ranking_stats): a
+    dataset micro-AUROC is a property of the joint score distribution,
+    not a mean of per-batch AUROCs (VERDICT r2 Weak #5). The per-batch
+    means of the exact in-batch values remain available, renamed
+    *_batch_mean."""
+    from proteinbert_tpu.train.loss import ranking_metrics_from_stats
+
+    acc, rank_stats, n, rows = pending
     metrics = {f"{prefix}{k}": v / max(rows, 1)
                for k, v in acc.sums().items()}
     if rank_stats is not None:
@@ -564,6 +721,19 @@ def evaluate_batches(
         metrics.update({f"{prefix}{k}": v for k, v in
                         ranking_metrics_from_stats(rank_stats).items()})
     return metrics, n, rows
+
+
+def evaluate_batches(
+    state, batches, put, cfg: PretrainConfig, base_key: jax.Array,
+    prefix: str = "eval_", max_batches: int = 0,
+):
+    """Synchronous eval over `batches` → (metrics dict, n_batches,
+    n_rows); dispatch_eval + resolve_eval in one call (the CLI
+    `evaluate` path and the trainer's non-overlapped bracket)."""
+    return resolve_eval(
+        dispatch_eval(state, batches, put, cfg, base_key,
+                      max_batches=max_batches),
+        prefix)
 
 
 def _evaluate(state, batches, put, cfg, step) -> Dict[str, float]:

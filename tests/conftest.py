@@ -8,11 +8,6 @@ multi-chip path via __graft_entry__.dryrun_multichip.
 
 import os
 
-# Env-var config for plain environments AND pytest-spawned subprocesses
-# (which inherit os.environ).
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/proteinbert_tpu_jax_cache")
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.3")
-
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
@@ -27,16 +22,56 @@ import jax  # noqa: E402  (import after env setup is the point)
 # none of the settings above take in-process — everything must also go
 # through the config API, before any device use.
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    # jax >= 0.5: first-class option, works even when the XLA_FLAGS env
+    # var was read before conftest ran.
+    jax.config.update("jax_num_cpu_devices", 8)
+    _NEW_JAX = True
+except AttributeError:
+    # jax 0.4.x has no such option — the XLA_FLAGS fallback set above is
+    # the only mechanism, and it works as long as the CPU backend has not
+    # been initialized yet (XLA reads the env var at client creation, not
+    # at module import). Nothing to do here; the assertion below verifies
+    # the flag actually took.
+    _NEW_JAX = False
+
 # Persistent XLA compilation cache: the suite is compile-bound on CPU (the
 # same train-step HLO is rebuilt by many tests), and a warm cache cuts
-# single-test wall time ~3x.
+# single-test wall time ~3x (without it the tier-1 suite blows its 870 s
+# budget). On jax 0.4.x the cache is only safe WITHOUT buffer donation:
+# executables DESERIALIZED from the persistent cache mis-handle donated
+# buffers on the CPU backend — reproduced as a hard segfault
+# (orbax-restored state + donated train_step + warm cache) and, worse,
+# SILENT wrong numerics (a warm-cache donated finetune_step stopped
+# applying head updates; sharded train_step loss diverged from the
+# single-device reference; the identical runs are bit-correct with
+# donation off). So on old jax the harness disables donation instead of
+# the cache — PBT_DISABLE_DONATION is read by the framework's donating
+# steps at import (train/train_state.py), and the env vars are inherited
+# by every pytest-spawned subprocess. Donation buys nothing on CPU smoke
+# shapes; production TPU runs keep it.
+if not _NEW_JAX:
+    os.environ["PBT_DISABLE_DONATION"] = "1"
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      "/tmp/proteinbert_tpu_jax_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.3")
 jax.config.update("jax_compilation_cache_dir",
                   os.environ["JAX_COMPILATION_CACHE_DIR"])
 jax.config.update(
     "jax_persistent_cache_min_compile_time_secs",
     float(os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"]),
 )
+
+# Fail at collection, loudly and once, if neither mechanism produced the
+# 8-device CPU mesh — otherwise every sharding/collective test fails
+# later with a confusing "axis size mismatch" instead of the real cause
+# (a sitecustomize that initialized the backend before XLA_FLAGS took).
+if jax.device_count() < 8:
+    raise RuntimeError(
+        f"test harness needs 8 virtual CPU devices, got "
+        f"{jax.device_count()} — the backend was initialized before "
+        "XLA_FLAGS=--xla_force_host_platform_device_count=8 could apply "
+        "(and this jax has no jax_num_cpu_devices option)")
 
 import numpy as np
 import pytest

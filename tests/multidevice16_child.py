@@ -153,7 +153,12 @@ def main():
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 16)
+    try:
+        jax.config.update("jax_num_cpu_devices", 16)
+    except AttributeError:  # jax 0.4.x: env route, pre-backend-init
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=16").strip()
     assert jax.device_count() == 16, jax.device_count()
 
     if scenario in ("fsdp4", "model4"):

@@ -45,7 +45,12 @@ def main() -> None:
     # Before any backend use: 2 local CPU devices per process, gloo
     # cross-process collectives (the CPU stand-in for ICI/DCN).
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 2)
+    try:
+        jax.config.update("jax_num_cpu_devices", 2)
+    except AttributeError:  # jax 0.4.x: env route, pre-backend-init
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=2").strip()
     if num_processes > 1:
         jax.config.update("jax_cpu_collectives_implementation", "gloo")
         jax.distributed.initialize(

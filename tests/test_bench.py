@@ -353,3 +353,35 @@ def test_parent_sweep_filters_and_survives_bad_children(
     assert len(rows) == len({(v[i][0], v[i][2], v[i][3])
                              for i in range(4, n)})
     assert not any(r.get("platform") for r in persisted["sweep"])
+
+
+def test_boundary_bench_emits_record_and_overlap_wins():
+    """`bench.py --boundary` (the CI-measurable overlap win): one JSON
+    line with both per-boundary stall numbers, and the overlapped
+    boundary strictly cheaper than the synchronous one. Sizes are
+    shrunk via the env knobs so this stays a plumbing-and-direction
+    test; the ≥5x magnitude claim is the bench's own default-size run."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PBT_BOUNDARY_BENCH_BOUNDARIES="2",
+               PBT_BOUNDARY_BENCH_STEPS="3",
+               PBT_BOUNDARY_BENCH_DIM="32")
+    p = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py"), "--boundary"],
+        capture_output=True, text=True, timeout=420, env=env, cwd=repo)
+    assert p.returncode == 0, p.stderr[-2000:]
+    record = json.loads(p.stdout.strip().splitlines()[-1])
+    assert record["metric"] == "ckpt_boundary_stall_s"
+    assert record["platform"] == "cpu"
+    assert record["boundaries"] == 2
+    assert record["overlapped_stall_s_per_boundary"] > 0
+    assert record["sync_stall_s_per_boundary"] > \
+        record["overlapped_stall_s_per_boundary"]
+    assert record["stall_reduction_x"] > 1
+    # The hidden work really ran (fetch+write seconds were recorded).
+    assert record["overlap_hidden_s_per_boundary"] > 0

@@ -118,6 +118,113 @@ def test_wall_mode_declared_monotonic_seam(tmp_path):
     assert [g["step"] for g in out2["gaps"]] == [625]
 
 
+def test_wall_mode_drops_duplicate_step_records(tmp_path):
+    """An adjacent record with an EQUAL step (flush retry, double
+    writer) is a duplicate to drop — not a re-log reset: the old
+    behavior fabricated a zero-duration seam there and split real
+    intervals across it."""
+    path = tmp_path / "m.jsonl"
+    t, lines = 0.0, []
+    for s in range(25, 501, 25):
+        t += 2.5
+        lines.append(json.dumps({
+            "step": s, "loss": 1.0, "lr": 1e-4, "t": t}))
+        if s == 250:  # the duplicated flush
+            lines.append(json.dumps({
+                "step": s, "loss": 1.0, "lr": 1e-4, "t": t}))
+    path.write_text("\n".join(lines))
+    out = _run([str(path), "--wall"])
+    assert out["seams"] == []          # no fabricated seam
+    assert out["intervals"] == 19      # stream uncut
+    assert out["gaps"] == []
+    assert out["total_wall_s"] == pytest.approx(19 * 2.5, abs=0.1)
+
+
+def test_wall_mode_honors_seam_alongside_unrelated_relog_reset(tmp_path):
+    """A stream can hold BOTH resume shapes: an early re-log reset and
+    a later monotonic preemption. The declared --seam must be honored
+    when it does not fall inside the detected between-segment span —
+    the old blanket suppression misreported the monotonic restart as a
+    (boundary-adjacent!) gap."""
+    path = tmp_path / "m.jsonl"
+    t, lines = 0.0, []
+
+    def rec(s):
+        lines.append(json.dumps({
+            "step": s, "loss": 1.0, "lr": 1e-4, "t": t}))
+
+    for s in range(25, 301, 25):   # phase 1: killed after 300
+        t += 2.5
+        rec(s)
+    t += 80.0                      # restart; restored from ckpt at 250
+    for s in range(275, 601, 25):  # phase 2 re-logs 275 onward
+        t += 2.5
+        rec(s)
+    t += 100.0                     # monotonic preemption right after 600
+    for s in range(625, 801, 25):  # phase 3 strictly advances — no reset
+        t += 2.5
+        rec(s)
+    path.write_text("\n".join(lines))
+    out = _run([str(path), "--wall", "--seam", "600"])
+    # Both restarts under seams, neither in gaps.
+    assert [sm["after_step"] for sm in out["seams"]] == [300, 600]
+    assert out["seams"][1]["dt_s"] == pytest.approx(102.5, abs=0.1)
+    assert out["gaps"] == []
+    # A seam declared INSIDE the detected span is still suppressed.
+    out2 = _run([str(path), "--wall", "--seam", "300"])
+    assert [sm["after_step"] for sm in out2["seams"]] == [300]
+    # ... and the undeclared monotonic restart now shows up as a gap —
+    # the failure mode the honored --seam above exists to prevent.
+    assert [g["step"] for g in out2["gaps"]] == [625]
+
+
+def test_step_less_records_are_skipped_not_fatal(tmp_path):
+    """Records without a step (aggregate writer lines) must be filtered
+    in both modes, not raise KeyError."""
+    path = tmp_path / "m.jsonl"
+    t, lines = 0.0, []
+    for s in range(25, 301, 25):
+        t += 2.5
+        lines.append(json.dumps({
+            "step": s, "loss": 1.0, "lr": 1e-4, "t": t,
+            "steps_per_sec": 10.0}))
+        if s == 100:  # a step-less summary line mid-stream
+            lines.append(json.dumps({
+                "loss": 1.0, "lr": 1e-4, "t": t, "steps_per_sec": 10.0}))
+    path.write_text("\n".join(lines))
+    out = _run([str(path), "--wall"])
+    assert out["intervals"] == 11
+    out2 = _run([str(path), "--log-every", "25"])
+    assert out2["windows"] == 11
+
+
+def test_wall_mode_attributes_overlapped_boundaries(tmp_path):
+    """Overlapped checkpoint boundaries: the hidden fetch+write seconds
+    arrive as window_overlap_s on the log records; --wall must total
+    them (overlapped_boundary_s) while reporting NO gap at those
+    boundaries — and a gap that still carries overlap seconds keeps
+    them as its attribution column."""
+    path = tmp_path / "m.jsonl"
+    t, lines = 0.0, []
+    for s in range(25, 501, 25):
+        t += 2.5
+        if s == 400:
+            t += 20.0  # one genuinely slow window, overlap in flight
+        lines.append(json.dumps({
+            "step": s, "loss": 1.0, "lr": 1e-4, "t": t,
+            # boundaries at 100/200/300/400: each hid 8 s of save work
+            "window_overlap_s": 8.0 if s % 100 == 0 and s <= 400 else 0.0,
+            "ckpt_in_flight": 1.0 if s % 100 == 0 else 0.0}))
+    path.write_text("\n".join(lines))
+    out = _run([str(path), "--wall", "--cadence", "100",
+                "--log-every", "25"])
+    assert out["overlapped_boundary_s"] == pytest.approx(32.0, abs=0.1)
+    # The overlapped boundaries at 100/200/300 produced NO gaps.
+    assert [g["step"] for g in out["gaps"]] == [400]
+    assert out["gaps"][0]["overlap_s"] == pytest.approx(8.0, abs=0.1)
+    assert out["gaps"][0]["ckpt_in_flight"] is True
+
+
 def test_r3_collapse_attribution_is_stable():
     """The recorded r3 stream's reconstruction: every one of the nine
     in-run eval+ckpt boundaries produced a slow following window, and

@@ -787,6 +787,202 @@ def test_eval_keyed_plateau_end_to_end_cut():
     assert lrs[-1] < lrs[0]  # the cut is visible in the logged LR
 
 
+# ------------------------------------------- overlapped checkpoint boundaries
+
+class _SlowStager(Checkpointer):
+    """Checkpointer whose staged device→host fetch takes `delay` seconds
+    — makes 'a snapshot is in flight while training advances' a
+    certainty instead of a race, so the overlap invariants (no torn
+    snapshot, flush-before-exit, backpressure) are actually exercised."""
+
+    def __init__(self, *a, delay=0.0, **kw):
+        super().__init__(*a, **kw)
+        self.delay = delay
+        self.fetch_done_at = []
+
+    def _stage_fetch(self, snapshot):
+        import time
+
+        time.sleep(self.delay)
+        out = super()._stage_fetch(snapshot)
+        self.fetch_done_at.append(time.perf_counter())
+        return out
+
+
+def _interrupting_factory(cfg, at_batch, fired):
+    """Batch-iterator factory that SIGTERMs the process while producing
+    batch `at_batch` of a fresh (skip=0) stream — the in-process stand-in
+    for a preemption landing mid-run."""
+    import signal
+    import time
+
+    def factory(skip):
+        it = make_iter(cfg, seed=0)
+        for _ in range(skip):
+            next(it)
+
+        def gen():
+            for i, b in enumerate(it):
+                if skip == 0 and i == at_batch:
+                    fired["t"] = time.perf_counter()
+                    signal.raise_signal(signal.SIGTERM)
+                yield b
+
+        return gen()
+
+    return factory
+
+
+def test_overlapped_ckpt_interrupt_mid_overlap_resumes_byte_identical(tmp_path):
+    """Kill the run while a staged snapshot is STILL IN FLIGHT: the
+    preemption path must flush the stage to disk before exiting, and the
+    resumed run must be byte-identical (losses, eval stream, final
+    state) to an uninterrupted one — RNG, data position, and eval-stream
+    state all survive the overlapped boundary."""
+    import dataclasses
+
+    cfg = smoke_cfg(max_steps=30)
+    cfg = cfg.replace(
+        train=dataclasses.replace(cfg.train, log_every=1, eval_every=5),
+        checkpoint=CheckpointConfig(every_steps=10, async_save=True))
+    eval_rng = np.random.default_rng(9)
+    eval_seqs, eval_ann = make_random_proteins(
+        16, eval_rng, num_annotations=cfg.model.num_annotations, max_len=40)
+    eval_ds = InMemoryPretrainingDataset(eval_seqs, eval_ann,
+                                         cfg.data.seq_len)
+    evb = lambda: make_pretrain_iterator(  # noqa: E731
+        eval_ds, cfg.data.batch_size, shuffle=False, num_epochs=1)
+
+    full = pretrain(cfg, make_iter(cfg), eval_batches=evb)
+
+    fired = {}
+    ck = _SlowStager(str(tmp_path / "ck"), delay=1.0, async_save=True)
+    out1 = pretrain(cfg, _interrupting_factory(cfg, 14, fired),
+                    checkpointer=ck, eval_batches=evb)
+    assert out1["preempted"]
+    kill_step = int(out1["state"].step)
+    assert 10 < kill_step < 20  # landed while the step-10 stage ran
+    # The stage WAS in flight at the interrupt (fetch completed after
+    # the signal fired) and still landed on disk before exit.
+    assert ck.fetch_done_at and fired["t"] < ck.fetch_done_at[0]
+    assert 10 in ck.all_steps() and kill_step in ck.all_steps()
+    ck.close()
+
+    ck2 = Checkpointer(str(tmp_path / "ck"), async_save=True)
+    resumed = pretrain(cfg, lambda skip: _skip(make_iter(cfg), skip),
+                       checkpointer=ck2, eval_batches=evb)
+    ck2.close()
+    assert int(resumed["state"].step) == 30
+    # Bit-equal final state: params, Adam moments, RNG key, step.
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        resumed["state"], full["state"])
+    # Bit-equal post-kill history: train losses AND eval records.
+    def tail(hist, key):
+        return {h["step"]: h[key] for h in hist
+                if key in h and h["step"] > kill_step}
+    for key in ("loss", "eval_loss"):
+        want, got = tail(full["history"], key), tail(resumed["history"], key)
+        assert set(got) == set(want) and want, key
+        for s, v in want.items():
+            assert got[s] == v, f"{key}@{s}: resumed {got[s]} != full {v}"
+
+
+def test_staged_save_observes_boundary_state_not_torn(tmp_path):
+    """The staged snapshot must capture the BOUNDARY step's state even
+    though training advances (and donates the live buffers) while the
+    device→host fetch sleeps: the overlapped run's step-10 checkpoint
+    is bit-equal to a synchronous run's step-10 checkpoint."""
+    import dataclasses
+
+    cfg = smoke_cfg(max_steps=20)
+    cfg_over = cfg.replace(
+        train=dataclasses.replace(cfg.train, log_every=0),
+        checkpoint=CheckpointConfig(every_steps=10, async_save=True))
+    cfg_sync = cfg_over.replace(
+        train=dataclasses.replace(cfg_over.train, max_steps=10),
+        checkpoint=CheckpointConfig(every_steps=10, async_save=False,
+                                    overlap=False))
+
+    ck_a = _SlowStager(str(tmp_path / "over"), delay=0.5, async_save=True)
+    out = pretrain(cfg_over, make_iter(cfg_over), checkpointer=ck_a)
+    assert 10 in ck_a.all_steps()
+    # The hidden fetch+write seconds are REPORTED, not bookkept away.
+    assert out["perf"].get("overlap_s", 0.0) > 0.0
+    ck_a.close()
+
+    ck_b = Checkpointer(str(tmp_path / "sync"), async_save=False)
+    pretrain(cfg_sync, make_iter(cfg_sync), checkpointer=ck_b)
+    ck_b.close()
+
+    template = create_train_state(jax.random.PRNGKey(cfg.train.seed), cfg)
+    ck_a2 = Checkpointer(str(tmp_path / "over"))
+    st_over, ds_over = ck_a2.restore(template, step=10)
+    ck_a2.close()
+    ck_b2 = Checkpointer(str(tmp_path / "sync"))
+    st_sync, ds_sync = ck_b2.restore(template, step=10)
+    ck_b2.close()
+    assert ds_over["batches_consumed"] == ds_sync["batches_consumed"] == 10
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        st_over, st_sync)
+
+
+def test_staged_save_error_propagates(tmp_path):
+    """A stager failure (disk full, serialization bug) must surface in
+    the train loop — at the next boundary/flush — never be swallowed."""
+    import dataclasses
+
+    class _BrokenStager(Checkpointer):
+        def _stage_fetch(self, snapshot):
+            raise RuntimeError("staged fetch exploded")
+
+    cfg = smoke_cfg(max_steps=12)
+    cfg = cfg.replace(
+        train=dataclasses.replace(cfg.train, log_every=0),
+        checkpoint=CheckpointConfig(every_steps=5, async_save=True))
+    ck = _BrokenStager(str(tmp_path / "ck"), async_save=True)
+    with pytest.raises(RuntimeError, match="staged fetch exploded"):
+        pretrain(cfg, make_iter(cfg), checkpointer=ck)
+    ck._staged = None  # the failure is consumed; close() must not re-raise
+    ck.close()
+
+
+def test_step_timer_overlap_accounting(monkeypatch):
+    """overlap() records hidden boundary seconds WITHOUT moving the
+    timing anchors (the wall clock never stopped for them): rates are
+    unchanged, summary() reports cumulative overlap_s and a per-window
+    window_overlap_s that resets each summary."""
+    from proteinbert_tpu.train.metrics import StepTimer
+
+    advance = _fake_clock(monkeypatch)
+
+    def step(t):
+        advance(0.01)
+        t.update()
+
+    timer = StepTimer(smoke_cfg().model, batch=8, seq_len=32)
+    for _ in range(4):  # 2 warmup + 2 timed
+        step(timer)
+    timer.overlap(0.7)  # a staged save that ran hidden
+    first = timer.summary()
+    assert first["step_ms"] == pytest.approx(10.0)  # anchors untouched
+    assert first["overlap_s"] == pytest.approx(0.7)
+    assert first["window_overlap_s"] == pytest.approx(0.7)
+    step(timer), step(timer)
+    second = timer.summary()
+    assert second["overlap_s"] == pytest.approx(0.7)  # cumulative
+    assert second["window_overlap_s"] == 0.0          # window reset
+    assert second["window_step_ms"] == pytest.approx(10.0)
+    # Before any overlap is recorded the keys are absent (records from
+    # pre-overlap runs stay byte-compatible with round-4/5 streams).
+    fresh = StepTimer(smoke_cfg().model, batch=8, seq_len=32)
+    step(fresh), step(fresh), step(fresh)
+    assert "overlap_s" not in fresh.summary()
+
+
 # ------------------------------------------------- GO ranking eval metrics
 
 def _brute_force_auroc(scores, labels, valid):

@@ -30,6 +30,15 @@ ckpt_in_flight — the full wall-time attribution the discounted stream
 cannot give. Preemption seams are reported separately from gaps:
 re-log seams auto-detected from the file-order step reset, monotonic
 seams (preemption save at the kill step itself) declared via --seam.
+A --seam that coincides with a detected re-log reset is suppressed
+(same preemption, already under `seams`); one elsewhere in the stream
+is honored even when an unrelated reset exists.
+
+Overlapped boundaries (checkpoint fetch+write hidden behind training,
+StepTimer.overlap) appear in the records as `window_overlap_s`; --wall
+sums them into `overlapped_boundary_s` and stamps any gap that still
+carries overlap seconds — so "boundary cost went to ~zero" is read off
+the attribution (no gap + nonzero overlapped seconds), not assumed.
 
 Usage:
   python tools/reconstruct_windows.py METRICS_JSONL \
@@ -52,7 +61,11 @@ def load_train_records(path):
                 r = json.loads(line)
             except ValueError:
                 continue
-            if "loss" in r and "lr" in r and r.get("steps_per_sec"):
+            # "step" in the filter too: a step-less record (a writer
+            # that logs aggregate lines without one) must be skipped,
+            # not KeyError the whole reconstruction.
+            if ("step" in r and "loss" in r and "lr" in r
+                    and r.get("steps_per_sec")):
                 ded[r["step"]] = r  # keep LAST record per step (seam re-log)
     return ded
 
@@ -156,23 +169,37 @@ def wall_gaps(path, cadence=None, log_every=None, gap_thresh=10.0,
                 r = json.loads(line)
             except ValueError:
                 continue
-            if "loss" in r and "lr" in r and r.get("t") is not None:
+            # "step" in the filter alongside loss/lr/t: a step-less
+            # record must be skipped, not KeyError the segment split.
+            if ("step" in r and "loss" in r and "lr" in r
+                    and r.get("t") is not None):
                 recs.append(r)
     if len(recs) < 3:
         return {"error": f"too few t-stamped records in {path}"}
     segments, cur = [], [recs[0]]
     for r in recs[1:]:
-        if r["step"] <= cur[-1]["step"]:
+        if r["step"] == cur[-1]["step"]:
+            # A duplicated log line (flush retry, double writer) is a
+            # record to DROP, not a reset: starting a new segment here
+            # would fabricate a zero-duration seam and split real
+            # intervals. Only a strict step DECREASE is a re-log reset.
+            continue
+        if r["step"] < cur[-1]["step"]:
             segments.append(cur)
             cur = [r]
         else:
             cur.append(r)
     segments.append(cur)
-    # An explicit seam only applies to a reset-free stream: with a
-    # detected re-log reset the restart is already under `seams`, and
-    # the RESUMED segment re-crosses the kill step as a normal
-    # interval that must not be re-classified.
-    if len(segments) > 1:
+    # An explicit seam is suppressed only when it falls INSIDE a
+    # detected between-segment span — i.e. it declares the same
+    # preemption the re-log reset already reports (the resumed segment
+    # re-crosses the kill step as a normal interval that must not be
+    # re-classified). A monotonic preemption elsewhere in the stream
+    # keeps its declared seam even when an unrelated re-log reset was
+    # detected.
+    if seam is not None and any(
+            segments[i][0]["step"] <= seam <= segments[i - 1][-1]["step"]
+            for i in range(1, len(segments))):
         seam = None
     spans, seams = [], []
     for i, seg in enumerate(segments):
@@ -189,7 +216,16 @@ def wall_gaps(path, cadence=None, log_every=None, gap_thresh=10.0,
                 continue
             spans.append({"step": r1["step"], "dt_s": r1["t"] - r0["t"],
                           "ckpt_in_flight":
-                              bool(r1.get("ckpt_in_flight"))})
+                              bool(r1.get("ckpt_in_flight")),
+                          # Overlapped-boundary seconds recorded inside
+                          # this window (StepTimer.overlap): checkpoint
+                          # fetch+write that ran HIDDEN behind training.
+                          # An overlapped boundary should NOT produce a
+                          # gap — the overlap_s column is its wall-time
+                          # attribution (the stall a synchronous
+                          # boundary would have cost here instead).
+                          "overlap_s":
+                              float(r1.get("window_overlap_s") or 0.0)})
     if not spans:
         return {"error": f"no within-segment intervals in {path}"}
     med = _median([sp["dt_s"] for sp in spans])
@@ -197,15 +233,22 @@ def wall_gaps(path, cadence=None, log_every=None, gap_thresh=10.0,
     total = (sum(sp["dt_s"] for sp in spans)
              + sum(sm["dt_s"] for sm in seams))
     gap_excess = sum(sp["dt_s"] - med for sp in gaps)
+    overlapped = sum(sp["overlap_s"] for sp in spans)
     out = {
         "path": path, "intervals": len(spans),
         "median_interval_s": round(med, 2),
         "total_wall_s": round(total, 1),
         "gaps": [{"step": sp["step"], "dt_s": round(sp["dt_s"], 1),
-                  "ckpt_in_flight": sp["ckpt_in_flight"]}
+                  "ckpt_in_flight": sp["ckpt_in_flight"],
+                  **({"overlap_s": round(sp["overlap_s"], 1)}
+                     if sp["overlap_s"] else {})}
                  for sp in gaps],
         "gap_excess_s": round(gap_excess, 1),
         "gap_excess_frac": round(gap_excess / total, 3) if total else None,
+        # Boundary seconds the run HID behind compute (overlapped
+        # checkpoint pipeline) — wall time that does not appear in any
+        # gap precisely because it was overlapped.
+        "overlapped_boundary_s": round(overlapped, 1),
         "seams": seams,
     }
     if cadence and log_every:

@@ -94,6 +94,13 @@ def main():
                     help="forwarded to the CLI; defaults to cpu at "
                          "--scale mini (a dead TPU tunnel otherwise "
                          "hangs the subprocess at device init)")
+    ap.add_argument("--set", action="append", default=[], dest="extra_set",
+                    metavar="KEY=VAL",
+                    help="extra config overrides appended AFTER the "
+                         "built-in ones (later wins in the CLI) — e.g. "
+                         "the fault drills pin checkpoint.overlap=false "
+                         "so the stager thread's CPU contention cannot "
+                         "noise the window stream they assert on")
     args = ap.parse_args()
     platform = args.platform or ("cpu" if args.scale == "mini" else None)
     S = dict(SCALES[args.scale])
@@ -141,6 +148,8 @@ def main():
            # its window stream should show only the steady per-boundary
            # cost, ckpt_in_flight-latched.
            "--set", "checkpoint.warm_start=true"]
+    for kv in args.extra_set:
+        cmd += ["--set", kv]
 
     # ---- phase 1: run until kill_at, then SIGTERM (preemption drill)
     print("+ " + " ".join(cmd[2:]), file=sys.stderr, flush=True)
@@ -235,6 +244,11 @@ def main():
         "eval_losses": [(r["step"], r["eval_loss"]) for r in evals],
         "final_mfu": last.get("mfu"),
         "res_per_sec": last.get("residues_per_sec_per_chip"),
+        # Cumulative seconds of checkpoint fetch+write that ran HIDDEN
+        # behind training (StepTimer.overlap) — the boundary cost the
+        # overlapped pipeline removed from the wall clock; None on
+        # streams recorded before round 6.
+        "overlapped_boundary_s": last.get("overlap_s"),
         "windows": window_report,
         "lr_cuts_at": lr_cuts,
         "seam": {

@@ -190,7 +190,9 @@ def enable_compile_cache() -> None:
     sweep children keep it unconditionally."""
     import jax
 
-    if (not hasattr(jax.config, "jax_num_cpu_devices")
+    from proteinbert_tpu.utils.compat import has_num_cpu_devices_option
+
+    if (not has_num_cpu_devices_option()
             and os.environ.get("JAX_PLATFORMS", "") == "cpu"
             and not os.environ.get("PBT_DISABLE_DONATION")):
         print("persistent compile cache disabled (jax 0.4.x CPU: "
@@ -623,6 +625,123 @@ def run_boundary():
     print(json.dumps(record))
 
 
+def run_comm():
+    """`bench.py --comm`: per-step collective bytes + per-chip state
+    bytes, replicated vs ZeRO-1 zero-update, on a CPU-virtual mesh —
+    one JSON line, so the memory/comm win is a recorded artifact
+    (ISSUE 2 acceptance), CI-measurable without a TPU tunnel.
+
+    Three numbers per mode, all derived from the COMPILED per-device
+    program (not from claims): collective bytes by kind from the HLO
+    (parallel/zero.collective_bytes_from_hlo), per-chip persistent
+    params/opt-state bytes from the sharding rules
+    (zero.per_chip_state_bytes — identical for a virtual mesh and the
+    real pod shape), and the executable's memory analysis where the
+    backend reports one. Knobs: PBT_COMM_MESH="dataxfsdp" (default 4x2,
+    matching the 8-device test harness), PBT_COMM_DIM scales the model
+    (default 64; plumbing tests use smaller). Numbers are CPU-virtual:
+    byte counts are exact properties of the partitioned program, but
+    ratios on real ICI/DCN await a tunnel window (PARITY.md note)."""
+    import jax
+
+    from proteinbert_tpu.utils.compat import request_cpu_devices
+
+    mesh_spec = os.environ.get("PBT_COMM_MESH", "4x2")
+    data_n, fsdp_n = (int(x) for x in mesh_spec.lower().split("x"))
+    n_devices = data_n * fsdp_n
+    request_cpu_devices(n_devices)
+    force_cpu_backend()
+
+    import numpy as np
+
+    from proteinbert_tpu.configs import (
+        DataConfig, MeshConfig, ModelConfig, OptimizerConfig, ParallelConfig,
+        PretrainConfig, TrainConfig,
+    )
+    from proteinbert_tpu.parallel import batch_sharding, make_mesh
+    from proteinbert_tpu.parallel.sharding import state_sharding
+    from proteinbert_tpu.parallel.zero import (
+        collective_bytes_from_hlo, make_zero_train_step, per_chip_state_bytes,
+    )
+    from proteinbert_tpu.train import create_train_state
+    from proteinbert_tpu.train import train_state as ts
+
+    if jax.device_count() < n_devices:
+        raise SystemExit(
+            f"--comm needs {n_devices} virtual devices, have "
+            f"{jax.device_count()} (backend initialized too early?)")
+
+    dim = int(os.environ.get("PBT_COMM_DIM", 64))
+    mesh_cfg = MeshConfig(data=data_n, fsdp=fsdp_n)
+    model = ModelConfig(local_dim=dim, global_dim=2 * dim, key_dim=16,
+                        num_heads=4, num_blocks=2,
+                        num_annotations=max(8 * dim, 256), dtype="float32")
+    base_cfg = PretrainConfig(
+        model=model,
+        data=DataConfig(seq_len=128, batch_size=2 * n_devices),
+        optimizer=OptimizerConfig(warmup_steps=10),
+        mesh=mesh_cfg, train=TrainConfig(max_steps=1))
+    mesh = make_mesh(mesh_cfg, jax.devices()[:n_devices])
+    abstract = jax.eval_shape(
+        lambda: create_train_state(jax.random.PRNGKey(0), base_cfg))
+    bsh = batch_sharding(mesh)
+    batch_abs = {
+        "tokens": jax.ShapeDtypeStruct(
+            (base_cfg.data.batch_size, base_cfg.data.seq_len), np.int32,
+            sharding=bsh["tokens"]),
+        "annotations": jax.ShapeDtypeStruct(
+            (base_cfg.data.batch_size, model.num_annotations), np.float32,
+            sharding=bsh["annotations"]),
+    }
+
+    def analyze(mode):
+        zero = mode != "replicated"
+        cfg = base_cfg.replace(parallel=ParallelConfig(
+            zero_update=zero,
+            grad_reduce_dtype="bf16" if mode == "zero_bf16" else "fp32"))
+        sh = state_sharding(mesh, abstract, zero_update=zero)
+        st = jax.tree.map(
+            lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
+            abstract, sh)
+        if zero:
+            lowered = make_zero_train_step(mesh, cfg).lower(st, batch_abs)
+        else:
+            lowered = ts.train_step.lower(st, batch_abs, cfg)
+        compiled = lowered.compile()
+        row = {"mode": mode,
+               "collective_bytes": collective_bytes_from_hlo(
+                   compiled.as_text()),
+               "state_bytes_per_chip": per_chip_state_bytes(
+                   mesh, abstract, zero_update=zero)}
+        try:  # not every backend reports memory stats
+            ma = compiled.memory_analysis()
+            row["hbm"] = {
+                "argument_bytes": int(ma.argument_size_in_bytes),
+                "output_bytes": int(ma.output_size_in_bytes),
+                "temp_bytes": int(ma.temp_size_in_bytes),
+            }
+        except Exception:
+            row["hbm"] = None
+        return row
+
+    rows = [analyze(m) for m in ("replicated", "zero", "zero_bf16")]
+    rep, zero = rows[0], rows[1]
+    record = {
+        "metric": "zero_update_comm",
+        "platform": "cpu-virtual",
+        "mesh": {"data": data_n, "fsdp": fsdp_n},
+        "model_dim": dim,
+        "modes": rows,
+        "opt_state_bytes_reduction_x": round(
+            rep["state_bytes_per_chip"]["opt_state"]
+            / max(zero["state_bytes_per_chip"]["opt_state"], 1), 2),
+        "collective_bytes_ratio": round(
+            zero["collective_bytes"]["total"]
+            / max(rep["collective_bytes"]["total"], 1), 3),
+    }
+    print(json.dumps(record))
+
+
 def variant_matches(pat, variant):
     """--only matching: the bare name AND the 'name:seq/batch' shape
     key, so anchored name patterns ('u2st$') and row-targeted ones
@@ -657,10 +776,20 @@ def main():
                          "boundary (sync vs overlapped) on CPU and emit "
                          "one JSON line — the overlap win, CI-measurable "
                          "without a TPU")
+    ap.add_argument("--comm", action="store_true",
+                    help="compile the train step replicated vs ZeRO-1 "
+                         "zero-update on a CPU-virtual mesh and emit one "
+                         "JSON line of per-step collective bytes (from "
+                         "the HLO) and per-chip state bytes (from the "
+                         "sharding rules)")
     cli = ap.parse_args()
 
     if cli.boundary:
         run_boundary()
+        return
+
+    if cli.comm:
+        run_comm()
         return
 
     if cli.run_index is not None:

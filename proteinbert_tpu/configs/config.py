@@ -171,6 +171,43 @@ class MeshConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ParallelConfig:
+    """Cross-replica execution strategy knobs (beyond the mesh SHAPE,
+    which stays in MeshConfig).
+
+    zero_update: ZeRO-1 sharded weight update (Xu et al.,
+      arXiv:2004.13336). The pure `data` axis normally replicates fp32
+      params and Adam mu/nu on every replica and pays a full gradient
+      all-reduce per step; with zero_update the train step
+      reduce-scatters gradients over ('data','fsdp'), applies the
+      optimizer to a 1/(data*fsdp) shard, and all-gathers the updated
+      params — Adam state HBM drops by ~(1 - 1/data_extent) on top of
+      fsdp, for near-equal total collective bytes (reduce-scatter +
+      all-gather ≈ all-reduce). Sharded-optimizer storage lives in
+      parallel/sharding.py (zero-aware state_sharding); the update
+      itself in parallel/zero.py. No-op without a mesh or when
+      data*fsdp == 1.
+    grad_reduce_dtype: dtype the gradient tree is ROUNDED to at the
+      zero-update boundary — "fp32" (exact) or "bf16" (the numerics of
+      an EQuARX-style compressed reduction, arXiv:2506.17615: the
+      optimizer math runs fp32 on bf16-rounded gradients; the clip norm
+      is measured pre-rounding; measured bound in tests/test_zero.py).
+      IMPORTANT: under the implicit-SPMD step the cast is applied to
+      the ALREADY-REDUCED logical gradients — no compiler may hoist it
+      ahead of the fp32 reduction — so today this knob changes numerics
+      only, NOT wire bytes (bench.py --comm records identical
+      collective bytes; docs/distributed.md). True on-the-wire
+      compression needs the reduction to consume per-replica bf16
+      partials, i.e. grads computed inside the shard_map — a future
+      step once a pure-DP explicit path exists. Only consulted by the
+      zero_update path.
+    """
+
+    zero_update: bool = False
+    grad_reduce_dtype: str = "fp32"         # "fp32" | "bf16"
+
+
+@dataclasses.dataclass(frozen=True)
 class CheckpointConfig:
     """Checkpoint cadence (reference utils.py:227 nb_iterations_checkpoint=1000)."""
 
@@ -283,6 +320,7 @@ class PretrainConfig:
     data: DataConfig = dataclasses.field(default_factory=DataConfig)
     optimizer: OptimizerConfig = dataclasses.field(default_factory=OptimizerConfig)
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+    parallel: ParallelConfig = dataclasses.field(default_factory=ParallelConfig)
     checkpoint: CheckpointConfig = dataclasses.field(default_factory=CheckpointConfig)
     train: TrainConfig = dataclasses.field(default_factory=TrainConfig)
 

@@ -34,18 +34,28 @@ def load_seqs_and_annotations(
     records_limit: Optional[int] = None,
     verbose: bool = True,
     log_progress_every: int = 100_000,
+    stats: Optional[dict] = None,
 ) -> Iterator[Tuple[str, str, List[int]]]:
     """Yield (uniprot_id, sequence, completed_annotation_indices) by
     joining SQLite records to FASTA via the `UniRef90_<accession>` key
     (reference uniref_dataset.py:274-320). Deterministic shuffle keeps
     the reference's reproducible-ordering property (its seed-0 sample at
     uniref_dataset.py:294) without materializing a DataFrame.
+
+    `stats`: optional dict the generator fills as it runs —
+    {'n_yielded', 'n_unjoinable'} — so callers (and hostile-input
+    tests) can assert how many annotation records had no FASTA
+    sequence instead of trusting a log line. Unjoinable ids are
+    counted and skipped, never a crash.
     """
     # Stream in O(fetch_chunk) row memory: materialize only the int64 key
     # column (8 bytes/row — fine even at UniRef90's ~10^8 rows), shuffle
     # the keys, then batch-fetch rows by key chunk. A fetchall of the
     # string columns here would hold tens of GB of Python objects.
     fetch_chunk = 10_000
+    if stats is None:
+        stats = {}
+    stats.update(n_yielded=0, n_unjoinable=0)
     conn = sqlite3.connect(sqlite_path)
     try:
         keys = np.fromiter(
@@ -79,7 +89,9 @@ def load_seqs_and_annotations(
                     fasta_id = f"UniRef90_{uniprot_name.split('_')[0]}"
                     if fasta_id not in fasta:
                         n_failed += 1
+                        stats["n_unjoinable"] = n_failed
                         continue
+                    stats["n_yielded"] += 1
                     yield uniprot_name, fasta.fetch(fasta_id), json.loads(raw_indices)
     finally:
         conn.close()

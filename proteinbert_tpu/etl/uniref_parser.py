@@ -112,6 +112,16 @@ class UnirefToSqliteParser:
         # Aggregates (reference uniref_dataset.py:43-45).
         self.go_record_counts: Counter = Counter()   # go_id → #records (completed)
         self.unrecognized_go: Counter = Counter()
+        # Hostile-input accounting: UniRef dumps in the wild contain
+        # malformed entries (missing representativeMember/dbReference/
+        # taxonomy) and occasionally arrive truncated (an interrupted
+        # download cuts the gzip stream mid-member). A corpus-scale ETL
+        # must COUNT and continue, never crash hours in — the reference
+        # crashes on the first malformed entry (AttributeError off
+        # find()) and on any truncated archive.
+        self.skipped_entries: Counter = Counter()    # reason → count
+        self.unrecognized_go_categories: Counter = Counter()
+        self.stream_error: Optional[str] = None      # truncation/parse fault
         self.n_records_with_any_go = 0
         self.n_entries = 0
 
@@ -121,12 +131,32 @@ class UnirefToSqliteParser:
         conn.executescript(_AGG_SCHEMA)
         buf: List[tuple] = []
         try:
-            for i, entry in self._iter_entries():
+            entries = self._iter_entries()
+            while True:
+                try:
+                    i, entry = next(entries)
+                except StopIteration:
+                    break
+                except (EOFError, OSError, ElementTree.ParseError) as e:
+                    # Truncated gzip member (EOFError), corrupt archive
+                    # (BadGzipFile is an OSError), or XML cut mid-entry
+                    # (ParseError): keep every row parsed so far, record
+                    # the fault loudly, and finish cleanly — the partial
+                    # DB plus the fault stat is recoverable state, a
+                    # traceback after hours of streaming is not.
+                    self.stream_error = f"{type(e).__name__}: {e}"
+                    log(f"uniref parse: input stream ended abnormally "
+                        f"after {self.n_entries} entries ({self.stream_error}"
+                        "); keeping rows parsed so far")
+                    break
                 if self.verbose and i and i % self.log_progress_every == 0:
                     log(f"uniref parse: {i} entries")
                 if i % self.num_shards != self.shard_index:
                     continue
-                buf.append(self._process_entry(i, entry))
+                row = self._process_entry(i, entry)
+                if row is None:
+                    continue
+                buf.append(row)
                 if len(buf) >= self.chunk_size:
                     self._flush(conn, buf)
                     buf = []
@@ -140,6 +170,12 @@ class UnirefToSqliteParser:
                 log(f"ignored unrecognized GO ids: "
                     f"{dict(self.unrecognized_go.most_common(20))} "
                     f"({len(self.unrecognized_go)} distinct)")
+            if self.unrecognized_go_categories:
+                log(f"ignored unknown GO categories: "
+                    f"{dict(self.unrecognized_go_categories)}")
+            if self.skipped_entries:
+                log(f"skipped malformed entries: "
+                    f"{dict(self.skipped_entries)}")
             log(f"parsed {self.n_entries} entries in shard "
                 f"{self.shard_index}/{self.num_shards}; "
                 f"{self.n_records_with_any_go} with any completed GO annotation")
@@ -164,11 +200,22 @@ class UnirefToSqliteParser:
                     if self.max_entries is not None and i >= self.max_entries:
                         break
 
-    def _process_entry(self, i: int, entry) -> tuple:
+    def _process_entry(self, i: int, entry) -> Optional[tuple]:
+        """One <entry> → row tuple, or None (counted in skipped_entries)
+        for entries missing the pieces the schema cannot do without."""
         self.n_entries += 1
         repr_member = entry.find(_NS + "representativeMember")
+        if repr_member is None:
+            self.skipped_entries["no_representative_member"] += 1
+            return None
         db_ref = repr_member.find(_NS + "dbReference")
+        if db_ref is None:
+            self.skipped_entries["no_db_reference"] += 1
+            return None
         uniprot_name = db_ref.get("id")
+        if not uniprot_name:
+            self.skipped_entries["no_uniprot_id"] += 1
+            return None
 
         tax_id = None
         go: Dict[str, List[str]] = {c: [] for c in GO_ANNOTATION_CATEGORIES}
@@ -180,7 +227,17 @@ class UnirefToSqliteParser:
                 except (TypeError, ValueError):
                     tax_id = None
             elif ptype in go:
-                go[ptype].append(prop.get("value"))
+                value = prop.get("value")
+                if value:
+                    go[ptype].append(value)
+            elif ptype and ptype.startswith("GO "):
+                # A GO-looking category this schema doesn't know (a new
+                # UniProt export aspect, or a typo'd dump): counted, not
+                # silently folded into the known three and not a crash.
+                self.unrecognized_go_categories[ptype] += 1
+        if tax_id is None:
+            self.skipped_entries["no_tax_id"] += 1
+            return None
         go = {c: sorted(set(v)) for c, v in go.items()}
 
         flat = sorted(set().union(*go.values()))
@@ -209,11 +266,13 @@ class UnirefToSqliteParser:
                 "INSERT OR REPLACE INTO go_record_counts VALUES (?,?)",
                 list(self.go_record_counts.items()),
             )
+            stats = [("n_records_with_any_go", self.n_records_with_any_go),
+                     ("n_entries", self.n_entries)]
+            stats += [(f"skipped_{reason}", count)
+                      for reason, count in self.skipped_entries.items()]
+            stats += [("n_stream_errors", 1 if self.stream_error else 0)]
             conn.executemany(
-                "INSERT OR REPLACE INTO etl_stats VALUES (?,?)",
-                [("n_records_with_any_go", self.n_records_with_any_go),
-                 ("n_entries", self.n_entries)],
-            )
+                "INSERT OR REPLACE INTO etl_stats VALUES (?,?)", stats)
 
 
 def read_aggregates(sqlite_path: str):

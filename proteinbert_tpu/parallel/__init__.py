@@ -9,6 +9,9 @@ from proteinbert_tpu.parallel.multihost import maybe_initialize_distributed
 from proteinbert_tpu.parallel.seq_parallel import (
     make_seq_parallel_train_step, seq_parallel_apply, sharded_global_attention,
 )
+from proteinbert_tpu.parallel.zero import (
+    make_zero_train_step, zero_extent, zero_gradient_update,
+)
 
 __all__ = [
     "make_mesh", "mesh_for_devices",
@@ -16,4 +19,5 @@ __all__ = [
     "halo_exchange", "conv1d_halo", "seq_parallel_conv1d",
     "make_seq_parallel_train_step", "seq_parallel_apply",
     "sharded_global_attention", "maybe_initialize_distributed",
+    "make_zero_train_step", "zero_extent", "zero_gradient_update",
 ]

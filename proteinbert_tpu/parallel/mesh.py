@@ -30,21 +30,10 @@ from jax.sharding import Mesh
 from proteinbert_tpu.configs import MeshConfig
 
 
-def shard_map(f, mesh, in_specs, out_specs, check_vma=None):
-    """Version-compat shard_map (same class of fix as the test
-    harness's jax_num_cpu_devices fallback): top-level `jax.shard_map`
-    with the `check_vma` kwarg on jax >= 0.6; on jax 0.4.x the function
-    lives in jax.experimental.shard_map and the varying-mesh-axes
-    checker flag is spelled `check_rep`. `check_vma=None` means "the
-    version's default"."""
-    try:
-        sm = jax.shard_map
-        kw = {} if check_vma is None else {"check_vma": check_vma}
-    except AttributeError:
-        from jax.experimental.shard_map import shard_map as sm
-
-        kw = {} if check_vma is None else {"check_rep": check_vma}
-    return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
+# Version-compat shard_map — moved to utils/compat.py (one home for the
+# jax 0.4.x shims, alongside request_cpu_devices); re-exported here for
+# the existing importers (seq_parallel, halo, tests).
+from proteinbert_tpu.utils.compat import shard_map  # noqa: F401
 
 
 def make_mesh(

@@ -51,6 +51,7 @@ from proteinbert_tpu.ops.layers import (
     dense_apply, embedding_apply, layer_norm_apply,
 )
 from proteinbert_tpu.parallel.halo import halo_exchange
+from proteinbert_tpu.parallel.zero import zero_extent
 
 Params = Dict[str, Any]
 
@@ -240,12 +241,26 @@ def make_seq_parallel_train_step(mesh: Mesh, cfg: PretrainConfig):
             return pretrain_loss(local_logits, global_logits, Y, W)
 
         grads, metrics = jax.grad(loss_fn, has_aux=True)(state.params)
-        params, opt_state = ts.gradient_update(
-            make_optimizer(cfg.optimizer), state.params, grads,
-            state.opt_state, metrics["loss"], needs_loss_value(cfg.optimizer),
-        )
+        if cfg.parallel.zero_update and zero_extent(mesh) > 1:
+            # ZeRO-1 weight update (parallel/zero.py): same shared
+            # optimizer-apply, run on 1/(data*fsdp) shards between a
+            # gradient reduce-scatter and a param all-gather.
+            from proteinbert_tpu.parallel.zero import zero_gradient_update
+
+            params, opt_state, grad_norm = zero_gradient_update(
+                mesh, cfg.optimizer, state.params, grads, state.opt_state,
+                metrics["loss"],
+                grad_reduce_dtype=cfg.parallel.grad_reduce_dtype,
+            )
+        else:
+            params, opt_state = ts.gradient_update(
+                make_optimizer(cfg.optimizer), state.params, grads,
+                state.opt_state, metrics["loss"],
+                needs_loss_value(cfg.optimizer),
+            )
+            grad_norm = optax.global_norm(grads)
         metrics = dict(metrics)
-        metrics["grad_norm"] = optax.global_norm(grads)
+        metrics["grad_norm"] = grad_norm
         from proteinbert_tpu.train.schedule import effective_lr
 
         metrics["lr"] = effective_lr(cfg.optimizer, opt_state, state.step)

@@ -17,7 +17,10 @@ framework lays out the ProteinBERT train state and input batches over the
   vectors replicated.
 - optimizer state: Adam's mu/nu mirror the params tree structure, so the
   same path-driven rule applies (their tree paths contain the param
-  paths).
+  paths). Under `parallel.zero_update` (ZeRO-1, parallel/zero.py) they
+  additionally carry the joint ('data','fsdp') replica axis
+  (zero_update_spec below) so each replica persists only a
+  1/(data*fsdp) slice of the Adam moments.
 
 All rules are resolved from an ABSTRACT pytree (jax.eval_shape) so no
 memory is allocated before shardings are known.
@@ -96,17 +99,99 @@ def _leaf_spec(path, leaf, mesh: Mesh) -> P:
     return P()
 
 
-def state_sharding(mesh: Mesh, abstract_state: Any) -> Any:
-    """NamedSharding pytree matching `abstract_state` (from jax.eval_shape)."""
+def param_spec(path, leaf, mesh: Mesh) -> P:
+    """Public storage spec for one leaf (scalar-safe `_leaf_spec`) — the
+    layout params keep BETWEEN steps, zero-update or not (the ZeRO-1
+    path all-gathers updated params back to this spec every step)."""
+    if not hasattr(leaf, "shape") or len(getattr(leaf, "shape", ())) == 0:
+        return P()
+    return _leaf_spec(path, leaf, mesh)
+
+
+def zero_update_spec(path, leaf, mesh: Mesh) -> P:
+    """ZeRO-1 spec for one leaf: the storage spec EXTENDED with the
+    joint ('data','fsdp') replica axis (arXiv:2004.13336's cross-replica
+    weight-update sharding, resolved per-leaf from the abstract tree).
+
+    Used for two things that must agree element-for-element: the
+    persistent sharding of Adam mu/nu (state_sharding with
+    zero_update=True — the HBM win), and the in/out specs of
+    parallel/zero.py's update shard_map (params/grads enter sliced the
+    same way, so the update math on each shard lines up).
+
+    Placement, in preference order: (1) widen an existing 'fsdp' axis to
+    ('data','fsdp') — data-slicing an already-fsdp-sharded axis further
+    is free at the shard_map boundary; (2) the largest spec-free axis
+    divisible by data*fsdp; (3) the largest spec-free axis divisible by
+    the data extent alone ('data' only, keeping any fsdp placement);
+    (4) give up — the leaf stays at its storage spec and the update runs
+    replicated across data (identical math on every replica; only small
+    leaves land here, so the memory claim is unaffected)."""
+    base = param_spec(path, leaf, mesh)
+    shape = getattr(leaf, "shape", ())
+    if len(shape) == 0:
+        return base
+    data_n = mesh.shape.get("data", 1)
+    fsdp_n = mesh.shape.get("fsdp", 1)
+    joint = data_n * fsdp_n
+    if joint == 1:
+        return base
+    entries = list(base) + [None] * (len(shape) - len(base))
+    for i, e in enumerate(entries):
+        if e == "fsdp" and shape[i] % joint == 0:
+            entries[i] = ("data", "fsdp")
+            return P(*entries)
+    has_fsdp = any(e == "fsdp" for e in entries)
+    by_size = sorted(range(len(shape)), key=lambda i: shape[i], reverse=True)
+    if not has_fsdp:
+        for ax in by_size:
+            if entries[ax] is None and shape[ax] % joint == 0:
+                entries[ax] = ("data", "fsdp")
+                return P(*entries)
+    if data_n > 1:
+        # 'fsdp' stays where the storage rule put it (a mesh axis can
+        # appear in a spec only once); 'data' gets its own axis.
+        for ax in by_size:
+            if entries[ax] is None and shape[ax] % data_n == 0:
+                entries[ax] = "data"
+                return P(*entries)
+    return base
+
+
+def _is_opt_state_path(path) -> bool:
+    if not path:
+        return False
+    p = path[0]
+    key = getattr(p, "key", None)
+    if key is None:
+        key = getattr(p, "name", None)
+    return key == "opt_state"
+
+
+def state_sharding(mesh: Mesh, abstract_state: Any,
+                   zero_update: bool = False) -> Any:
+    """NamedSharding pytree matching `abstract_state` (from jax.eval_shape).
+
+    zero_update=True applies the ZeRO-1 rule to OPTIMIZER-STATE leaves:
+    Adam's mu/nu additionally carry the joint ('data','fsdp') axis
+    (zero_update_spec), so each replica persists only a 1/(data*fsdp)
+    slice of the Adam moments instead of a full fsdp-sharded copy.
+    Params keep their ordinary storage spec either way — the zero step
+    all-gathers them fresh every update, so their layout between steps
+    is unchanged (and checkpoints stay shape-identical across modes)."""
     def rule(path, leaf):
         if not hasattr(leaf, "shape") or len(getattr(leaf, "shape", ())) == 0:
             return NamedSharding(mesh, P())
+        if zero_update and _is_opt_state_path(path):
+            return NamedSharding(mesh, zero_update_spec(path, leaf, mesh))
         return NamedSharding(mesh, _leaf_spec(path, leaf, mesh))
 
     return jax.tree_util.tree_map_with_path(rule, abstract_state)
 
 
-def shard_train_state(state: Any, mesh: Mesh) -> Any:
+def shard_train_state(state: Any, mesh: Mesh,
+                      zero_update: bool = False) -> Any:
     """Place a concrete TrainState onto the mesh per `state_sharding`."""
-    shardings = state_sharding(mesh, jax.eval_shape(lambda: state))
+    shardings = state_sharding(mesh, jax.eval_shape(lambda: state),
+                               zero_update=zero_update)
     return jax.device_put(state, shardings)

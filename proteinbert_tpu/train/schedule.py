@@ -26,6 +26,8 @@ utils.py:136) and Adam(b1,b2) (reference dummy_tests.py:127-130).
 
 from __future__ import annotations
 
+import jax
+import jax.numpy as jnp
 import optax
 
 from proteinbert_tpu.configs import OptimizerConfig
@@ -48,9 +50,40 @@ def make_schedule(cfg: OptimizerConfig):
     raise ValueError(f"unknown schedule {cfg.schedule!r}")
 
 
-def make_optimizer(cfg: OptimizerConfig) -> optax.GradientTransformation:
+def _clip_by_known_norm(max_norm, g_norm) -> optax.GradientTransformation:
+    """optax.clip_by_global_norm with the global norm SUPPLIED instead of
+    recomputed from the updates tree. Needed by the ZeRO-1 sharded
+    update (parallel/zero.py): inside the update shard_map each replica
+    holds only a 1/(data*fsdp) slice of every gradient leaf, so an
+    in-tree global_norm would measure the local shard, not the
+    gradient — the caller computes the true norm on the full tree
+    outside the shard_map (it already does, for the grad_norm metric)
+    and passes it in. The clip formula and the EmptyState are copied
+    from optax so numerics and opt_state STRUCTURE are identical to the
+    replicated chain — checkpoints stay interchangeable across modes."""
+    def update_fn(updates, state, params=None):
+        del params
+        trigger = jnp.squeeze(g_norm < max_norm)
+
+        def clip_fn(t):
+            return jax.lax.select(
+                trigger, t, (t / g_norm.astype(t.dtype)) * max_norm)
+
+        return jax.tree.map(clip_fn, updates), state
+
+    return optax.GradientTransformation(
+        lambda params: optax.EmptyState(), update_fn)
+
+
+def make_optimizer(cfg: OptimizerConfig,
+                   clip_norm_value=None) -> optax.GradientTransformation:
     """Clip → Adam(schedule) [→ plateau scaling]. Returns a transformation
-    whose `update` accepts `value=` when schedule == 'warmup_plateau'."""
+    whose `update` accepts `value=` when schedule == 'warmup_plateau'.
+
+    `clip_norm_value`: optional traced scalar — the gradients' TRUE
+    global norm, pre-computed by the caller. When given, the clip stage
+    uses it instead of measuring the updates tree (see
+    _clip_by_known_norm); the chain structure is unchanged."""
     schedule = make_schedule(cfg)
     if cfg.weight_decay > 0:
         adam = optax.adamw(
@@ -58,7 +91,11 @@ def make_optimizer(cfg: OptimizerConfig) -> optax.GradientTransformation:
         )
     else:
         adam = optax.adam(schedule, b1=cfg.b1, b2=cfg.b2)
-    chain = [optax.clip_by_global_norm(cfg.grad_clip_norm), adam]
+    if clip_norm_value is None:
+        clip = optax.clip_by_global_norm(cfg.grad_clip_norm)
+    else:
+        clip = _clip_by_known_norm(cfg.grad_clip_norm, clip_norm_value)
+    chain = [clip, adam]
     if cfg.schedule == "warmup_plateau":
         chain.append(
             optax.contrib.reduce_on_plateau(

@@ -66,11 +66,56 @@ def gradient_update(
 ) -> Tuple[Any, Any]:
     """Shared optimizer-apply: update → params + cast-preserving add.
     Single source of truth for the default, sequence-parallel
-    (parallel/seq_parallel.py) and fine-tune (train/finetune.py) steps."""
+    (parallel/seq_parallel.py), ZeRO-1 (parallel/zero.py) and fine-tune
+    (train/finetune.py) steps."""
     extra = {"value": loss} if needs_value else {}
     updates, opt_state = tx.update(grads, opt_state, params, **extra)
     params = jax.tree.map(lambda p, u: p + u.astype(p.dtype), params, updates)
     return params, opt_state
+
+
+def corrupt_forward_grads(
+    state: "TrainState", batch: Dict[str, jax.Array], cfg: PretrainConfig,
+) -> Tuple[jax.Array, Any, Dict[str, jax.Array]]:
+    """The pretraining step's front half — split the RNG key, corrupt
+    the clean batch, forward, loss, backward — shared verbatim by the
+    default step below and the ZeRO-1 step (parallel/zero.py), so the
+    corruption plumbing and loss contract cannot drift between them.
+    Returns (next state key, grads, loss metrics)."""
+    key, step_key = jax.random.split(state.key)
+    X, Y, W = corrupt_batch(
+        step_key,
+        batch["tokens"],
+        batch["annotations"],
+        token_randomize_prob=cfg.data.token_randomize_prob,
+        annotation_corrupt_prob=cfg.data.annotation_corrupt_prob,
+        annotation_drop_prob=cfg.data.annotation_drop_prob,
+        annotation_add_prob=cfg.data.annotation_add_prob,
+    )
+    pad_mask = W["local"] > 0
+
+    def loss_fn(params):
+        local_logits, global_logits = proteinbert.apply(
+            params, X["local"], X["global"], cfg.model, pad_mask
+        )
+        return pretrain_loss(local_logits, global_logits, Y, W)
+
+    grads, metrics = jax.grad(loss_fn, has_aux=True)(state.params)
+    return key, grads, metrics
+
+
+def plateau_observation(cfg_opt, metrics: Dict[str, jax.Array],
+                        plateau_value: Any):
+    """The value the plateau transform observes this step: the train
+    loss, or — under an eval-keyed plateau with a finite caller-provided
+    value — the latest cadenced eval loss (+inf means "no eval yet" and
+    falls back to the train loss so the placeholder can't tick the
+    patience counter). One definition for the default and ZeRO-1 steps."""
+    value = metrics["loss"]
+    if plateau_uses_eval(cfg_opt) and plateau_value is not None:
+        pv = jnp.asarray(plateau_value, dtype=jnp.float32)
+        value = jnp.where(jnp.isfinite(pv), pv, metrics["loss"])
+    return value
 
 
 @jax.jit
@@ -136,33 +181,8 @@ def train_step(
     for direct callers of this function, and such callers should know
     the fallback mixes train-scale values into the plateau window
     (ADVICE r4)."""
-    key, step_key = jax.random.split(state.key)
-    X, Y, W = corrupt_batch(
-        step_key,
-        batch["tokens"],
-        batch["annotations"],
-        token_randomize_prob=cfg.data.token_randomize_prob,
-        annotation_corrupt_prob=cfg.data.annotation_corrupt_prob,
-        annotation_drop_prob=cfg.data.annotation_drop_prob,
-        annotation_add_prob=cfg.data.annotation_add_prob,
-    )
-    pad_mask = W["local"] > 0
-
-    def loss_fn(params):
-        local_logits, global_logits = proteinbert.apply(
-            params, X["local"], X["global"], cfg.model, pad_mask
-        )
-        return pretrain_loss(local_logits, global_logits, Y, W)
-
-    grads, metrics = jax.grad(loss_fn, has_aux=True)(state.params)
-
-    value = metrics["loss"]
-    if plateau_uses_eval(cfg.optimizer) and plateau_value is not None:
-        # +inf = "no eval yet": observe the train loss until the first
-        # real eval value arrives, so the pre-eval steps cannot tick the
-        # plateau's patience counter on a meaningless placeholder.
-        pv = jnp.asarray(plateau_value, dtype=jnp.float32)
-        value = jnp.where(jnp.isfinite(pv), pv, metrics["loss"])
+    key, grads, metrics = corrupt_forward_grads(state, batch, cfg)
+    value = plateau_observation(cfg.optimizer, metrics, plateau_value)
     params, opt_state = gradient_update(
         make_optimizer(cfg.optimizer), state.params, grads, state.opt_state,
         value, needs_loss_value(cfg.optimizer),

@@ -146,7 +146,8 @@ def pretrain(
             # intent of cfg.mesh actually apply to CLI-created states.
             from proteinbert_tpu.parallel.sharding import shard_train_state
 
-            state = shard_train_state(state, mesh)
+            state = shard_train_state(state, mesh,
+                                      zero_update=cfg.parallel.zero_update)
         if checkpointer is not None and checkpointer.latest_step() is not None:
             state, data_state = checkpointer.restore(state)
             batches_consumed = int((data_state or {}).get("batches_consumed", 0))
@@ -213,6 +214,20 @@ def pretrain(
             "train.early_stop_patience needs a cadenced eval stream: "
             "pass eval_batches and set train.eval_every > 0")
 
+    from proteinbert_tpu.parallel.zero import zero_extent
+
+    zero_on = (mesh is not None and cfg.parallel.zero_update
+               and zero_extent(mesh) > 1)
+    if cfg.parallel.zero_update and not zero_on:
+        logger.warning(
+            "parallel.zero_update requested but %s — running the "
+            "replicated update",
+            "no mesh was passed" if mesh is None
+            else "the mesh has data*fsdp == 1 (nothing to shard across)")
+    # plateau_step is the eval-keyed variant (extra plateau_value arg);
+    # the zero step carries it natively, mirroring train_step.
+    plateau_step = (lambda state, batch, v:               # noqa: E731
+                    ts.train_step(state, batch, cfg, plateau_value=v))
     if mesh is not None and cfg.mesh.seq > 1 and cfg.model.use_pallas:
         from proteinbert_tpu.parallel.seq_parallel import (
             make_seq_parallel_train_step,
@@ -225,7 +240,19 @@ def pretrain(
                 "step takes no plateau_value input)")
         seq_step = make_seq_parallel_train_step(mesh, cfg)
         step_fn = lambda state, batch, _cfg: seq_step(state, batch)  # noqa: E731
-        logger.info("using explicit sequence-parallel train step (pallas)")
+        logger.info("using explicit sequence-parallel train step (pallas%s)",
+                    " + zero-update" if zero_on else "")
+    elif zero_on:
+        from proteinbert_tpu.parallel.zero import make_zero_train_step
+
+        zero_step = make_zero_train_step(mesh, cfg)
+        step_fn = lambda state, batch, _cfg: zero_step(state, batch)  # noqa: E731
+        plateau_step = (lambda state, batch, v:           # noqa: E731
+                        zero_step(state, batch, v))
+        logger.info(
+            "using ZeRO-1 sharded-update train step (update sharded over "
+            "data*fsdp = %d replicas, grad reduction %s)",
+            zero_extent(mesh), cfg.parallel.grad_reduce_dtype)
     else:
         step_fn = ts.train_step
 
@@ -404,8 +431,7 @@ def pretrain(
             # timing window — the drill asserts it shows up there.
             time.sleep(fault_stall[1])
         if eval_keyed_plateau:
-            state, metrics = ts.train_step(state, put(batch), cfg,
-                                           plateau_value=last_eval_loss)
+            state, metrics = plateau_step(state, put(batch), last_eval_loss)
         else:
             state, metrics = step_fn(state, put(batch), cfg)
         timer.update()

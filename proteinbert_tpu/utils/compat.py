@@ -1,0 +1,93 @@
+"""jax version-compat shims, in ONE place.
+
+Two classes of fix accumulated across the harness while making the suite
+run on both jax 0.4.x and >= 0.5:
+
+- `request_cpu_devices(n)`: force n virtual CPU devices before the
+  backend initializes. jax >= 0.5 has a first-class
+  `jax_num_cpu_devices` config option that works even when env vars were
+  read before the caller ran (images whose sitecustomize imports jax at
+  interpreter start); jax 0.4.x only has the
+  `--xla_force_host_platform_device_count` XLA flag, which works as long
+  as the CPU backend has not been created yet (XLA reads the env var at
+  client creation, not module import). This helper was previously
+  duplicated — with drifting except-clauses — across tests/conftest.py,
+  the multi-device/multi-host child scripts, and __graft_entry__.py.
+
+- `shard_map(...)`: top-level `jax.shard_map` with the `check_vma` kwarg
+  on jax >= 0.6; on 0.4.x the function lives in
+  jax.experimental.shard_map and the varying-mesh-axes checker flag is
+  spelled `check_rep`. (Moved here from parallel/mesh.py, which
+  re-exports it for existing importers.)
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+_FORCE_FLAG = "--xla_force_host_platform_device_count"
+
+
+def request_cpu_devices(n: int) -> bool:
+    """Ask for `n` virtual CPU devices; call BEFORE any device use.
+
+    Returns True when the jax >= 0.5 config API took, False when the
+    0.4.x XLA_FLAGS fallback was installed instead. Either way the
+    caller should verify `jax.device_count()` afterwards — on an
+    already-initialized backend neither mechanism can take effect
+    (the config API raises RuntimeError, swallowed here so a dry run
+    inside a warm session degrades to the caller's count check instead
+    of crashing)."""
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except RuntimeError:  # backend already initialized
+        pass
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+        return True
+    except AttributeError:
+        # jax 0.4.x: env route. Replace any previous count rather than
+        # appending a duplicate (last flag wins in XLA, but a child that
+        # scrubs flags by regex must see exactly one).
+        flags = scrub_device_count_flag(os.environ.get("XLA_FLAGS", ""))
+        os.environ["XLA_FLAGS"] = f"{flags} {_FORCE_FLAG}={n}".strip()
+        return False
+    except RuntimeError:
+        return True
+
+
+def scrub_device_count_flag(flags: str) -> str:
+    """Remove any --xla_force_host_platform_device_count=N from an
+    XLA_FLAGS string. Test parents pinned to 8 devices use this on a
+    child's env so the child's own request_cpu_devices(n) is the only
+    count in play — one definition here, next to the code that re-adds
+    the flag, so the two can't drift."""
+    return re.sub(_FORCE_FLAG + r"=\d+", "", flags).strip()
+
+
+def has_num_cpu_devices_option() -> bool:
+    """True on jax >= 0.5 (first-class jax_num_cpu_devices option).
+
+    Doubles as the harness's version sentinel for the 0.4.x
+    CPU-persistent-cache/donation bug (tests/conftest.py, bench.py)."""
+    import jax
+
+    return hasattr(jax.config, "jax_num_cpu_devices")
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma=None):
+    """Version-compat shard_map; `check_vma=None` means "the version's
+    default" (0.4.x spells the checker flag `check_rep`)."""
+    import jax
+
+    try:
+        sm = jax.shard_map
+        kw = {} if check_vma is None else {"check_vma": check_vma}
+    except AttributeError:
+        from jax.experimental.shard_map import shard_map as sm
+
+        kw = {} if check_vma is None else {"check_rep": check_vma}
+    return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)

@@ -20,20 +20,12 @@ import jax  # noqa: E402  (import after env setup is the point)
 # On images whose sitecustomize imports jax at interpreter start (the axon
 # plugin registration), jax reads its env vars BEFORE conftest runs, so
 # none of the settings above take in-process — everything must also go
-# through the config API, before any device use.
-jax.config.update("jax_platforms", "cpu")
-try:
-    # jax >= 0.5: first-class option, works even when the XLA_FLAGS env
-    # var was read before conftest ran.
-    jax.config.update("jax_num_cpu_devices", 8)
-    _NEW_JAX = True
-except AttributeError:
-    # jax 0.4.x has no such option — the XLA_FLAGS fallback set above is
-    # the only mechanism, and it works as long as the CPU backend has not
-    # been initialized yet (XLA reads the env var at client creation, not
-    # at module import). Nothing to do here; the assertion below verifies
-    # the flag actually took.
-    _NEW_JAX = False
+# through the config API, before any device use. The version-compat
+# mechanics (config API on >= 0.5, XLA_FLAGS on 0.4.x) live in ONE
+# helper shared with the child scripts and the driver entry.
+from proteinbert_tpu.utils.compat import request_cpu_devices  # noqa: E402
+
+_NEW_JAX = request_cpu_devices(8)
 
 # Persistent XLA compilation cache: the suite is compile-bound on CPU (the
 # same train-step HLO is rebuilt by many tests), and a warm cache cuts
@@ -116,6 +108,33 @@ def _jax_map_pressure_relief():
         jax.extend.backend.clear_backends()
         gc.collect()
     yield
+
+
+# ---------------------------------------------------------- marker audit
+# --strict-markers (pyproject) rejects UNREGISTERED marks; this check
+# covers the other failure mode — a registered-but-FORGOTTEN mark. The
+# scale tiers spawn multi-minute children; if a test in one of these
+# modules ships without `slow`, tier-1's `-m 'not slow'` run collects it
+# and the 870 s budget dies quietly. Fail at collection, naming the test.
+
+_SLOW_REQUIRED_MODULES = ("test_parallel64", "test_multihost")
+
+
+def pytest_collection_modifyitems(config, items):
+    unmarked = [
+        item.nodeid for item in items
+        if item.module.__name__.rsplit(".", 1)[-1] in _SLOW_REQUIRED_MODULES
+        and "slow" not in item.keywords
+    ]
+    if unmarked:
+        raise pytest.UsageError(
+            "scale-tier tests must carry the `slow` marker (tier-1's "
+            "timeout budget assumes -m 'not slow' excludes them): "
+            + ", ".join(unmarked))
+    for item in items:
+        if "tier64" in item.keywords and "slow" not in item.keywords:
+            raise pytest.UsageError(
+                f"{item.nodeid}: tier64 tests must also be marked slow")
 
 
 @pytest.fixture

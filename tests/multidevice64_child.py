@@ -1,16 +1,14 @@
-"""16-virtual-device parity child (VERDICT r4 weak #4 / round-5 item 5).
+"""64-virtual-device pod-shape parity child (VERDICT round-6 item 5).
 
-Every in-suite mesh caps fsdp/model at extent 2 (the pytest process is
-pinned to 8 virtual CPU devices at backend init), but off-by-N bugs in
-gather/reduce-scatter sharding rules characteristically appear only at
-extents >2. This child runs in its OWN process with 16 virtual CPU
-devices — forced through the config API, since env vars don't take on
-images whose sitecustomize pre-imports jax — and asserts the sharded
-step is numerically identical to the single-device step. Cheap
-insurance before real-pod day (SURVEY C18/C19; the reference has no
-distributed path at all).
+Extent-8 data collectives have never been constructed by any lower
+tier (the in-suite mesh caps at 8 devices, the 16-device tier at
+extent 4); this child runs realistic v5e-64 mesh shapes on 64 virtual
+CPU devices and asserts the sharded step — including the ZeRO-1
+sharded weight update this tier exists to validate at scale — is
+numerically identical to the single-device step.
 
-Usage: python tests/multidevice16_child.py {fsdp4|model4|sp4-bucketed}
+Usage: python tests/multidevice64_child.py
+           {dp8-fsdp4-model2 | zero-dp8-fsdp4-model2 | dp16-sp4-bucketed}
 Prints one JSON line with the compared losses.
 """
 
@@ -21,36 +19,36 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-# Small dims, all divisible by the >2 axis extents below.
-MODEL = dict(local_dim=16, global_dim=64, key_dim=16, num_heads=4,
+# Small dims, all divisible by the extents below (data*fsdp = 32).
+MODEL = dict(local_dim=32, global_dim=64, key_dim=16, num_heads=4,
              num_blocks=2, num_annotations=64, dtype="float32")
 
 
-def _cfg(mesh_cfg, **data_kw):
+def _cfg(mesh_cfg, parallel=None, **data_kw):
     from proteinbert_tpu.configs import (
-        DataConfig, ModelConfig, OptimizerConfig, PretrainConfig,
-        TrainConfig,
+        DataConfig, ModelConfig, OptimizerConfig, ParallelConfig,
+        PretrainConfig, TrainConfig,
     )
 
-    data = dict(seq_len=32, batch_size=16)
+    data = dict(seq_len=32, batch_size=64)
     data.update(data_kw)
     return PretrainConfig(
         model=ModelConfig(**MODEL),
         data=DataConfig(**data),
         optimizer=OptimizerConfig(learning_rate=1e-3, warmup_steps=10),
         mesh=mesh_cfg,
+        parallel=parallel or ParallelConfig(),
         train=TrainConfig(max_steps=2),
     )
 
 
-def _dense_parity(scenario):
-    """fsdp=4 / model=4: sharded train_step vs single-device, same batch
-    and init — sharding must not change the math (the 8-device tier's
-    test_sharded_train_step_matches_single_device at doubled extents)."""
+def _dense_parity(zero):
+    """data=8 x fsdp=4 x model=2 (the v5e-64 flagship assignment):
+    sharded train_step — replicated or ZeRO-1 — vs single-device."""
     import numpy as np
 
     import jax
-    from proteinbert_tpu.configs import MeshConfig
+    from proteinbert_tpu.configs import MeshConfig, ParallelConfig
     from proteinbert_tpu.data import (
         InMemoryPretrainingDataset, make_pretrain_iterator,
     )
@@ -60,9 +58,9 @@ def _dense_parity(scenario):
     )
     from proteinbert_tpu.train import create_train_state, train_step
 
-    mesh_cfg = (MeshConfig(data=2, fsdp=4, model=2) if scenario == "fsdp4"
-                else MeshConfig(data=2, fsdp=2, model=4))
-    cfg = _cfg(mesh_cfg)
+    mesh_cfg = MeshConfig(data=8, fsdp=4, model=2)
+    cfg = _cfg(mesh_cfg,
+               parallel=ParallelConfig(zero_update=zero))
     rng = np.random.default_rng(0)
     seqs, ann = make_random_proteins(
         cfg.data.batch_size, rng, num_annotations=MODEL["num_annotations"],
@@ -75,10 +73,17 @@ def _dense_parity(scenario):
 
     mesh = make_mesh(mesh_cfg)
     state = shard_train_state(
-        create_train_state(jax.random.PRNGKey(0), cfg), mesh)
+        create_train_state(jax.random.PRNGKey(0), cfg), mesh,
+        zero_update=zero)
     bsh = batch_sharding(mesh)
     dbatch = {k: jax.device_put(v, bsh[k]) for k, v in batch.items()}
-    new_state, m = train_step(state, dbatch, cfg)
+    if zero:
+        from proteinbert_tpu.parallel import make_zero_train_step
+
+        zstep = make_zero_train_step(mesh, cfg)
+        new_state, m = zstep(state, dbatch)
+    else:
+        new_state, m = train_step(state, dbatch, cfg)
 
     ref_loss, got_loss = float(ref_m["loss"]), float(m["loss"])
     assert abs(got_loss - ref_loss) <= 2e-5 * max(1.0, abs(ref_loss)), (
@@ -90,18 +95,27 @@ def _dense_parity(scenario):
             np.asarray(r, np.float64)
             - np.asarray(jax.device_get(g), np.float64))))
         max_err = max(max_err, err)
-    assert max_err < 2e-5, (scenario, max_err)
-    return {"mesh": dict(mesh.shape), "ref_loss": ref_loss,
-            "sharded_loss": got_loss, "max_param_err": max_err}
+    assert max_err < 2e-5, max_err
+    out = {"mesh": dict(mesh.shape), "ref_loss": ref_loss,
+           "sharded_loss": got_loss, "max_param_err": max_err}
+    if zero:
+        # The at-scale memory claim: per-chip Adam state ~1/8 (= the
+        # data extent) of the fsdp-only layout.
+        from proteinbert_tpu.parallel.zero import per_chip_state_bytes
+
+        abstract = jax.eval_shape(
+            lambda: create_train_state(jax.random.PRNGKey(0), cfg))
+        rep = per_chip_state_bytes(mesh, abstract, zero_update=False)
+        zer = per_chip_state_bytes(mesh, abstract, zero_update=True)
+        assert zer["opt_state"] <= rep["opt_state"] / 4.0, (rep, zer)
+        out["opt_state_bytes"] = {"replicated": rep["opt_state"],
+                                  "zero": zer["opt_state"]}
+    return out
 
 
 def _sp4_bucketed():
-    """data=2 x fsdp=2 x seq=4: mixed-length corpus -> length-bucketed
-    lockstep batches -> the EXPLICIT seq-parallel step (halo conv +
-    distributed softmax) — every emitted bucket shape must match the
-    implicit-SPMD step's loss on the identical batch (the 8-device
-    test_long_preset_miniature_h5_bucketed_seq_parallel, with the seq
-    axis at 4 alongside a live fsdp axis)."""
+    """data=16 x seq=4: mixed-length corpus -> bucketed lockstep batches
+    -> the EXPLICIT seq-parallel step, extent-16 data collectives live."""
     import numpy as np
 
     import jax
@@ -114,16 +128,16 @@ def _sp4_bucketed():
     )
     from proteinbert_tpu.train import create_train_state, train_step
 
-    mesh_cfg = MeshConfig(data=2, fsdp=2, seq=4)
-    cfg = _cfg(mesh_cfg, seq_len=128, batch_size=8, buckets=(32, 128))
+    mesh_cfg = MeshConfig(data=16, seq=4)
+    cfg = _cfg(mesh_cfg, seq_len=128, batch_size=16, buckets=(32, 128))
     rng = np.random.default_rng(0)
     seqs = []
-    for i in range(64):
+    for i in range(96):
         n = (int(rng.integers(5, 28)) if i % 2
              else int(rng.integers(80, 120)))
         seqs.append("".join(
             rng.choice(list("ACDEFGHIKLMNPQRSTVWY"), size=n)))
-    ann = (rng.random((64, MODEL["num_annotations"])) < 0.1)
+    ann = (rng.random((96, MODEL["num_annotations"])) < 0.1)
     ds = InMemoryPretrainingDataset(seqs, ann, cfg.data.seq_len)
 
     mesh = make_mesh(mesh_cfg)
@@ -154,12 +168,14 @@ def main():
 
     from proteinbert_tpu.utils.compat import request_cpu_devices
 
-    request_cpu_devices(16)
-    assert jax.device_count() == 16, jax.device_count()
+    request_cpu_devices(64)
+    assert jax.device_count() == 64, jax.device_count()
 
-    if scenario in ("fsdp4", "model4"):
-        out = _dense_parity(scenario)
-    elif scenario == "sp4-bucketed":
+    if scenario == "dp8-fsdp4-model2":
+        out = _dense_parity(zero=False)
+    elif scenario == "zero-dp8-fsdp4-model2":
+        out = _dense_parity(zero=True)
+    elif scenario == "dp16-sp4-bucketed":
         out = _sp4_bucketed()
     else:
         raise SystemExit(f"unknown scenario {scenario!r}")

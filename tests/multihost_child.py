@@ -44,13 +44,9 @@ def main() -> None:
 
     # Before any backend use: 2 local CPU devices per process, gloo
     # cross-process collectives (the CPU stand-in for ICI/DCN).
-    jax.config.update("jax_platforms", "cpu")
-    try:
-        jax.config.update("jax_num_cpu_devices", 2)
-    except AttributeError:  # jax 0.4.x: env route, pre-backend-init
-        os.environ["XLA_FLAGS"] = (
-            os.environ.get("XLA_FLAGS", "")
-            + " --xla_force_host_platform_device_count=2").strip()
+    from proteinbert_tpu.utils.compat import request_cpu_devices
+
+    request_cpu_devices(2)
     if num_processes > 1:
         jax.config.update("jax_cpu_collectives_implementation", "gloo")
         jax.distributed.initialize(

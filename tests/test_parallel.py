@@ -248,14 +248,9 @@ def test_fsdp_compile_has_no_involuntary_remat_warning():
                     "test belongs to the shardy partitioner")
 
     code = """
-import os
 import jax
-jax.config.update("jax_platforms", "cpu")
-try:
-    jax.config.update("jax_num_cpu_devices", 8)
-except AttributeError:  # jax 0.4.x: env route, pre-backend-init
-    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
-        + " --xla_force_host_platform_device_count=8").strip()
+from proteinbert_tpu.utils.compat import request_cpu_devices
+request_cpu_devices(8)
 # A persistent-cache hit loads an AOT result and SKIPS partitioning, so
 # neither arm would emit the warning (observed: the positive control
 # went silent once the suite's cache warmed) — force fresh compiles.

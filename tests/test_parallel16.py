@@ -11,7 +11,6 @@ data=2 x fsdp=2 x seq=4 with bucketed lockstep batches.
 
 import json
 import os
-import re
 import subprocess
 import sys
 
@@ -23,10 +22,10 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 def _child_env():
     """The child forces 16 devices via the config API; scrub the
     conftest's 8-device XLA flag so the two mechanisms can't fight."""
+    from proteinbert_tpu.utils.compat import scrub_device_count_flag
+
     env = dict(os.environ)
-    env["XLA_FLAGS"] = re.sub(
-        r"--xla_force_host_platform_device_count=\d+", "",
-        env.get("XLA_FLAGS", "")).strip()
+    env["XLA_FLAGS"] = scrub_device_count_flag(env.get("XLA_FLAGS", ""))
     return env
 
 
@@ -68,14 +67,9 @@ def test_fsdp4_compile_has_no_involuntary_remat_warning():
         pytest.skip("default partitioner is GSPMD (jax 0.4.x) — the "
                     "warning-free property under test belongs to shardy")
     code = """
-import os
 import jax
-jax.config.update("jax_platforms", "cpu")
-try:
-    jax.config.update("jax_num_cpu_devices", 16)
-except AttributeError:  # jax 0.4.x: env route, pre-backend-init
-    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
-        + " --xla_force_host_platform_device_count=16").strip()
+from proteinbert_tpu.utils.compat import request_cpu_devices
+request_cpu_devices(16)
 jax.config.update("jax_enable_compilation_cache", False)
 import numpy as np
 from proteinbert_tpu.configs import (DataConfig, MeshConfig, ModelConfig,

@@ -1,9 +1,35 @@
 #!/usr/bin/env bash
 # The ROADMAP tier-1 verify command, verbatim — one place to edit, so a
 # local run, CI, and the driver's gate can never drift apart.
+#
+# --pod64: ALSO run the opt-in 64-virtual-device pod-shape tier
+# (tests/test_parallel64.py) after the tier-1 suite. It is slow-marked
+# and env-gated, so the tier-1 pass itself is byte-identical with or
+# without the flag; the pod tier's pass/fail is OR-ed into the exit
+# code but its dots are reported separately (the DOTS_PASSED contract
+# counts tier-1 only).
 set -o pipefail
+
+POD64=0
+for arg in "$@"; do
+  case "$arg" in
+    --pod64) POD64=1 ;;
+    *) echo "unknown flag: $arg (supported: --pod64)" >&2; exit 2 ;;
+  esac
+done
+
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+
+if [ "$POD64" = "1" ]; then
+  echo "=== pod64 tier (64 virtual devices, opt-in) ==="
+  timeout -k 10 2700 env JAX_PLATFORMS=cpu PBT_RUN_TIER64=1 \
+    python -m pytest tests/test_parallel64.py -q -m 'tier64' \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+  rc64=$?
+  [ "$rc" -eq 0 ] && rc=$rc64
+fi
+
 exit $rc

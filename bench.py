@@ -283,6 +283,21 @@ def persist_last_good(sweep):
     except OSError as e:
         print(f"could not persist last-good TPU record: {e}",
               file=sys.stderr)
+    # Mirror the capture onto the shared telemetry stream (obs `note`
+    # events, same schema as training runs) so sweep history is readable
+    # by pbt diagnose / validate_events instead of a private format.
+    try:
+        from proteinbert_tpu.obs.events import EventLog
+
+        ev = EventLog(os.path.join(os.path.dirname(LAST_GOOD_PATH),
+                                   "bench_events.jsonl"))
+        ev.emit("note", source="bench", kind="sweep_capture",
+                rows=len(merged), best_variant=top["variant"],
+                best_residues_per_sec=top["residues_per_sec"],
+                best_mfu=top["mfu"])
+        ev.close()
+    except Exception as e:  # stream is best-effort, the record is safe
+        print(f"bench events stream unavailable: {e}", file=sys.stderr)
 
 
 def time_step(cfg, batch_np, steps):

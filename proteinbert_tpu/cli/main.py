@@ -16,6 +16,7 @@ is one console with subcommands:
   export-weights     orbax run dir → flat NPZ of named arrays (portability)
   import-weights     flat NPZ → orbax run dir (the export round trip)
   evaluate           score a checkpoint on a dataset (loss/acc/AUROC/p@k)
+  diagnose           summarize a run's telemetry events (+ flight dump)
   data-bench         host input-pipeline throughput probe (batches/s)
   embed              trunk representations for sequences → HDF5/NPZ
   predict-go         GO-annotation probabilities from sequence alone
@@ -263,6 +264,15 @@ def cmd_pretrain(args) -> int:
         # Downstream --pretrained commands reconstruct the exact run
         # config from this file, no repeated --pretrained-set flags.
         _save_run_config(cfg, cfg.checkpoint.directory)
+    tele = None
+    # Only host 0 writes (every process would append duplicate, possibly
+    # torn, lines to a shared file under --multihost; flight dumps are
+    # pid-stamped but one forensics stream is what diagnose wants).
+    if getattr(args, "events_jsonl", None) and jax.process_index() == 0:
+        from proteinbert_tpu.obs import Telemetry
+
+        tele = Telemetry(events_path=args.events_jsonl)
+        tele.flight.install_excepthook()  # unhandled exception → dump
     log_fn = None
     mf = None
     # Only host 0 writes (every process would append duplicate, possibly
@@ -287,18 +297,23 @@ def cmd_pretrain(args) -> int:
 
             with device_trace(args.profile_dir):
                 out = pretrain(cfg, factory, checkpointer=ck, mesh=mesh,
-                               eval_batches=eval_batches, log_fn=log_fn)
+                               eval_batches=eval_batches, log_fn=log_fn,
+                               telemetry=tele)
             log(f"jax profiler trace → {args.profile_dir} "
                 "(view in TensorBoard/Perfetto)")
         else:
             out = pretrain(cfg, factory, checkpointer=ck, mesh=mesh,
-                           eval_batches=eval_batches, log_fn=log_fn)
+                           eval_batches=eval_batches, log_fn=log_fn,
+                           telemetry=tele)
     finally:
         # Always await in-flight async checkpoint saves — a halt (e.g.
         # NonFiniteLossError) must not abandon a half-written checkpoint.
         ck.close()
         if mf is not None:
             mf.close()
+        if tele is not None:
+            _export_metrics(tele)
+            tele.close()
     perf = out["perf"]
     if perf:
         log(f"done: {perf.get('residues_per_sec_per_chip', 0):.0f} "
@@ -421,9 +436,21 @@ def cmd_finetune(args) -> int:
     # (same convention — and the same host-0 guard — as pretrain run dirs).
     if jax.process_index() == 0:
         _save_run_config(cfg, cfg.checkpoint.directory)
-    out = finetune(cfg, train_batches, eval_batches=eval_batches,
-                   pretrained_trunk=trunk, checkpointer=ck)
-    ck.close()
+    tele = None
+    if getattr(args, "events_jsonl", None) and jax.process_index() == 0:
+        from proteinbert_tpu.obs import Telemetry
+
+        tele = Telemetry(events_path=args.events_jsonl)
+        tele.flight.install_excepthook()  # unhandled exception → dump
+    try:
+        out = finetune(cfg, train_batches, eval_batches=eval_batches,
+                       pretrained_trunk=trunk, checkpointer=ck,
+                       telemetry=tele)
+    finally:
+        ck.close()
+        if tele is not None:
+            _export_metrics(tele)
+            tele.close()
     best = out["best"]
     log(f"best epoch {best['epoch']}: score {best['score']:.4f}")
     if args.history_json:
@@ -473,6 +500,22 @@ def _read_named_seqs(args) -> tuple:
     if getattr(args, "seqs", None):
         return [f"seq{i}" for i in range(len(args.seqs))], list(args.seqs)
     raise SystemExit("provide --fasta, --seqs-file, or positional sequences")
+
+
+def _export_metrics(tele) -> None:
+    """Persist the run's metrics registry beside the events stream: one
+    appended JSONL snapshot (`<events>.metrics.jsonl`, a time series
+    across requeues) plus the Prometheus textfile (`<events>.prom`,
+    last-run-wins for a textfile collector). Best-effort — the run's
+    outcome must never depend on a metrics sink."""
+    if tele.events is None:
+        return
+    base = tele.events.path
+    try:
+        tele.metrics.write_snapshot(base + ".metrics.jsonl")
+        tele.metrics.write_prometheus(base + ".prom")
+    except OSError as e:
+        log(f"could not export telemetry metrics: {e}")
 
 
 def _save_run_config(cfg, directory: str) -> None:
@@ -644,6 +687,35 @@ def cmd_evaluate(args) -> int:
     if args.output:
         with open(args.output, "w") as f:
             json.dump(result, f, indent=2)
+    return 0
+
+
+def cmd_diagnose(args) -> int:
+    """Summarize a telemetry events JSONL (+ optional flight-recorder
+    dump): step-rate trend, stall top-list, boundary overlap ratio, and
+    the last events before death — the one-artifact post-mortem the
+    obs subsystem exists for. No jax import: runs anywhere the
+    artifacts can be copied."""
+    from proteinbert_tpu.obs import read_events, validate_flight_dump
+    from proteinbert_tpu.obs.diagnose import render, summarize
+
+    records = read_events(args.events)
+    if not records:
+        raise SystemExit(f"no valid event records in {args.events}")
+    flight = None
+    if args.flight:
+        with open(args.flight) as f:
+            flight = json.load(f)
+        try:
+            validate_flight_dump(flight)
+        except ValueError as e:
+            raise SystemExit(f"{args.flight} is not a valid flight dump: {e}")
+    summary = summarize(records, flight=flight,
+                        slow_top=args.slow_top, last=args.last)
+    if args.json:
+        print(json.dumps(summary))
+    else:
+        print(render(summary))
     return 0
 
 
@@ -942,6 +1014,13 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("--history-json", type=creatable_path)
         sp.add_argument("--metrics-jsonl", type=creatable_path,
                         help="append one JSON line per logged/eval step")
+        sp.add_argument("--events-jsonl", type=creatable_path,
+                        help="unified telemetry: append schema-versioned "
+                             "run events here (run_start/step/ckpt_stage/"
+                             "eval/requeue/nan_halt/run_end); also arms "
+                             "the flight recorder, which dumps "
+                             "flight_<pid>.json beside this file on "
+                             "SIGTERM/NaN/crash (docs/observability.md)")
         sp.add_argument("--profile-dir",
                         help="capture a jax.profiler device trace here")
         sp.add_argument("--set", action="append", metavar="PATH=VALUE",
@@ -975,6 +1054,9 @@ def build_parser() -> argparse.ArgumentParser:
     ftp.add_argument("--eval-data", type=existing_file)
     ftp.add_argument("--checkpoint-dir")
     ftp.add_argument("--history-json", type=creatable_path)
+    ftp.add_argument("--events-jsonl", type=creatable_path,
+                     help="unified telemetry events stream "
+                          "(docs/observability.md)")
     ftp.add_argument("--set", action="append", metavar="PATH=VALUE")
     ftp.set_defaults(fn=cmd_finetune)
 
@@ -1032,6 +1114,21 @@ def build_parser() -> argparse.ArgumentParser:
     ev.add_argument("--output", type=creatable_path,
                     help="also write the JSON result here")
     ev.set_defaults(fn=cmd_evaluate)
+
+    dg = sub.add_parser("diagnose",
+                        help="summarize a telemetry events JSONL "
+                             "(+ flight-recorder dump)")
+    dg.add_argument("events", type=existing_file,
+                    help="events JSONL from --events-jsonl")
+    dg.add_argument("--flight", type=existing_file,
+                    help="flight_<pid>.json dump from a dead run")
+    dg.add_argument("--last", type=int, default=10,
+                    help="how many trailing events to list")
+    dg.add_argument("--slow-top", type=int, default=5,
+                    help="size of the slowest-windows list")
+    dg.add_argument("--json", action="store_true",
+                    help="machine-readable summary instead of the report")
+    dg.set_defaults(fn=cmd_diagnose)
 
     dbench = sub.add_parser("data-bench",
                             help="host input-pipeline throughput probe")

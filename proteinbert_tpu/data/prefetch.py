@@ -17,13 +17,21 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Iterator
 
 _SENTINEL = object()
 
 
 class PrefetchIterator:
-    """Iterator view over `source` with `depth` batches produced ahead."""
+    """Iterator view over `source` with `depth` batches produced ahead.
+
+    Starvation accounting: `wait_s` accumulates the wall seconds the
+    CONSUMER spent blocked on an empty queue (i.e. the host input
+    pipeline failed to stay ahead of the device) and `batches` counts
+    deliveries — the two numbers telemetry exports as the
+    `data_wait_seconds` / `data_batches_total` metrics, turning "is the
+    chip starving?" from a data-bench rerun into a per-run gauge."""
 
     def __init__(self, source: Iterator, depth: int = 2):
         if depth < 1:
@@ -33,6 +41,8 @@ class PrefetchIterator:
         self._error = None
         self._done = False
         self._source = source
+        self.wait_s = 0.0
+        self.batches = 0
         self._thread = threading.Thread(target=self._fill, daemon=True)
         self._thread.start()
 
@@ -62,6 +72,7 @@ class PrefetchIterator:
     def __next__(self):
         if self._done:
             raise StopIteration
+        t0 = time.perf_counter()
         while True:
             try:
                 item = self._q.get(timeout=0.5)
@@ -73,12 +84,14 @@ class PrefetchIterator:
                 if self._stop.is_set() or not self._thread.is_alive():
                     self._done = True
                     raise StopIteration from None
+        self.wait_s += time.perf_counter() - t0
         if item is _SENTINEL:
             self._done = True
             if self._error is not None:
                 err, self._error = self._error, None
                 raise err
             raise StopIteration
+        self.batches += 1
         return item
 
     def close(self):

@@ -1,0 +1,184 @@
+"""Run diagnosis from a telemetry events stream (+ optional flight dump).
+
+The analysis behind `pbt diagnose`: given the JSONL a run emitted (and,
+for a dead run, its flight-recorder dump), answer the operator
+questions one artifact at a time used to need four — how fast was it
+going, where did it stall, how much boundary work ran hidden, and what
+happened right before it died.
+
+Pure functions over plain dicts (no jax), so this also serves as the
+library API for notebooks and the test suite.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Dict, List, Optional
+
+
+def summarize(records: List[Dict[str, Any]],
+              flight: Optional[Dict[str, Any]] = None,
+              slow_top: int = 5, last: int = 10) -> Dict[str, Any]:
+    """One JSON-able summary dict; every section is optional-input-safe
+    (a partial stream from a dead run still summarizes).
+
+    A requeued run appends a fresh run_start to the SAME file (that is
+    the exit-75 flow); rates/wall/manifest are computed over the LAST
+    incarnation only — mixing incarnations would divide step counts by
+    wall time that includes the queue/restart gap and report the dead
+    pid's manifest. Earlier incarnations stay visible via `counts`
+    (whole file) and `incarnations`."""
+    starts = [i for i, r in enumerate(records) if r["event"] == "run_start"]
+    incarnations = len(starts)
+    whole_file_counts = dict(
+        collections.Counter(r["event"] for r in records))
+    if len(starts) > 1:
+        records = records[starts[-1]:]
+    steps = [r for r in records if r["event"] == "step"]
+    evals = [r for r in records if r["event"] == "eval"]
+    ckpt = [r for r in records if r["event"] == "ckpt_stage"]
+    run_start = next((r for r in records if r["event"] == "run_start"), None)
+    run_end = next((r for r in reversed(records)
+                    if r["event"] == "run_end"), None)
+
+    out: Dict[str, Any] = {
+        "counts": whole_file_counts,
+        "incarnations": incarnations,
+        "outcome": (run_end["outcome"] if run_end
+                    else "unknown (no run_end record — died hard?)"),
+    }
+    if run_start is not None:
+        out["manifest"] = {
+            "jax_version": run_start.get("jax_version"),
+            "pid": run_start.get("pid"),
+            "mesh": run_start.get("mesh"),
+            "n_chips": run_start.get("n_chips"),
+            "resumed": run_start.get("resumed"),
+        }
+
+    # ------------------------------------------------------ step rate
+    # Cumulative rate straight from StepTimer (run_end.perf, else the
+    # last step record), PLUS an independent wall-clock estimate from
+    # the stream's own stamps — a disagreement between the two is
+    # itself a finding (timer discounting hiding real stall time).
+    perf = dict((run_end or {}).get("perf") or {})
+    if not perf and steps:
+        perf = {k: v for k, v in steps[-1]["metrics"].items()
+                if isinstance(v, (int, float))}
+    rate: Dict[str, Any] = {"steps_per_sec": perf.get("steps_per_sec")}
+    if len(steps) >= 2:
+        d_steps = steps[-1]["step"] - steps[0]["step"]
+        d_t = steps[-1]["t"] - steps[0]["t"]
+        if d_steps > 0 and d_t > 0:
+            rate["stream_steps_per_sec"] = d_steps / d_t
+    windows = [(s["step"], s["metrics"]["window_steps_per_sec"])
+               for s in steps
+               if isinstance(s["metrics"].get("window_steps_per_sec"),
+                             (int, float))]
+    if windows:
+        rate["window_trend"] = [(st, round(w, 4)) for st, w in windows]
+        half = len(windows) // 2
+        if half:
+            first = sum(w for _, w in windows[:half]) / half
+            second = sum(w for _, w in windows[half:]) / (len(windows) - half)
+            ratio = second / first if first > 0 else 1.0
+            rate["trend"] = ("degrading" if ratio < 0.9
+                            else "improving" if ratio > 1.1 else "stable")
+    out["step_rate"] = rate
+
+    # ------------------------------------------------- stall top-list
+    slow = sorted(
+        (s for s in steps
+         if isinstance(s["metrics"].get("window_step_ms"), (int, float))),
+        key=lambda s: -s["metrics"]["window_step_ms"])[:slow_top]
+    out["stalls"] = [{
+        "step": s["step"],
+        "window_step_ms": round(s["metrics"]["window_step_ms"], 2),
+        "ckpt_in_flight": bool(s["metrics"].get("ckpt_in_flight")),
+        "t": s["t"],
+    } for s in slow]
+
+    # -------------------------------------------- boundary overlap
+    landed = [c for c in ckpt if c.get("phase") == "landed"]
+    landed_overlap = sum(c.get("overlap_s") or 0.0 for c in landed)
+    overlap_s = perf.get("overlap_s", landed_overlap)
+    wall = None
+    if run_start is not None and run_end is not None:
+        wall = run_end["t"] - run_start["t"]
+    elif len(records) >= 2:
+        wall = records[-1]["t"] - records[0]["t"]
+    out["boundary"] = {
+        "ckpt_stages_landed": len(landed),
+        "overlap_s": round(overlap_s, 4),
+        "landed_overlap_s": round(landed_overlap, 4),
+        "evals": len(evals),
+        "wall_s": round(wall, 3) if wall is not None else None,
+        "overlap_ratio": (round(overlap_s / wall, 6)
+                          if wall and wall > 0 else None),
+    }
+
+    # ------------------------------------------- death forensics
+    tail_src: List[Dict[str, Any]] = records
+    if flight is not None:
+        out["flight"] = {"reason": flight.get("reason"),
+                         "pid": flight.get("pid"),
+                         "dumped_at": flight.get("dumped_at"),
+                         "events": len(flight.get("events") or [])}
+        tail_src = flight.get("events") or records
+    out["last_events"] = [{
+        "event": r["event"], "step": r.get("step"), "t": r["t"],
+        **({"phase": r["phase"]} if r["event"] == "ckpt_stage" else {}),
+        **({"reason": r["reason"]} if r["event"] == "requeue" else {}),
+        **({"outcome": r["outcome"]} if r["event"] == "run_end" else {}),
+    } for r in tail_src[-last:]]
+    return out
+
+
+def render(summary: Dict[str, Any]) -> str:
+    """Human-readable report (the `pbt diagnose` default output)."""
+    lines = []
+    lines.append(f"outcome: {summary['outcome']}")
+    if summary.get("incarnations", 1) > 1:
+        lines.append(f"requeued stream: {summary['incarnations']} "
+                     "incarnations in this file (rates cover the last)")
+    man = summary.get("manifest")
+    if man:
+        lines.append(
+            f"manifest: jax {man.get('jax_version')} pid {man.get('pid')}"
+            f" mesh {man.get('mesh')} chips {man.get('n_chips')}"
+            + (" (resumed)" if man.get("resumed") else ""))
+    lines.append("events: " + ", ".join(
+        f"{k}={v}" for k, v in sorted(summary["counts"].items())))
+    rate = summary["step_rate"]
+    sps = rate.get("steps_per_sec")
+    lines.append(
+        "step rate: "
+        + (f"{sps:.4f} steps/s (StepTimer cumulative)" if sps is not None
+           else "n/a")
+        + (f", {rate['stream_steps_per_sec']:.4f} steps/s (stream wall"
+           f"-clock)" if "stream_steps_per_sec" in rate else "")
+        + (f" — trend {rate['trend']}" if "trend" in rate else ""))
+    if summary["stalls"]:
+        lines.append("slowest windows (window_step_ms, ckpt_in_flight):")
+        for s in summary["stalls"]:
+            lines.append(f"  step {s['step']:>8}: {s['window_step_ms']:10.2f}"
+                         f" ms {'[ckpt]' if s['ckpt_in_flight'] else ''}")
+    b = summary["boundary"]
+    ratio = b.get("overlap_ratio")
+    lines.append(
+        f"boundary: {b['ckpt_stages_landed']} staged saves landed, "
+        f"{b['overlap_s']:.3f}s overlapped"
+        + (f" ({100 * ratio:.2f}% of {b['wall_s']:.1f}s wall)"
+           if ratio is not None else "")
+        + f", {b['evals']} evals")
+    fl = summary.get("flight")
+    if fl:
+        lines.append(f"flight dump: reason={fl['reason']} pid={fl['pid']} "
+                     f"({fl['events']} events)")
+    lines.append(f"last {len(summary['last_events'])} events before end:")
+    for r in summary["last_events"]:
+        extra = " ".join(f"{k}={v}" for k, v in r.items()
+                         if k not in ("event", "step", "t") and v is not None)
+        lines.append(f"  t={r['t']:.2f} {r['event']:<11}"
+                     f" step={r.get('step')} {extra}".rstrip())
+    return "\n".join(lines)

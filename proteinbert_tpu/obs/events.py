@@ -1,0 +1,248 @@
+"""Structured run-event log: append-only, schema-versioned JSONL.
+
+One stream per run, one JSON object per line. Every record carries the
+schema version (`v`), the event type (`event`), a per-process monotonic
+sequence number (`seq`), and a monotonically non-decreasing wall-clock
+stamp (`t`) — so a reader can order records even across a torn tail and
+correlate them with external logs. Writes are line-buffered appends: a
+crash loses at most the partially-written last line, never an earlier
+record, and `read_events` skips a torn tail instead of dying on it.
+
+This module is deliberately stdlib-only (no jax import): the schema
+validator (`tools/validate_events.py`) and `pbt diagnose` must work on
+machines that only hold the artifacts.
+
+Event types and their required payload fields are in EVENT_FIELDS;
+`validate_record` is the single source of truth the writer, the
+validator tool, and the tier-1 round-trip test all share.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import math
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+SCHEMA_VERSION = 1
+
+# Per-type REQUIRED payload fields (name -> type or tuple of types).
+# Extra fields are always allowed — the schema bounds the floor, not the
+# ceiling, so emitters can attach context without a schema bump.
+EVENT_FIELDS: Dict[str, Dict[str, Any]] = {
+    # Run manifest: everything needed to interpret the rest of the
+    # stream without the shell history (config, mesh, jax version).
+    "run_start": {"config": dict, "jax_version": str, "pid": int},
+    # One per log cadence; `metrics` is the logged metrics dict
+    # (loss/acc + StepTimer summary incl. window_* rates).
+    "step": {"step": int, "metrics": dict},
+    # Checkpoint boundary lifecycle: phase in CKPT_PHASES.
+    "ckpt_stage": {"step": int, "phase": str},
+    # One per eval bracket (sync or overlap-resolved — same payload).
+    "eval": {"step": int, "metrics": dict},
+    # Preemption (SIGTERM/SIGINT): the run exits 75 for a supervisor.
+    "requeue": {"step": int, "reason": str},
+    # Non-finite loss/grad watch fired (on_nan halt or warn).
+    "nan_halt": {"step": int, "metrics": dict},
+    # Terminal record; outcome in OUTCOMES, perf is StepTimer.summary().
+    "run_end": {"outcome": str, "perf": dict},
+    # Generic annotated event for tools (tpu_watch, bench) that share
+    # the stream format without being training runs.
+    "note": {"source": str},
+}
+
+CKPT_PHASES = ("dispatch", "landed", "save")
+OUTCOMES = ("completed", "preempted", "early_stopped", "nan_halt", "error")
+
+
+def sanitize(value: Any) -> Any:
+    """Recursively make `value` strict-JSON-safe: non-finite floats
+    become None (a NaN-halt record must stay parseable — NaN/Inf are the
+    one payload this log exists to capture and the one thing json.dumps
+    emits invalid JSON for), numpy scalars collapse to Python scalars
+    via their item()/float semantics, unknown objects become str()."""
+    if isinstance(value, bool) or value is None or isinstance(value, str):
+        return value
+    if isinstance(value, int):
+        return value
+    if isinstance(value, float):
+        return value if math.isfinite(value) else None
+    if isinstance(value, dict):
+        return {str(k): sanitize(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple, set)):
+        return [sanitize(v) for v in value]
+    item = getattr(value, "item", None)
+    if callable(item):
+        try:
+            return sanitize(item())
+        except Exception:
+            pass
+    return str(value)
+
+
+def make_record(event: str, seq: int, t: float, **fields) -> Dict[str, Any]:
+    return {"v": SCHEMA_VERSION, "event": event, "seq": seq,
+            "t": round(float(t), 6), **sanitize(fields)}
+
+
+def build_record(event: str, seq: int, t: float,
+                 fields: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """make_record + validate under the never-raises contract: a
+    malformed payload (schema violation, or a field colliding with a
+    record key — TypeError from make_record) is logged and returns
+    None. The ONE construction path for both EventLog.emit and the
+    Telemetry facade's flight-only mode."""
+    try:
+        rec = make_record(event, seq=seq, t=t, **fields)
+        validate_record(rec)
+        return rec
+    except (ValueError, TypeError):
+        logger.warning("dropping malformed %r event", event, exc_info=True)
+        return None
+
+
+def validate_record(rec: Any) -> None:
+    """Raise ValueError (with a pinpointing message) unless `rec` is a
+    well-formed event record. The writer, tools/validate_events.py, and
+    the tier-1 round-trip test all call THIS function — one schema."""
+    if not isinstance(rec, dict):
+        raise ValueError(f"record is not an object: {type(rec).__name__}")
+    if rec.get("v") != SCHEMA_VERSION:
+        raise ValueError(f"schema version {rec.get('v')!r} != {SCHEMA_VERSION}")
+    event = rec.get("event")
+    if event not in EVENT_FIELDS:
+        raise ValueError(f"unknown event type {event!r} "
+                         f"(have {sorted(EVENT_FIELDS)})")
+    seq = rec.get("seq")
+    if not isinstance(seq, int) or isinstance(seq, bool) or seq < 0:
+        raise ValueError(f"seq must be a non-negative int, got {seq!r}")
+    t = rec.get("t")
+    if not isinstance(t, (int, float)) or isinstance(t, bool) \
+            or not math.isfinite(t):
+        raise ValueError(f"t must be a finite number, got {t!r}")
+    for name, typ in EVENT_FIELDS[event].items():
+        if name not in rec:
+            raise ValueError(f"{event}: missing required field {name!r}")
+        if not isinstance(rec[name], typ):
+            raise ValueError(
+                f"{event}.{name}: expected {typ}, got {type(rec[name]).__name__}")
+    if "step" in rec:
+        s = rec["step"]
+        if not isinstance(s, int) or isinstance(s, bool) or s < 0:
+            raise ValueError(f"step must be a non-negative int, got {s!r}")
+    if event == "ckpt_stage" and rec["phase"] not in CKPT_PHASES:
+        raise ValueError(f"ckpt_stage.phase {rec['phase']!r} not in "
+                         f"{CKPT_PHASES}")
+    if event == "run_end" and rec["outcome"] not in OUTCOMES:
+        raise ValueError(f"run_end.outcome {rec['outcome']!r} not in "
+                         f"{OUTCOMES}")
+
+
+def make_example(event: str) -> Dict[str, Any]:
+    """A minimal valid record of `event` — the self-test/round-trip
+    fixture, kept next to the schema so adding an event type without a
+    fixture fails the validator self-test immediately."""
+    payloads = {
+        "run_start": {"config": {"train": {"max_steps": 1}},
+                      "jax_version": "0.0.0", "pid": 1},
+        "step": {"step": 1, "metrics": {"loss": 1.0}},
+        "ckpt_stage": {"step": 1, "phase": "dispatch"},
+        "eval": {"step": 1, "metrics": {"eval_loss": 1.0}},
+        "requeue": {"step": 1, "reason": "signal_15"},
+        "nan_halt": {"step": 1, "metrics": {"loss": None}},
+        "run_end": {"outcome": "completed", "perf": {}},
+        "note": {"source": "self_test"},
+    }
+    return make_record(event, seq=0, t=0.0, **payloads[event])
+
+
+class EventLog:
+    """Append-only JSONL event writer.
+
+    - line-buffered file (crash loses at most the in-flight line);
+    - thread-safe (the checkpoint stager thread emits from off-main);
+    - `seq` monotonic per process, `t` clamped non-decreasing;
+    - NEVER raises from emit(): telemetry must not be able to kill a
+      training run — a failing disk logs one warning and disables the
+      writer, the run continues.
+    """
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+        d = os.path.dirname(self.path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._fh = open(self.path, "a", buffering=1)
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._last_t = 0.0
+        self._dead = False
+
+    def emit(self, event: str, **fields) -> Optional[Dict[str, Any]]:
+        """Validate + append one record; returns it (also handed to the
+        flight recorder by the Telemetry facade), or None on failure."""
+        with self._lock:
+            t = max(time.time(), self._last_t)
+            self._last_t = t
+            rec = build_record(event, self._seq, t, fields)
+            if rec is None:
+                return None
+            self._seq += 1
+            if not self._dead:
+                try:
+                    self._fh.write(json.dumps(rec) + "\n")
+                except (OSError, ValueError):
+                    # ValueError: write on a closed file (interpreter
+                    # teardown / double-close races).
+                    self._dead = True
+                    logger.warning("event log %s failed; telemetry "
+                                   "writes disabled", self.path,
+                                   exc_info=True)
+            return rec
+
+    def close(self) -> None:
+        with self._lock:
+            self._dead = True
+            try:
+                self._fh.close()
+            except OSError:
+                pass
+
+
+def read_events(path: str, strict: bool = False) -> List[Dict[str, Any]]:
+    """Load an events JSONL. A torn final line (crash mid-write) is
+    skipped silently; any OTHER malformed line raises only under
+    `strict` (the validator tool) and is skipped with a warning
+    otherwise (diagnose must work on imperfect artifacts)."""
+    with open(path) as f:
+        lines = [(i, ln) for i, ln in enumerate(f, start=1) if ln.strip()]
+    records: List[Dict[str, Any]] = []
+    for lineno, line in lines:
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError as e:
+            # Only UNPARSEABLE JSON on the FINAL line is mid-write
+            # tearing; a parseable-but-schema-invalid last record is a
+            # writer bug and must not be silently absorbed by strict.
+            if lineno == lines[-1][0]:
+                break  # torn tail from a crash mid-write
+            if strict:
+                raise ValueError(f"{path}:{lineno}: {e}") from None
+            logger.warning("%s:%d: skipping unparseable line (%s)",
+                           path, lineno, e)
+            continue
+        try:
+            validate_record(rec)
+        except ValueError as e:
+            if strict:
+                raise ValueError(f"{path}:{lineno}: {e}") from None
+            logger.warning("%s:%d: skipping bad record (%s)",
+                           path, lineno, e)
+            continue
+        records.append(rec)
+    return records

@@ -1,0 +1,123 @@
+"""Host-side span tracing with device-trace forwarding.
+
+`span("name")` times a nested host region. Three sinks, all optional:
+
+- a SpanCollector accumulates finished spans and dumps them as
+  Perfetto-compatible `{"traceEvents": [...]}` JSON — the SAME format
+  jax.profiler's trace.json.gz uses, so `tools/trace_attribution.py`
+  parses host-span dumps and device traces with one parser;
+- when jax is already imported, the span body also runs under
+  `jax.profiler.TraceAnnotation`, so spans appear on the host lane of a
+  live device trace (and under `step_span`, `StepTraceAnnotation` gives
+  the profiler step boundaries for its per-step views);
+- nesting depth is tracked per-thread, so a collector dump renders as a
+  flame graph (perfetto nests by timestamps; depth is kept as an arg
+  for flat consumers).
+
+jax is NEVER imported by this module — only used if something else
+already did — so the obs package stays importable on artifact-only
+machines.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import gzip
+import json
+import os
+import sys
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Optional
+
+_tls = threading.local()
+
+
+def _depth() -> int:
+    return getattr(_tls, "depth", 0)
+
+
+class SpanCollector:
+    """Bounded buffer of finished spans (oldest dropped past capacity —
+    a long run must not grow host memory without bound)."""
+
+    def __init__(self, capacity: int = 20000):
+        self._spans: deque = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+
+    def add(self, name: str, wall_start: float, dur_s: float,
+            depth: int, **args) -> None:
+        with self._lock:
+            self._spans.append({
+                "ph": "X", "name": name, "pid": os.getpid(),
+                "tid": threading.get_ident(),
+                "ts": round(wall_start * 1e6, 3),   # perfetto: microseconds
+                "dur": round(dur_s * 1e6, 3),
+                "args": {"depth": depth, **args} if (args or depth)
+                        else {"depth": 0},
+            })
+
+    def __len__(self) -> int:
+        return len(self._spans)
+
+    def to_perfetto(self) -> Dict[str, Any]:
+        meta = [{"ph": "M", "name": "process_name", "pid": os.getpid(),
+                 "args": {"name": "proteinbert_tpu host spans"}}]
+        with self._lock:
+            return {"traceEvents": meta + list(self._spans)}
+
+    def dump(self, path: str) -> str:
+        """Write trace-event JSON (gzipped when the path ends in .gz) —
+        loadable by ui.perfetto.dev and tools/trace_attribution.py."""
+        data = json.dumps(self.to_perfetto())
+        if path.endswith(".gz"):
+            with gzip.open(path, "wt") as f:
+                f.write(data)
+        else:
+            with open(path, "w") as f:
+                f.write(data)
+        return path
+
+
+def _jax_annotation(name: str, step: Optional[int] = None):
+    """A TraceAnnotation context when jax is live, else a null context.
+    Checked through sys.modules: telemetry must not be the thing that
+    pays the jax import."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return contextlib.nullcontext()
+    try:
+        if step is not None:
+            return jax.profiler.StepTraceAnnotation(name, step_num=step)
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:
+        return contextlib.nullcontext()
+
+
+@contextlib.contextmanager
+def span(name: str, collector: Optional[SpanCollector] = None,
+         step: Optional[int] = None, **args):
+    """Nested host span: times the body, forwards to the jax profiler
+    when available, records into `collector` when given."""
+    depth = _depth()
+    _tls.depth = depth + 1
+    wall0 = time.time()
+    t0 = time.perf_counter()
+    try:
+        with _jax_annotation(name, step):
+            yield
+    finally:
+        _tls.depth = depth
+        if collector is not None:
+            dur = time.perf_counter() - t0
+            if step is not None:
+                args["step"] = step
+            collector.add(name, wall0, dur, depth, **args)
+
+
+def step_span(step: int, collector: Optional[SpanCollector] = None,
+              name: str = "train_step"):
+    """Span for one training step: uses StepTraceAnnotation so a live
+    device trace gets proper step boundaries."""
+    return span(name, collector=collector, step=step)

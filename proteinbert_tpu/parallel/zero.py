@@ -243,6 +243,18 @@ def collective_bytes_from_hlo(hlo_text: str) -> Dict[str, int]:
     return out
 
 
+def record_comm_metrics(registry, hlo_text: str) -> Dict[str, int]:
+    """Fold one compiled module's per-collective bytes into a telemetry
+    metrics registry (obs/metrics.py) as `collective_bytes{kind=...}`
+    gauges — so `bench.py --comm` evidence and any consumer of the
+    unified metrics stream read the SAME accounting instead of a
+    private dict. Returns the collective_bytes_from_hlo breakdown."""
+    out = collective_bytes_from_hlo(hlo_text)
+    for kind, n in out.items():
+        registry.gauge("collective_bytes", kind=kind).set(n)
+    return out
+
+
 def per_chip_state_bytes(mesh: Mesh, abstract_state: Any,
                          zero_update: bool = False) -> Dict[str, int]:
     """Per-chip persistent bytes of the train state under the sharding

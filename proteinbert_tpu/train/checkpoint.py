@@ -38,6 +38,13 @@ class Checkpointer:
 
     def __init__(self, directory: str, max_to_keep: int = 3, async_save: bool = True):
         self.directory = os.path.abspath(directory)
+        # Optional telemetry hook: callable(phase, step, **info), phase
+        # in obs.events.CKPT_PHASES ("dispatch" at save_staged,
+        # "landed" when a stage joins with its overlap_s, "save" for a
+        # direct synchronous save). The trainer points this at
+        # Telemetry.emit("ckpt_stage", ...); errors in the hook are
+        # logged, never allowed to fail a save.
+        self.on_event = None
         # Staged (overlapped) save slot: at most ONE in flight — the
         # double-buffer is {the device-side snapshot} + {the host copy
         # the stager fetches into}; a second boundary arriving while a
@@ -82,7 +89,18 @@ class Checkpointer:
 
         return self._saver.submit(run).result()
 
-    def save(self, step: int, state: Any, data_state: Optional[Dict] = None) -> bool:
+    def _notify(self, phase: str, step: int, **info) -> None:
+        cb = self.on_event
+        if cb is None:
+            return
+        try:
+            cb(phase, step, **info)
+        except Exception:
+            logger.exception("checkpoint on_event hook failed (phase=%s "
+                             "step=%d) — save path unaffected", phase, step)
+
+    def save(self, step: int, state: Any, data_state: Optional[Dict] = None,
+             _from_stage: bool = False) -> bool:
         """Returns orbax's outcome: False means the manager SILENTLY
         skipped (it does so for any step <= latest_step, not only
         exact duplicates) — callers that need the save to have
@@ -94,8 +112,14 @@ class Checkpointer:
         if data_state is not None:
             args["data"] = ocp.args.JsonSave(data_state)
         composite = ocp.args.Composite(**args)
-        return bool(self._on_saver(
+        saved = bool(self._on_saver(
             lambda: self._mngr.save(step, args=composite)))
+        if not _from_stage:
+            # Staged saves report through "dispatch"/"landed" instead
+            # (this synchronous-save event from the stager worker would
+            # double-count the boundary).
+            self._notify("save", step, saved=saved)
+        return saved
 
     # ---------------------------------------------- overlapped (staged) saves
 
@@ -131,10 +155,12 @@ class Checkpointer:
             t0 = time.perf_counter()
             try:
                 host_state = self._stage_fetch(snapshot)
-                holder["saved"] = self.save(step, host_state, data_state)
+                holder["saved"] = self.save(step, host_state, data_state,
+                                            _from_stage=True)
             finally:
                 holder["overlap_s"] = time.perf_counter() - t0
 
+        self._notify("dispatch", step)
         self._staged = (self._saver.submit(work), holder)
 
     def flush_staged(self) -> Optional[Dict[str, Any]]:
@@ -152,6 +178,9 @@ class Checkpointer:
                 "staged checkpoint save at step %d was SKIPPED by the "
                 "manager (directory already holds a step >= %d) — state "
                 "was NOT written", holder["step"], holder["step"])
+        self._notify("landed", holder["step"],
+                     saved=bool(holder.get("saved")),
+                     overlap_s=round(holder.get("overlap_s", 0.0), 6))
         return holder
 
     def poll_staged(self) -> Optional[Dict[str, Any]]:

@@ -157,6 +157,7 @@ def finetune(
     pretrained_trunk: Optional[Any] = None,
     checkpointer=None,                  # train.checkpoint.Checkpointer
     log_fn=None,
+    telemetry=None,                     # obs.Telemetry (None = no-op)
 ) -> Dict[str, Any]:
     """Epoch loop; returns {"state", "history", "best"}.
 
@@ -166,6 +167,9 @@ def finetune(
     model-selection design of the reference's sketch (reference
     utils.py:442-458).
     """
+    from proteinbert_tpu.obs import as_telemetry
+
+    tele = as_telemetry(telemetry)
     start_epoch = 0
     history: list = []
     best: Dict[str, Any] = {"epoch": -1, "score": -float("inf")}
@@ -191,6 +195,15 @@ def finetune(
             best = dict(data.get("best", best))
             logger.info("resumed fine-tune after epoch %d", start_epoch)
 
+    if tele.enabled:
+        import os
+
+        from proteinbert_tpu.configs.config import config_to_dict
+
+        tele.emit("run_start", step=start_epoch, kind="finetune",
+                  config=config_to_dict(cfg), jax_version=jax.__version__,
+                  pid=os.getpid(), resumed=bool(start_epoch))
+
     for epoch in range(start_epoch, cfg.task.epochs):
         # Same roundtrip batching as evaluate(): the per-step float(v)
         # fetches made every training step synchronous with the device —
@@ -210,13 +223,16 @@ def finetune(
             (epoch + 1) % cfg.task.eval_every_epochs == 0
             or epoch == cfg.task.epochs - 1
         ):
-            em = evaluate(state, eval_batches(), cfg)
+            with tele.span("finetune_eval", step=epoch + 1):
+                em = evaluate(state, eval_batches(), cfg)
             record.update({f"eval_{k}": v for k, v in em.items()})
+            tele.emit("eval", step=epoch + 1, metrics=em, kind="finetune")
             score = em.get("accuracy", -em.get("loss", float("inf")))
             if score > best["score"]:
                 best = {"epoch": epoch, "score": score, **record}
 
         history.append(record)
+        tele.emit("step", step=epoch + 1, metrics=record, kind="finetune")
         logger.info("finetune %s", record)
         if log_fn is not None:
             log_fn(epoch, record)
@@ -226,4 +242,8 @@ def finetune(
 
     if checkpointer is not None:
         checkpointer.wait()
+    # (emit sanitizes: a never-evaluated best's -inf score becomes null)
+    tele.emit("run_end", outcome="completed", kind="finetune",
+              perf={"best_epoch": best["epoch"],
+                    "best_score": best["score"]})
     return {"state": state, "history": history, "best": best}

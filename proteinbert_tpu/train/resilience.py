@@ -46,11 +46,20 @@ class GracefulShutdown:
     ...         if stop.requested: break
     """
 
-    def __init__(self, signals=(signal.SIGTERM, signal.SIGINT)):
+    def __init__(self, signals=(signal.SIGTERM, signal.SIGINT),
+                 on_signal=None):
         self._signals = signals
         self._previous: Dict[int, object] = {}
         self.requested = False
         self.signum: Optional[int] = None
+        # Optional callable(signum) run at the FIRST signal, inside the
+        # handler — the flight-recorder dump hook: even if the clean
+        # preemption path later wedges (a hung collective, a stuck
+        # stager join), forensics for the moment of the signal are
+        # already on disk. Must be cheap and must not raise; errors are
+        # swallowed so a broken hook cannot turn a clean preemption
+        # into a crash.
+        self._on_signal = on_signal
 
     def _handler(self, signum, frame):
         if self.requested:
@@ -64,6 +73,12 @@ class GracefulShutdown:
         logger.warning(
             "signal %s received: finishing current step, then "
             "checkpoint + clean exit", signum)
+        if self._on_signal is not None:
+            try:
+                self._on_signal(signum)
+            except Exception:
+                logger.exception("on_signal hook failed (continuing "
+                                 "with the clean preemption path)")
 
     def __enter__(self):
         for s in self._signals:

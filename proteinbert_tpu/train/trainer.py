@@ -25,6 +25,7 @@ import jax
 import numpy as np
 
 from proteinbert_tpu.configs import PretrainConfig
+from proteinbert_tpu.obs import as_telemetry
 from proteinbert_tpu.train import train_state as ts
 from proteinbert_tpu.train.checkpoint import Checkpointer
 from proteinbert_tpu.train.metrics import DeviceMetricAccumulator, StepTimer
@@ -92,6 +93,7 @@ def pretrain(
     mesh: Optional[jax.sharding.Mesh] = None,
     eval_batches=None,
     log_fn=None,
+    telemetry=None,
 ) -> Dict[str, Any]:
     """Run the pretraining loop; returns {"state", "history", "perf"}.
 
@@ -117,7 +119,13 @@ def pretrain(
         loop the reference's train/test dataloader split was built for
         but never ran, reference utils.py:71-107).
       log_fn: optional callable(step, metrics_dict) for external loggers.
+      telemetry: optional obs.Telemetry — structured run events
+        (run_start/step/ckpt_stage/eval/requeue/nan_halt/run_end),
+        metrics registry, and flight recorder. None = the NULL facade:
+        every instrumented site below becomes a no-op (~zero hot-path
+        cost — all emits sit at log/eval/boundary cadence anyway).
     """
+    tele = as_telemetry(telemetry)
     batches_consumed = 0
     # Eval-stream state. last_eval_loss feeds the eval-keyed plateau
     # (+inf = "no eval yet" — a fresh run replaces it with a seed eval
@@ -187,12 +195,14 @@ def pretrain(
         for _ in range(batches_consumed):
             next(batch_iterator)
 
+    prefetch_it = None
     if cfg.data.prefetch_depth > 0:
         # Hide host-side batch production (HDF5 reads, tokenization)
         # behind the asynchronously-dispatched device step.
         from proteinbert_tpu.data.prefetch import prefetch
 
-        batch_iterator = prefetch(batch_iterator, cfg.data.prefetch_depth)
+        batch_iterator = prefetch_it = prefetch(batch_iterator,
+                                                cfg.data.prefetch_depth)
 
     put = _make_batch_put(mesh)
 
@@ -259,6 +269,42 @@ def pretrain(
     start_step = int(state.step)
     history: list = []
 
+    if tele.enabled:
+        if checkpointer is not None:
+            # Checkpoint boundary lifecycle → ckpt_stage events, emitted
+            # from wherever the save runs (incl. the stager thread:
+            # EventLog is thread-safe).
+            checkpointer.on_event = (
+                lambda phase, save_step, **info:
+                tele.emit("ckpt_stage", step=save_step, phase=phase, **info))
+        from proteinbert_tpu.configs.config import config_to_dict
+
+        tele.emit(
+            "run_start", step=start_step, config=config_to_dict(cfg),
+            jax_version=jax.__version__, pid=os.getpid(),
+            mesh=({str(k): int(v) for k, v in mesh.shape.items()}
+                  if mesh is not None else None),
+            n_chips=(int(mesh.size) if mesh is not None
+                     else jax.device_count()),
+            resumed=bool(batches_consumed), zero_update=bool(zero_on),
+        )
+        if mesh is not None:
+            # Per-chip persistent state bytes under the sharding rules
+            # (the ZeRO-1 HBM claim, from shapes alone — no allocation).
+            try:
+                from proteinbert_tpu.parallel.zero import per_chip_state_bytes
+
+                abstract = jax.tree.map(
+                    lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), state)
+                for part, n in per_chip_state_bytes(
+                        mesh, abstract,
+                        zero_update=cfg.parallel.zero_update).items():
+                    tele.metrics.gauge(
+                        "per_chip_state_bytes", part=part).set(n)
+            except Exception:
+                logger.debug("per-chip state-bytes gauge failed",
+                             exc_info=True)
+
     if eval_keyed_plateau and not np.isfinite(last_eval_loss):
         # Seed the plateau stream with ONE up-front eval bracket
         # (ADVICE r4): without it, the pre-first-eval steps feed TRAIN
@@ -273,6 +319,7 @@ def pretrain(
         last_eval_loss = np.float32(em["eval_loss"])
         best_eval_loss = min(best_eval_loss, float(em["eval_loss"]))
         history.append({"step": start_step, **em})
+        tele.emit("eval", step=start_step, metrics=em, seed=True)
         logger.info("seed eval at step %d: eval loss %.4f (plateau "
                     "baseline)", start_step, em["eval_loss"])
         if log_fn is not None:
@@ -395,6 +442,7 @@ def pretrain(
         em, _, _ = resolve_eval(handle)
         timer.discount(time.perf_counter() - t0)
         history.append({"step": e_step, **em})
+        tele.emit("eval", step=e_step, metrics=em, overlapped=True)
         logger.info(
             "step %d eval loss %.4f (local %.4f global %.4f) acc %.3f",
             e_step, em["eval_loss"], em["eval_local_loss"],
@@ -423,7 +471,10 @@ def pretrain(
         logger.warning("FAULT INJECTION ACTIVE: %.1fs stall per eval "
                        "bracket (PBT_FAULT_EVAL_STALL)", fault_eval_stall)
 
-    with GracefulShutdown() as stop:
+    with GracefulShutdown(
+        on_signal=((lambda signum: tele.dump_flight(f"signal_{signum}"))
+                   if tele.enabled else None)
+    ) as stop:
       for step in range(start_step, cfg.train.max_steps):
         batch = next(batch_iterator)
         if fault_stall and step + 1 == fault_stall[0]:
@@ -469,6 +520,10 @@ def pretrain(
                     stats.get("peak_bytes_in_use", 0) / 1e9,
                     stats.get("bytes_limit", 0) / 1e9,
                 )
+                for k in ("bytes_in_use", "peak_bytes_in_use",
+                          "bytes_limit"):
+                    if k in stats:
+                        tele.metrics.gauge(f"hbm_{k}").set(stats[k])
 
         if cfg.train.log_every and (step + 1) % cfg.train.log_every == 0:
             # ONE device_get for the whole metrics dict (per-key float()
@@ -497,6 +552,8 @@ def pretrain(
                     diagnostic_saved = True
                     logger.warning("non-finite state preserved in %s",
                                    checkpointer.directory + "-diagnostic")
+                tele.emit("nan_halt", step=step + 1, metrics=m,
+                          mode=cfg.train.on_nan)
                 if cfg.train.on_nan == "halt":
                     # About to raise: a staged snapshot mid-fetch is the
                     # newest durable state a requeued run could resume
@@ -504,6 +561,9 @@ def pretrain(
                     # NaN stays the reported cause).
                     flush_inflight_checkpoint(checkpointer,
                                               "non-finite halt")
+                    tele.emit("run_end", step=step + 1, outcome="nan_halt",
+                              perf=timer.summary())
+                    tele.dump_flight("nan_halt")
                 # Raises in halt mode; logs the warning in warn mode.
                 check_finite(m, step + 1, mode=cfg.train.on_nan)
             harvest_staged()  # completed overlap lands in this record
@@ -518,6 +578,30 @@ def pretrain(
                                             or ckpt_since_log)
                 ckpt_since_log = False
             history.append({"step": step + 1, **m})
+            if tele.enabled:
+                # All telemetry sits at log cadence — the per-step hot
+                # path stays untouched (overhead <1% of a log interval,
+                # ~0 of a step).
+                extra = {}
+                reg = tele.metrics
+                if prefetch_it is not None:
+                    extra["data_wait_s"] = round(prefetch_it.wait_s, 4)
+                    reg.gauge("data_wait_seconds").set(prefetch_it.wait_s)
+                    reg.gauge("data_batches_total").set(prefetch_it.batches)
+                try:
+                    import resource
+                    import sys as _sys
+
+                    # ru_maxrss: kilobytes on Linux, BYTES on macOS.
+                    rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+                    rss *= 1 if _sys.platform == "darwin" else 1024
+                    extra["host_max_rss_bytes"] = rss
+                    reg.gauge("host_max_rss_bytes").set(rss)
+                except Exception:
+                    pass  # non-POSIX host: RSS gauge just absent
+                tele.emit("step", step=step + 1, metrics=m, **extra)
+                reg.counter("steps_total").inc(cfg.train.log_every)
+                reg.set_many(m)  # loss/acc + StepTimer summary as gauges
             logger.info(
                 "step %d loss %.4f (local %.4f global %.4f) acc %.3f %s",
                 step + 1, m["loss"], m["local_loss"], m["global_loss"],
@@ -548,6 +632,12 @@ def pretrain(
                 checkpointer.wait()
             logger.warning("preempted at step %d: %s, exiting", step + 1,
                            "state saved" if saved else "state NOT saved")
+            tele.emit("requeue", step=step + 1,
+                      reason=f"signal_{stop.signum}", saved=saved)
+            # Second, fuller dump (the signal-time one fired mid-step):
+            # now the flush/save outcome and the requeue record are in
+            # the ring — the picture a post-mortem actually wants.
+            tele.dump_flight(f"signal_{stop.signum}")
             preempted = True
             break
 
@@ -589,9 +679,11 @@ def pretrain(
             else:
                 # Key the eval by the 1-based step recorded in history,
                 # so `evaluate --like-step <history step>` reproduces it.
-                em = _evaluate(state, eval_batches(), put, cfg, step + 1)
+                with tele.span("eval_bracket", step=step + 1):
+                    em = _evaluate(state, eval_batches(), put, cfg, step + 1)
                 timer.discount(time.perf_counter() - t_eval)
                 history.append({"step": step + 1, **em})
+                tele.emit("eval", step=step + 1, metrics=em)
                 logger.info(
                     "step %d eval loss %.4f (local %.4f global %.4f) "
                     "acc %.3f",
@@ -639,10 +731,11 @@ def pretrain(
                 # values belong in this boundary's data_state (resume
                 # must restore them byte-identically).
                 resolve_pending_eval()
-                flush_staged_overlap()  # backpressure: one stage in flight
-                snap = ts.snapshot_train_state(state)
-                checkpointer.save_staged(step + 1, snap,
-                                         data_state_for(step + 1))
+                with tele.span("ckpt_boundary_staged", step=step + 1):
+                    flush_staged_overlap()  # backpressure: one stage in flight
+                    snap = ts.snapshot_train_state(state)
+                    checkpointer.save_staged(step + 1, snap,
+                                             data_state_for(step + 1))
                 ckpt_since_log = True
                 # Deliberately NOT discounted: the snapshot dispatch +
                 # thread handoff are the boundary's only in-window cost
@@ -657,7 +750,8 @@ def pretrain(
                 # deflate the window when a later sync() extends it.
                 drain_and_sync()
                 t_save = time.perf_counter()
-                checked_save(step + 1, state)
+                with tele.span("ckpt_boundary_sync", step=step + 1):
+                    checked_save(step + 1, state)
                 ckpt_since_log = True
                 timer.discount(time.perf_counter() - t_save)
 
@@ -672,7 +766,13 @@ def pretrain(
                 checked_save(cfg.train.max_steps, state)
             checkpointer.wait()
 
-    return {"state": state, "history": history, "perf": timer.summary(),
+    perf = timer.summary()
+    tele.emit("run_end", step=int(state.step),
+              outcome=("preempted" if preempted
+                       else "early_stopped" if early_stopped
+                       else "completed"),
+              perf=perf)
+    return {"state": state, "history": history, "perf": perf,
             "preempted": preempted, "early_stopped": early_stopped}
 
 

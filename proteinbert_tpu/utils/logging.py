@@ -46,8 +46,15 @@ def start_log(
         os.makedirs(log_dir, exist_ok=True)
         name = (f"{log_file_prefix}.{os.getpid()}.log" if pid_stamp
                 else f"{log_file_prefix}.log")
-        path = os.path.join(log_dir, name)
-        fh = logging.FileHandler(path)
-        fh.setFormatter(logging.Formatter(_FMT))
-        _LOGGER.addHandler(fh)
+        path = os.path.abspath(os.path.join(log_dir, name))
+        # Idempotent: a repeated start_log() with the same log_dir must
+        # not attach a SECOND FileHandler for the same file (every line
+        # was written twice per extra call — e.g. cli main()'s start_log
+        # followed by a library consumer calling it again).
+        if not any(isinstance(h, logging.FileHandler)
+                   and getattr(h, "baseFilename", None) == path
+                   for h in _LOGGER.handlers):
+            fh = logging.FileHandler(path)
+            fh.setFormatter(logging.Formatter(_FMT))
+            _LOGGER.addHandler(fh)
     return path

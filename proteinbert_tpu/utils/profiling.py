@@ -38,38 +38,31 @@ class TimeMeasure:
 
 
 class Profiler:
-    """Named aggregating profiler: total time + invoke count per name."""
+    """Named aggregating profiler — now a thin shim over the telemetry
+    metrics registry (obs/metrics.py), which absorbed the host-timer
+    aggregation this class used to hold privately. The API is unchanged
+    (`measure`/`summary`/`report`), and existing call sites keep
+    working; pass a shared `registry` to fold a Profiler's sections
+    into a run's unified metrics stream instead of a private one."""
 
-    def __init__(self):
-        self._totals: Dict[str, float] = {}
-        self._counts: Dict[str, int] = {}
+    def __init__(self, registry=None):
+        from proteinbert_tpu.obs.metrics import MetricsRegistry
 
-    @contextlib.contextmanager
+        self._reg = registry if registry is not None else MetricsRegistry()
+
     def measure(self, name: str):
-        t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            dt = time.perf_counter() - t0
-            self._totals[name] = self._totals.get(name, 0.0) + dt
-            self._counts[name] = self._counts.get(name, 0) + 1
+        return self._reg.timer(name)
 
     def summary(self) -> Dict[str, Dict[str, float]]:
-        return {
-            name: {
-                "total_s": self._totals[name],
-                "count": self._counts[name],
-                "mean_s": self._totals[name] / self._counts[name],
-            }
-            for name in self._totals
-        }
+        return self._reg.timer_summary()
 
     def report(self) -> str:
-        rows = sorted(self._totals.items(), key=lambda kv: -kv[1])
+        rows = sorted(self.summary().items(),
+                      key=lambda kv: -kv[1]["total_s"])
         return "\n".join(
-            f"{name}: {total:.3f}s / {self._counts[name]} calls "
-            f"({total / self._counts[name] * 1e3:.2f} ms each)"
-            for name, total in rows
+            f"{name}: {s['total_s']:.3f}s / {s['count']} calls "
+            f"({s['mean_s'] * 1e3:.2f} ms each)"
+            for name, s in rows
         )
 
 

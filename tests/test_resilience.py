@@ -134,6 +134,66 @@ def test_finetune_resume(tmp_path, rng):
     ck3.close()
 
 
+def test_sigterm_mid_staged_checkpoint_dumps_flight(tmp_path):
+    """ISSUE 3 flight-recorder signal path: SIGTERM while a STAGED
+    checkpoint save is still in flight must leave a valid flight dump
+    whose events include the in-flight stage's dispatch — and the
+    preemption must still land the stage + save cleanly (the existing
+    contract)."""
+    import dataclasses
+    import json
+    import time
+
+    from proteinbert_tpu import obs
+    from proteinbert_tpu.configs import CheckpointConfig
+
+    cfg = _cfg()
+    cfg = cfg.replace(checkpoint=dataclasses.replace(
+        CheckpointConfig(), directory=str(tmp_path / "ck"),
+        every_steps=4, overlap=True))
+
+    class SlowStageCheckpointer(Checkpointer):
+        # Stretch the device→host fetch so the step-4 stage is still in
+        # flight when the SIGTERM lands at the step-6 log point (two
+        # ~ms steps later — 0.6s is ample margin without bloating the
+        # tier-1 wall budget).
+        def _stage_fetch(self, snapshot):
+            time.sleep(0.6)
+            return super()._stage_fetch(snapshot)
+
+    ck = SlowStageCheckpointer(cfg.checkpoint.directory, async_save=False)
+    tele = obs.Telemetry(events_path=str(tmp_path / "ev.jsonl"),
+                         flight_dir=str(tmp_path))
+    fired = []
+
+    def send_signal(step, m):
+        if step == 6 and not fired:
+            assert ck.staged_in_flight(), "drill setup: stage already landed"
+            fired.append(step)
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    out = pretrain(cfg, _iterator(), checkpointer=ck, log_fn=send_signal,
+                   telemetry=tele)
+    ck.close()
+    tele.close()
+    assert out["preempted"] is True
+
+    payload = json.load(open(obs.flight_path(str(tmp_path))))
+    obs.validate_flight_dump(payload)
+    assert payload["reason"].startswith("signal_")
+    kinds = [(r["event"], r.get("phase")) for r in payload["events"]]
+    # The in-flight stage's dispatch is in the forensics...
+    assert ("ckpt_stage", "dispatch") in kinds
+    # ...and so are its landing (flushed on the preemption path) and the
+    # requeue record itself.
+    assert ("ckpt_stage", "landed") in kinds
+    assert any(r["event"] == "requeue" and r["reason"] == "signal_15"
+               for r in payload["events"])
+    # The events stream tells the same story and still validates.
+    recs = obs.read_events(str(tmp_path / "ev.jsonl"), strict=True)
+    assert any(r["event"] == "requeue" for r in recs)
+
+
 def test_multihost_noop_single_host():
     from proteinbert_tpu.parallel import maybe_initialize_distributed
 

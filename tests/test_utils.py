@@ -68,6 +68,34 @@ def test_profiler_and_time_measure():
     assert tm.elapsed is not None and tm.elapsed >= 0
 
 
+def test_start_log_idempotent_file_handler(tmp_path):
+    """Regression (ISSUE 3 satellite): repeated start_log() with the
+    same log_dir attached a SECOND FileHandler — every line then landed
+    twice in the file."""
+    import logging as pylogging
+
+    from proteinbert_tpu.utils.logging import _LOGGER, log, start_log
+
+    try:
+        p1 = start_log(log_dir=str(tmp_path), pid_stamp=False)
+        n_handlers = len(_LOGGER.handlers)
+        p2 = start_log(log_dir=str(tmp_path), pid_stamp=False)
+        assert p1 == p2
+        assert len(_LOGGER.handlers) == n_handlers  # no double handler
+        log("once-only-marker")
+        with open(p1) as f:
+            assert f.read().count("once-only-marker") == 1
+        # A DIFFERENT directory is a new sink, not a duplicate.
+        other = tmp_path / "other"
+        start_log(log_dir=str(other), pid_stamp=False)
+        assert len(_LOGGER.handlers) == n_handlers + 1
+    finally:
+        for h in list(_LOGGER.handlers):
+            if isinstance(h, pylogging.FileHandler):
+                _LOGGER.removeHandler(h)
+                h.close()
+
+
 def test_transpose_dataset(tmp_path):
     import h5py
 

@@ -23,6 +23,15 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
 rc=${PIPESTATUS[0]}
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
 
+# Events-schema validator self-test (ISSUE 3 satellite): every telemetry
+# event type must round-trip the validator, and garbage must be
+# rejected. Stdlib-only (<2 s, no jax) — runs even when the pytest tier
+# timed out, and its failure fails the gate.
+echo "=== telemetry events-schema validator self-test ==="
+python "$(dirname "$0")/validate_events.py" --self-test
+rcv=$?
+[ "$rc" -eq 0 ] && rc=$rcv
+
 if [ "$POD64" = "1" ]; then
   echo "=== pod64 tier (64 virtual devices, opt-in) ==="
   timeout -k 10 2700 env JAX_PLATFORMS=cpu PBT_RUN_TIER64=1 \

@@ -98,6 +98,31 @@ def refresh_last_good_stamp():
         pass
 
 
+# Status transitions also land on a telemetry events stream (obs.events
+# `note` records) beside the status mirror — so `pbt diagnose` /
+# tools/validate_events.py read the watcher's history in the SAME format
+# as training runs, instead of this tool keeping a private one. The
+# mirror file stays (cheap point-in-time polling); the stream adds the
+# ordered history a post-mortem wants. Keyed by STATUS_PATH's directory
+# so tests that repoint the mirror repoint the stream too.
+_EVENT_LOGS = {}
+
+
+def _event_log():
+    path = os.path.join(os.path.dirname(os.path.abspath(STATUS_PATH)),
+                        "tpu_watch_events.jsonl")
+    log = _EVENT_LOGS.get(path)
+    if log is None:
+        try:
+            from proteinbert_tpu.obs.events import EventLog
+
+            log = _EVENT_LOGS[path] = EventLog(path)
+        except Exception as e:  # best-effort, like the status mirror
+            print(f"[tpu_watch] events stream unavailable: {e}", flush=True)
+            _EVENT_LOGS[path] = False
+    return log or None
+
+
 def put_status(**kv):
     kv["at"] = time.strftime("%Y-%m-%dT%H:%M:%S%z")
     kv["pid"] = os.getpid()  # lets the single-instance guard see us
@@ -108,6 +133,12 @@ def put_status(**kv):
         atomic_json_dump(kv, STATUS_PATH)
     except OSError as e:  # status mirror is best-effort; never die on it
         print(f"[tpu_watch] could not write status: {e}", flush=True)
+    ev = _event_log()
+    if ev is not None:
+        # The bench record is already persisted in bench_last_tpu.json;
+        # keep the stream lean.
+        ev.emit("note", source="tpu_watch",
+                **{k: v for k, v in kv.items() if k != "record"})
 
 
 def probe():

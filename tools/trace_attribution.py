@@ -52,13 +52,25 @@ def categorize(name: str) -> str:
 
 
 def parse_trace(trace_dir: str):
-    """{op name: total device-lane µs} from the newest trace.json.gz."""
-    paths = sorted(glob.glob(os.path.join(
-        trace_dir, "**", "*.trace.json.gz"), recursive=True),
-        key=os.path.getmtime)
+    """{op name: total device-lane µs} from the newest trace-event JSON
+    — a jax.profiler `*.trace.json.gz`, or a host-span dump written by
+    `obs.tracing.SpanCollector` (`*.trace.json`, optionally .gz): both
+    carry the same Perfetto `traceEvents` format, so the telemetry
+    subsystem's span dumps and real device traces share one parser. A
+    file path is parsed directly; a directory is globbed."""
+    if os.path.isfile(trace_dir):
+        paths = [trace_dir]
+    else:
+        paths = sorted(
+            glob.glob(os.path.join(trace_dir, "**", "*.trace.json.gz"),
+                      recursive=True)
+            + glob.glob(os.path.join(trace_dir, "**", "*.trace.json"),
+                        recursive=True),
+            key=os.path.getmtime)
     if not paths:
-        raise SystemExit(f"no trace.json.gz under {trace_dir}")
-    with gzip.open(paths[-1], "rt") as f:
+        raise SystemExit(f"no *.trace.json(.gz) under {trace_dir}")
+    opener = gzip.open if paths[-1].endswith(".gz") else open
+    with opener(paths[-1], "rt") as f:
         data = json.load(f)
     events = data.get("traceEvents", [])
     # Lane discovery. Summing every span in a device pid double-counts:
@@ -88,6 +100,15 @@ def parse_trace(trace_dir: str):
         if device_pids:
             print("note: no TPU lane; attributing the host CPU lane",
                   file=sys.stderr)
+    span_dump = False
+    if not device_pids:
+        # Host-span dump (obs.tracing): a single "host spans" process —
+        # attribute every lane present, by SELF time (see below).
+        device_pids = {e.get("pid") for e in events if e.get("ph") == "X"}
+        span_dump = True
+        if device_pids:
+            print("note: no device/CPU lane; attributing all span lanes "
+                  "by self-time (host-span dump)", file=sys.stderr)
     op_lanes = {(p, t) for (p, t), n in tid_name.items()
                 if p in device_pids and "xla ops" in n.lower()}
 
@@ -102,18 +123,43 @@ def parse_trace(trace_dir: str):
                  "collectgarbage", "lower_sharding", "trace_to_jaxpr",
                  "compile")
     per_op: dict = collections.Counter()
-    for e in events:
-        if e.get("ph") != "X" or not in_scope(e):
-            continue
-        name = e.get("name", "?")
-        low = name.lower()
-        # Host python frames / runtime wrapper spans / "end:" markers
-        # enclose the op events — counting them double-counts the step.
-        if (name.startswith("$") or ".py:" in name
-                or name.startswith("end:")
-                or any(w in low for w in _WRAPPERS)):
-            continue
-        per_op[name] += e.get("dur", 0)
+    if span_dump:
+        # Host spans NEST (obs.tracing tracks depth): summing raw
+        # durations counts a parent's time once for itself and again
+        # for every child. Attribute SELF time instead — each span's
+        # duration minus its enclosed spans' — via an interval stack
+        # per thread lane.
+        by_tid: dict = {}
+        for e in events:
+            if e.get("ph") == "X" and in_scope(e):
+                by_tid.setdefault((e.get("pid"), e.get("tid")),
+                                  []).append(e)
+        for evs in by_tid.values():
+            evs.sort(key=lambda e: (e.get("ts", 0), -e.get("dur", 0)))
+            stack = []  # (end_ts, name) of still-open enclosing spans
+            for e in evs:
+                ts, dur = e.get("ts", 0), e.get("dur", 0)
+                while stack and stack[-1][0] <= ts:
+                    stack.pop()
+                name = e.get("name", "?")
+                per_op[name] += dur
+                if stack:
+                    per_op[stack[-1][1]] -= dur  # carve out of parent
+                stack.append((ts + dur, name))
+    else:
+        for e in events:
+            if e.get("ph") != "X" or not in_scope(e):
+                continue
+            name = e.get("name", "?")
+            low = name.lower()
+            # Host python frames / runtime wrapper spans / "end:"
+            # markers enclose the op events — counting them
+            # double-counts the step.
+            if (name.startswith("$") or ".py:" in name
+                    or name.startswith("end:")
+                    or any(w in low for w in _WRAPPERS)):
+                continue
+            per_op[name] += e.get("dur", 0)
     if not per_op:
         lanes = sorted(set(pid_name.values()))
         raise SystemExit(

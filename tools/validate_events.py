@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""Telemetry events-schema validator (CI/tooling satellite, ISSUE 3).
+
+Validates an events JSONL (every line against obs.events.validate_record,
+plus per-stream seq monotonicity) and, optionally, a flight-recorder
+dump. `--self-test` round-trips one synthetic record of EVERY event type
+through the validator — and asserts a deliberately broken record fails —
+so a schema/fixture drift breaks CI immediately; tools/run_tier1.sh runs
+it after the pytest tier.
+
+No jax import (the obs package is stdlib-only): artifacts validate on
+any machine.
+
+Usage:
+  python tools/validate_events.py events.jsonl [--flight flight_123.json]
+  python tools/validate_events.py --self-test
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from proteinbert_tpu.obs.events import (  # noqa: E402
+    EVENT_FIELDS, make_example, validate_record,
+)
+from proteinbert_tpu.obs.flight import validate_flight_dump  # noqa: E402
+
+
+def self_test() -> int:
+    for event in sorted(EVENT_FIELDS):
+        rec = make_example(event)
+        try:
+            validate_record(rec)
+            # And through a JSON round trip, like real consumers see it.
+            validate_record(json.loads(json.dumps(rec)))
+        except ValueError as e:
+            print(f"SELF-TEST FAIL: example {event!r} does not validate: {e}")
+            return 1
+    # Negative control: the validator must actually reject garbage.
+    bad = [
+        {"v": 99, "event": "step", "seq": 0, "t": 0.0,
+         "step": 1, "metrics": {}},
+        {"v": 1, "event": "no_such_event", "seq": 0, "t": 0.0},
+        {"v": 1, "event": "step", "seq": 0, "t": 0.0},  # missing fields
+        {"v": 1, "event": "ckpt_stage", "seq": 0, "t": 0.0,
+         "step": 1, "phase": "bogus"},
+        {"v": 1, "event": "run_end", "seq": -1, "t": 0.0,
+         "outcome": "completed", "perf": {}},
+    ]
+    for rec in bad:
+        try:
+            validate_record(rec)
+        except ValueError:
+            continue
+        print(f"SELF-TEST FAIL: accepted invalid record {rec!r}")
+        return 1
+    print(f"self-test OK: {len(EVENT_FIELDS)} event types round-trip, "
+          f"{len(bad)} invalid records rejected")
+    return 0
+
+
+def validate_file(path: str) -> int:
+    errors = 0
+    count = 0
+    last_seq: dict = {}
+    with open(path) as f:
+        for lineno, line in enumerate(f, start=1):
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError as e:
+                print(f"{path}:{lineno}: not JSON: {e}")
+                errors += 1
+                continue
+            try:
+                validate_record(rec)
+            except ValueError as e:
+                print(f"{path}:{lineno}: {e}")
+                errors += 1
+                continue
+            # seq must be monotonic within one emitting process; seq 0
+            # legitimately restarts the stream (a requeued run appends
+            # its fresh run_start to the same file).
+            prev = last_seq.get("run")
+            if prev is not None and rec["seq"] <= prev and rec["seq"] != 0:
+                print(f"{path}:{lineno}: seq {rec['seq']} not > previous "
+                      f"{prev} (and not a fresh stream)")
+                errors += 1
+            last_seq["run"] = rec["seq"]
+            count += 1
+    print(f"{path}: {count} records, {errors} errors")
+    return 1 if errors else 0
+
+
+def validate_flight(path: str) -> int:
+    with open(path) as f:
+        try:
+            payload = json.load(f)
+        except ValueError as e:
+            print(f"{path}: not JSON: {e}")
+            return 1
+    try:
+        validate_flight_dump(payload)
+    except ValueError as e:
+        print(f"{path}: invalid flight dump: {e}")
+        return 1
+    print(f"{path}: valid flight dump ({len(payload['events'])} events, "
+          f"reason={payload['reason']!r})")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("events", nargs="?", help="events JSONL to validate")
+    ap.add_argument("--flight", help="flight-recorder dump to validate")
+    ap.add_argument("--self-test", action="store_true",
+                    help="validate the schema fixtures themselves")
+    args = ap.parse_args(argv)
+    if args.self_test:
+        return self_test()
+    if not args.events and not args.flight:
+        ap.error("give an events JSONL, --flight, or --self-test")
+    rc = 0
+    if args.events:
+        rc |= validate_file(args.events)
+    if args.flight:
+        rc |= validate_flight(args.flight)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

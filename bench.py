@@ -475,7 +475,13 @@ def run_variant(index, on_tpu):
     }
     dt = time_step(cfg, batch_np, steps)
     res_per_sec = batch * seq_len / dt
-    mfu = train_flops(model, batch, seq_len) / dt / peak_flops_per_chip()
+    # MFU from the ACTUAL per-batch FLOPs (non-pad tokens), not the
+    # padded shape — identical for this all-real synthetic batch, but
+    # the denominator is now honest for any future padded row (the
+    # --pack bench relies on the same fix).
+    mfu = (train_flops(model, batch, seq_len,
+                       nonpad_tokens=int((batch_np["tokens"] != 0).sum()))
+           / dt / peak_flops_per_chip())
     print(f"variant={name} seq={seq_len} batch={batch}: "
           f"{dt * 1e3:.1f} ms/step "
           f"res/s={res_per_sec:,.0f} MFU={mfu:.3f}", file=sys.stderr)
@@ -640,6 +646,121 @@ def run_boundary():
     print(json.dumps(record))
 
 
+def run_pack():
+    """`bench.py --pack`: packed vs unpacked pretraining throughput on a
+    realistic UniRef-like length distribution — one JSON line, CPU-
+    measurable (ISSUE 4 acceptance).
+
+    Two iterators over the SAME synthetic corpus (lognormal lengths,
+    median ~350) at the SAME batch shape (B, L): the plain padded
+    iterator and the segment-aware packed one (data/packing.py). Each
+    mode times its own jitted train step and reports BOTH raw
+    residues/s (B·L positions per second — the number that flatters
+    padding) and pad-adjusted EFFECTIVE residues/s (non-pad tokens per
+    second — the number that measures useful work). MFU likewise comes
+    in raw (padded-shape FLOPs) and effective (actual per-batch FLOPs,
+    train_flops(..., nonpad_tokens=...) — the satellite's honest-MFU
+    fix) flavors. The capture is mirrored as a `note` event on the
+    bench event stream (bench_events.jsonl), like the TPU sweeps.
+
+    Knobs: PBT_PACK_BENCH_SEQ_LEN (default 1024), PBT_PACK_BENCH_BATCH
+    (8), PBT_PACK_BENCH_DIM (64; plumbing tests shrink it),
+    PBT_PACK_BENCH_STEPS (5), PBT_PACK_BENCH_MEDIAN_LEN (350).
+    """
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS", "") != "tpu":
+        force_cpu_backend()
+    enable_compile_cache()
+
+    from proteinbert_tpu.configs import (
+        DataConfig, ModelConfig, OptimizerConfig, PretrainConfig,
+        TrainConfig,
+    )
+    from proteinbert_tpu.data import (
+        InMemoryPretrainingDataset, make_packed_iterator,
+        make_pretrain_iterator,
+    )
+    from proteinbert_tpu.train import create_train_state, train_step
+    from proteinbert_tpu.train.metrics import (
+        peak_flops_per_chip, train_flops,
+    )
+
+    seq_len = int(os.environ.get("PBT_PACK_BENCH_SEQ_LEN", 1024))
+    batch = int(os.environ.get("PBT_PACK_BENCH_BATCH", 8))
+    dim = int(os.environ.get("PBT_PACK_BENCH_DIM", 64))
+    steps = int(os.environ.get("PBT_PACK_BENCH_STEPS", 5))
+    median = int(os.environ.get("PBT_PACK_BENCH_MEDIAN_LEN", 350))
+
+    model = ModelConfig(local_dim=dim, global_dim=2 * dim, key_dim=16,
+                        num_heads=4, num_blocks=2,
+                        num_annotations=max(8 * dim, 256), dtype="float32")
+    cfg = PretrainConfig(
+        model=model,
+        data=DataConfig(seq_len=seq_len, batch_size=batch),
+        optimizer=OptimizerConfig(warmup_steps=10),
+        train=TrainConfig(max_steps=steps))
+
+    # UniRef-like lengths: lognormal with the requested median, clipped
+    # to the crop cap (sequences longer than seq_len-2 pack alone).
+    rng = np.random.default_rng(0)
+    n = max(64 * batch, 512)
+    lengths = np.clip(
+        rng.lognormal(mean=np.log(median), sigma=0.6, size=n),
+        20, 4 * median).astype(np.int64)
+    from proteinbert_tpu.data.vocab import ALPHABET
+
+    alphabet = np.array(list(ALPHABET))
+    seqs = ["".join(rng.choice(alphabet, size=int(L))) for L in lengths]
+    ann = (rng.random((n, model.num_annotations)) < 0.01).astype(np.float32)
+    ds = InMemoryPretrainingDataset(seqs, ann, seq_len)
+
+    def measure(batch_np):
+        dt = time_step(cfg, batch_np, steps)
+        nonpad = int((batch_np["tokens"] != 0).sum())
+        total = batch_np["tokens"].size
+        peak = peak_flops_per_chip()
+        return {
+            "ms_per_step": round(dt * 1e3, 2),
+            "pad_fraction": round(1.0 - nonpad / total, 4),
+            "raw_residues_per_sec": round(total / dt, 1),
+            "effective_residues_per_sec": round(nonpad / dt, 1),
+            "mfu_raw": round(
+                train_flops(model, batch, seq_len) / dt / peak, 4),
+            "mfu_effective": round(
+                train_flops(model, batch, seq_len, nonpad_tokens=nonpad)
+                / dt / peak, 4),
+        }
+
+    unpacked = measure(next(make_pretrain_iterator(ds, batch, seed=0)))
+    packed = measure(next(make_packed_iterator(ds, batch, seed=0)))
+    record = {
+        "metric": "packed_throughput",
+        "platform": jax.devices()[0].platform,
+        "seq_len": seq_len, "batch": batch, "model_dim": dim,
+        "median_len": median,
+        "unpacked": unpacked,
+        "packed": packed,
+        "effective_speedup_x": round(
+            packed["effective_residues_per_sec"]
+            / max(unpacked["effective_residues_per_sec"], 1e-9), 2),
+    }
+    try:  # mirror onto the shared bench event stream (best-effort)
+        from proteinbert_tpu.obs.events import EventLog
+
+        ev = EventLog(os.path.join(os.path.dirname(LAST_GOOD_PATH),
+                                   "bench_events.jsonl"))
+        ev.emit("note", source="bench", kind="pack_capture",
+                platform=record["platform"], seq_len=seq_len, batch=batch,
+                effective_speedup_x=record["effective_speedup_x"],
+                packed_pad_fraction=packed["pad_fraction"],
+                unpacked_pad_fraction=unpacked["pad_fraction"])
+        ev.close()
+    except Exception as e:
+        print(f"bench events stream unavailable: {e}", file=sys.stderr)
+    print(json.dumps(record))
+
+
 def run_comm():
     """`bench.py --comm`: per-step collective bytes + per-chip state
     bytes, replicated vs ZeRO-1 zero-update, on a CPU-virtual mesh —
@@ -791,6 +912,12 @@ def main():
                          "boundary (sync vs overlapped) on CPU and emit "
                          "one JSON line — the overlap win, CI-measurable "
                          "without a TPU")
+    ap.add_argument("--pack", action="store_true",
+                    help="measure packed vs unpacked throughput (raw AND "
+                         "pad-adjusted effective residues/s, raw AND "
+                         "effective MFU) on a realistic length "
+                         "distribution and emit one JSON line — "
+                         "CI-measurable without a TPU")
     ap.add_argument("--comm", action="store_true",
                     help="compile the train step replicated vs ZeRO-1 "
                          "zero-update on a CPU-virtual mesh and emit one "
@@ -801,6 +928,10 @@ def main():
 
     if cli.boundary:
         run_boundary()
+        return
+
+    if cli.pack:
+        run_pack()
         return
 
     if cli.comm:

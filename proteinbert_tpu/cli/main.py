@@ -241,7 +241,27 @@ def cmd_pretrain(args) -> int:
         mesh = make_mesh(cfg.mesh)
         log(f"mesh: {dict(mesh.shape)} over {mesh.size} devices")
 
-    if cfg.data.buckets:
+    # `tele` is assigned below; the factories read it at CALL time
+    # (inside pretrain), so the pad_fraction/dropped-row metrics land in
+    # the run's own registry when --events-jsonl telemetry is on.
+    reg = lambda: tele.metrics if tele is not None else None  # noqa: E731
+    if cfg.data.packing and cfg.data.buckets:
+        raise SystemExit("data.packing and data.buckets are mutually "
+                         "exclusive — pick one padding strategy")
+    if cfg.data.packing:
+        from proteinbert_tpu.data.packing import make_packed_iterator
+
+        log(f"segment-aware packing: up to {cfg.data.pack_max_segments} "
+            f"proteins per {cfg.data.seq_len}-token row")
+
+        factory = lambda skip: make_packed_iterator(  # noqa: E731
+            ds, cfg.data.batch_size, seed=cfg.train.seed,
+            num_epochs=cfg.data.num_epochs,
+            process_index=jax.process_index(),
+            process_count=jax.process_count(), skip_batches=skip,
+            max_segments=cfg.data.pack_max_segments,
+            max_open=cfg.data.pack_open_bins, metrics=reg())
+    elif cfg.data.buckets:
         from proteinbert_tpu.data.dataset import make_bucketed_iterator
 
         log(f"length bucketing: {cfg.data.buckets}")
@@ -250,7 +270,8 @@ def cmd_pretrain(args) -> int:
             ds, cfg.data.batch_size, cfg.data.buckets, seed=cfg.train.seed,
             num_epochs=cfg.data.num_epochs,
             process_index=jax.process_index(),
-            process_count=jax.process_count(), skip_batches=skip)
+            process_count=jax.process_count(), skip_batches=skip,
+            metrics=reg())
     else:
         factory = lambda skip: make_pretrain_iterator(  # noqa: E731
             ds, cfg.data.batch_size, seed=cfg.train.seed,

@@ -80,6 +80,19 @@ class DataConfig:
     seq_len: int = 256                      # fixed padded length fed to the model
     buckets: Optional[Tuple[int, ...]] = None  # length buckets (last == seq_len);
                                             # None = single padded length
+    packing: bool = False                   # segment-aware sequence packing
+                                            # (data/packing.py): several
+                                            # proteins per fixed-shape row
+                                            # with segment ids — ONE compiled
+                                            # shape, ~zero pad FLOPs; mutually
+                                            # exclusive with buckets
+    pack_max_segments: int = 8              # max proteins per packed row (the
+                                            # S axis of the per-segment
+                                            # annotation tensor)
+    pack_open_bins: int = 0                 # packer look-back: open rows the
+                                            # first-fit planner keeps before
+                                            # closing the oldest (0 = auto,
+                                            # 2 x global batch)
     token_randomize_prob: float = 0.05      # data_processing.py:90
     annotation_corrupt_prob: float = 0.5    # P(keep-and-noise); else hide all
                                             # (data_processing.py:127-128)

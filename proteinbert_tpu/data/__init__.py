@@ -21,6 +21,8 @@ from proteinbert_tpu.data.corruption import (
     randomize_tokens,
     corrupt_annotations,
     corrupt_batch,
+    corrupt_packed_batch,
+    packed_weights,
     pretrain_weights,
 )
 from proteinbert_tpu.data.dataset import (
@@ -31,6 +33,12 @@ from proteinbert_tpu.data.dataset import (
     Subset,
     train_eval_split,
 )
+from proteinbert_tpu.data.packing import (
+    PackPlanner,
+    make_packed_iterator,
+    pack_rows,
+    unpack_segments,
+)
 
 __all__ = [
     "ALPHABET", "PAD_ID", "SOS_ID", "EOS_ID", "UNK_ID", "VOCAB_SIZE",
@@ -38,8 +46,9 @@ __all__ = [
     "tokenize", "tokenize_batch", "random_crop",
     "crop_starts", "epoch_crop_seed", "splitmix64",
     "randomize_tokens", "corrupt_annotations", "corrupt_batch",
-    "pretrain_weights",
+    "corrupt_packed_batch", "packed_weights", "pretrain_weights",
     "InMemoryPretrainingDataset", "HDF5PretrainingDataset",
     "make_bucketed_iterator", "make_pretrain_iterator",
     "Subset", "train_eval_split",
+    "PackPlanner", "make_packed_iterator", "pack_rows", "unpack_segments",
 ]

@@ -87,6 +87,63 @@ def pretrain_weights(
     return {"local": seq_w, "global": ann_w}
 
 
+def packed_weights(
+    tokens: jax.Array, segment_ids: jax.Array, annotations: jax.Array
+) -> Dict[str, jax.Array]:
+    """Loss weights for a PACKED clean batch (data/packing.py layout).
+
+    local: (B, L) — 1 at real (segment > 0) positions, like the unpacked
+      non-pad mask (pad and real positions coincide: packed rows carry
+      no interior padding).
+    global: (B, S, A) — 1 iff the segment EXISTS in the row and has any
+      positive annotation (the per-protein contract of
+      `pretrain_weights`, applied per segment).
+    """
+    del tokens  # the segment map is the authoritative pad mask
+    seq_w = (segment_ids > 0).astype(jnp.float32)
+    S = annotations.shape[-2]
+    seg_exists = (
+        segment_ids[..., None] == jnp.arange(1, S + 1, dtype=segment_ids.dtype)
+    ).any(axis=-2)  # (B, S)
+    has_any = (annotations.sum(axis=-1) > 0) & seg_exists
+    ann_w = jnp.broadcast_to(
+        has_any[..., None].astype(jnp.float32), annotations.shape)
+    return {"local": seq_w, "global": ann_w}
+
+
+def corrupt_packed_batch(
+    key: jax.Array,
+    tokens: jax.Array,
+    segment_ids: jax.Array,
+    annotations: jax.Array,
+    token_randomize_prob: float = 0.05,
+    annotation_corrupt_prob: float = 0.5,
+    annotation_drop_prob: float = 0.25,
+    annotation_add_prob: float = 1e-4,
+) -> Tuple[Dict[str, jax.Array], Dict[str, jax.Array], Dict[str, jax.Array]]:
+    """`corrupt_batch` for PACKED rows (tokens (B, L), segment_ids
+    (B, L), annotations (B, S, A) — data/packing.py).
+
+    Segment-awareness comes for free from the existing primitives:
+    `randomize_tokens` protects special positions BY TOKEN ID, so every
+    packed sequence's <sos>/<eos>/<pad> stay untouched wherever they
+    sit in the row; `corrupt_annotations` draws its keep/hide decision
+    per leading-batch element, which on a (B, S, A) input is per
+    SEGMENT — each packed protein independently keeps-and-noises or
+    hides its annotation vector, exactly like an unpacked row would.
+    """
+    k_tok, k_ann = jax.random.split(key)
+    x_local = randomize_tokens(k_tok, tokens, token_randomize_prob)
+    x_global = corrupt_annotations(
+        k_ann, annotations, annotation_corrupt_prob,
+        annotation_drop_prob, annotation_add_prob,
+    )
+    X = {"local": x_local, "global": x_global}
+    Y = {"local": tokens, "global": annotations}
+    W = packed_weights(tokens, segment_ids, annotations)
+    return X, Y, W
+
+
 def corrupt_batch(
     key: jax.Array,
     tokens: jax.Array,

@@ -344,6 +344,7 @@ def make_bucketed_iterator(
     process_index: int = 0,
     process_count: int = 1,
     skip_batches: int = 0,
+    metrics=None,
 ) -> Iterator[Dict[str, np.ndarray]]:
     """Length-bucketed batch iterator (SURVEY §7 stage 10).
 
@@ -370,7 +371,13 @@ def make_bucketed_iterator(
     (rows longer than it are cropped there by tokenization). Bucket
     remainders carry over epoch boundaries and are dropped only when the
     iterator ends (num_epochs reached) — with static batch shapes a
-    partial batch cannot be emitted.
+    partial batch cannot be emitted; the drop is COUNTED, not silent:
+    with a `metrics` registry the iterator increments
+    `data_dropped_rows_total{strategy="bucketed"}` at exhaustion and
+    sets a per-batch `data_pad_fraction{strategy="bucketed"}` gauge —
+    the SAME metric names the packed iterator reports
+    (data/packing.make_packed_iterator), so `pbt diagnose` compares the
+    two strategies from one stream.
     """
     if isinstance(buckets, str) or not hasattr(buckets, "__iter__"):
         raise ValueError(
@@ -395,6 +402,11 @@ def make_bucketed_iterator(
     fetch = _make_fetch(dataset)
     rng = np.random.default_rng(seed)
     pending: Dict[int, list] = {b: [] for b in range(len(buckets))}
+    pad_gauge = drop_counter = None
+    if metrics is not None:
+        pad_gauge = metrics.gauge("data_pad_fraction", strategy="bucketed")
+        drop_counter = metrics.counter("data_dropped_rows_total",
+                                       strategy="bucketed")
     epoch = 0
     while num_epochs is None or epoch < num_epochs:
         order = _epoch_order(n, rng, shuffle, block)[: per_host * process_count]
@@ -413,8 +425,24 @@ def make_bucketed_iterator(
                      : (process_index + 1) * batch_size])
             batch = fetch(mine, epoch)
             batch["tokens"] = batch["tokens"][:, : buckets[b]]
+            if pad_gauge is not None:
+                pad_gauge.set(float((batch["tokens"] == 0).mean()))
             yield batch
         epoch += 1
+    # End of data: the sub-global-batch remainders in each bucket cannot
+    # be emitted at a static shape — count them (every host sees the
+    # same bookkeeping, so the count is host-consistent).
+    dropped = sum(len(rows) for rows in pending.values())
+    if dropped:
+        if drop_counter is not None:
+            drop_counter.inc(dropped)
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "bucketed iterator ended with %d pending rows across %d "
+            "buckets (static batch shapes cannot emit partial batches); "
+            "counted in data_dropped_rows_total", dropped,
+            sum(1 for rows in pending.values() if rows))
 
 
 class Subset:

@@ -1,0 +1,269 @@
+"""Segment-aware sequence packing (ISSUE 4 tentpole).
+
+UniRef sequences cluster around ~350 residues while the padded training
+row is 1024-8192, so even the bucketed iterator spends most of a step's
+FLOPs and HBM traffic on `<pad>`. This module packs SEVERAL proteins
+into one fixed-shape row and tags every position with a segment id, so
+the model keeps ONE compiled shape (no bucket-fill stalls, no per-bucket
+executables) while almost every position is a real residue — the
+ragged-input strategy TPU stacks converge on (Ragged Paged Attention,
+arXiv:2604.15464).
+
+A packed batch is:
+
+    tokens       (B, L)    int32 — each row is the concatenation of the
+                           nonpad tokens (<sos> seq <eos>) of up to S
+                           proteins, padded with <pad>=0 at the tail;
+    segment_ids  (B, L)    int32 — 0 at pad, 1..S at the positions of
+                           the row's 1st..S-th protein;
+    annotations  (B, S, A) float32 — one annotation vector per packed
+                           protein (zero rows for unused slots).
+
+Downstream, every cross-position op is segment-masked (models/
+proteinbert.py packed path; kernels/fused_block.local_track_segment_
+reference; ops/attention.packed_global_attention_apply) and the loss
+normalizes per segment (train/loss.packed_pretrain_loss), so a packed
+row is numerically a batch of independent proteins — proven by the
+leakage/parity tests in tests/test_packing.py.
+
+Packing plan (`PackPlanner`): greedy FIRST-FIT over a bounded set of
+open rows. Sequences arrive in epoch-permutation order; each goes into
+the first open row with enough remaining capacity and a free segment
+slot, else opens a new row. When the open set exceeds its bound the
+OLDEST row is closed (emitted) — a pure streaming rule, so the whole
+plan is a deterministic function of (lengths, seed, epoch order):
+identical on every host (multi-host lockstep, same contract as
+make_bucketed_iterator) and identical on restart (`skip_batches`
+replays only the cheap index bookkeeping, no data is fetched).
+
+Per-batch `pad_fraction` is reported to the obs metrics registry under
+the SAME metric name the bucketed iterator uses (`data_pad_fraction`,
+labeled by strategy), so `pbt diagnose` can compare the two strategies
+from one stream.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from proteinbert_tpu.data.dataset import _check_per_host, _epoch_order, _make_fetch
+from proteinbert_tpu.data.vocab import PAD_ID
+
+# A closed row slot below this many free positions cannot hold even an
+# empty tokenized sequence (<sos><eos>), so the planner closes it early.
+_MIN_FIT = 2
+
+
+class PackPlanner:
+    """Greedy first-fit packer over a bounded set of open rows.
+
+    add(row_id, length) -> list of CLOSED rows (each a list of row ids),
+    in deterministic closing order; flush() closes everything left.
+    Pure index bookkeeping — no data moves through the planner, which is
+    what makes multi-host lockstep and free restart replay possible.
+    """
+
+    def __init__(self, seq_len: int, max_segments: int, max_open: int):
+        if max_segments < 1:
+            raise ValueError(f"max_segments must be >= 1, got {max_segments}")
+        if max_open < 1:
+            raise ValueError(f"max_open must be >= 1, got {max_open}")
+        self.seq_len = seq_len
+        self.max_segments = max_segments
+        self.max_open = max_open
+        # Each open row: [remaining_capacity, [row_ids...]]
+        self._open: List[List] = []
+
+    def add(self, row_id: int, length: int) -> List[List[int]]:
+        length = int(min(length, self.seq_len))
+        closed: List[List[int]] = []
+        placed = None
+        for slot in self._open:
+            if slot[0] >= length and len(slot[1]) < self.max_segments:
+                slot[0] -= length
+                slot[1].append(row_id)
+                placed = slot
+                break
+        if placed is None:
+            placed = [self.seq_len - length, [row_id]]
+            self._open.append(placed)
+            if len(self._open) > self.max_open:
+                # max_open >= 1, so the popped oldest is never `placed`
+                # (which was just appended at the end).
+                closed.append(self._open.pop(0)[1])
+        # A row that can't take another sequence only wastes first-fit
+        # scans — close it now (also bounds per-row segment count).
+        if (placed[0] < _MIN_FIT
+                or len(placed[1]) >= self.max_segments):
+            self._open = [s for s in self._open if s is not placed]
+            closed.append(placed[1])
+        return closed
+
+    def flush(self) -> List[List[int]]:
+        closed = [slot[1] for slot in self._open]
+        self._open = []
+        return closed
+
+
+def pack_rows(
+    fetched_tokens: np.ndarray,
+    fetched_annotations: np.ndarray,
+    groups: List[List[int]],
+    seq_len: int,
+    max_segments: int,
+) -> Dict[str, np.ndarray]:
+    """Assemble fetched per-sequence arrays into a packed batch.
+
+    `groups[i]` lists positions into `fetched_*` for packed row i (the
+    planner guarantees their nonpad lengths fit seq_len and there are at
+    most max_segments of them).
+    """
+    B = len(groups)
+    A = fetched_annotations.shape[-1]
+    tokens = np.zeros((B, seq_len), dtype=np.int32)
+    segment_ids = np.zeros((B, seq_len), dtype=np.int32)
+    annotations = np.zeros((B, max_segments, A), dtype=np.float32)
+    for i, group in enumerate(groups):
+        cursor = 0
+        for s, pos in enumerate(group):
+            row = fetched_tokens[pos]
+            n = int((row != PAD_ID).sum())
+            n = min(n, seq_len - cursor)
+            tokens[i, cursor:cursor + n] = row[:n]
+            segment_ids[i, cursor:cursor + n] = s + 1
+            annotations[i, s] = fetched_annotations[pos]
+            cursor += n
+    return {"tokens": tokens, "segment_ids": segment_ids,
+            "annotations": annotations}
+
+
+def pad_fraction(tokens: np.ndarray) -> float:
+    """Fraction of pad positions in a (B, L) token batch."""
+    return float((tokens == PAD_ID).mean())
+
+
+def make_packed_iterator(
+    dataset,
+    batch_size: int,
+    seed: int = 0,
+    shuffle: bool = True,
+    num_epochs: Optional[int] = None,
+    process_index: int = 0,
+    process_count: int = 1,
+    skip_batches: int = 0,
+    max_segments: int = 8,
+    max_open: int = 0,
+    metrics=None,
+) -> Iterator[Dict[str, np.ndarray]]:
+    """Infinite (or num_epochs-bounded) per-host PACKED batch iterator.
+
+    Yields {"tokens" (B, L), "segment_ids" (B, L), "annotations"
+    (B, S, A)} per-host batches (B = batch_size, L = dataset.seq_len,
+    S = max_segments). Multi-host lockstep mirrors
+    make_bucketed_iterator: every host runs the SAME planner over the
+    same epoch permutation (identical seed), so all hosts agree on the
+    packing plan; when `batch_size * process_count` rows are ready each
+    host fetches only its slice.
+
+    `max_open` bounds the planner's open-row set (0 = auto:
+    2 * global batch — enough look-back that a long sequence arriving
+    late still finds a half-empty row). `skip_batches` replays only the
+    planner bookkeeping — resume costs index arithmetic, not I/O.
+
+    `metrics` (an obs.MetricsRegistry) receives per-batch
+    `data_pad_fraction{strategy="packed"}` plus segment/dropped-row
+    counters; None = no reporting.
+    """
+    n = len(dataset)
+    per_host = _check_per_host(n, batch_size, process_count)
+    global_batch = batch_size * process_count
+    if max_open <= 0:
+        max_open = 2 * global_batch
+    lengths = np.minimum(dataset.row_lengths(), dataset.seq_len)
+    seq_len = dataset.seq_len
+    block = getattr(dataset, "shuffle_block", None)
+    fetch = _make_fetch(dataset)
+    rng = np.random.default_rng(seed)
+
+    gauge = counter_seg = counter_rows = counter_drop = None
+    if metrics is not None:
+        gauge = metrics.gauge("data_pad_fraction", strategy="packed")
+        counter_seg = metrics.counter("data_packed_segments_total")
+        counter_rows = metrics.counter("data_packed_rows_total")
+        counter_drop = metrics.counter("data_dropped_rows_total",
+                                       strategy="packed")
+
+    planner = PackPlanner(seq_len, max_segments, max_open)
+    ready: List[List[int]] = []
+
+    def emit(groups: List[List[int]], epoch: int):
+        mine = groups[process_index * batch_size
+                      : (process_index + 1) * batch_size]
+        flat = [r for g in mine for r in g]
+        # Map each group's row ids to positions in the flattened fetch.
+        pos = 0
+        positions = []
+        for g in mine:
+            positions.append(list(range(pos, pos + len(g))))
+            pos += len(g)
+        data = fetch(np.asarray(flat, dtype=np.int64), epoch)
+        batch = pack_rows(data["tokens"], data["annotations"], positions,
+                          seq_len, max_segments)
+        if metrics is not None:
+            gauge.set(pad_fraction(batch["tokens"]))
+            counter_seg.inc(len(flat))
+            counter_rows.inc(len(mine))
+        return batch
+
+    epoch = 0
+    while num_epochs is None or epoch < num_epochs:
+        order = _epoch_order(n, rng, shuffle, block)[: per_host * process_count]
+        for i in order:
+            ready.extend(planner.add(int(i), int(lengths[i])))
+            while len(ready) >= global_batch:
+                groups, ready = ready[:global_batch], ready[global_batch:]
+                if skip_batches > 0:
+                    skip_batches -= 1
+                    continue
+                yield emit(groups, epoch)
+        epoch += 1
+    # End of data: flush the planner and emit every FULL global batch;
+    # the (sub-global-batch) remainder cannot be emitted at a static
+    # shape — count it instead of losing it silently.
+    ready.extend(planner.flush())
+    while len(ready) >= global_batch:
+        groups, ready = ready[:global_batch], ready[global_batch:]
+        if skip_batches > 0:
+            skip_batches -= 1
+            continue
+        yield emit(groups, epoch - 1 if epoch else 0)
+    dropped = sum(len(g) for g in ready)
+    if dropped:
+        if counter_drop is not None:
+            counter_drop.inc(dropped)
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "packed iterator ended with %d pending sequences in %d "
+            "partial rows (a sub-global-batch remainder cannot be "
+            "emitted at a static shape); counted in "
+            "data_dropped_rows_total", dropped, len(ready))
+
+
+def unpack_segments(
+    batch: Dict[str, np.ndarray],
+) -> List[Tuple[np.ndarray, np.ndarray]]:
+    """Split a packed batch back into per-sequence (tokens, annotation)
+    pairs, in row-major segment order — the inverse the parity tests and
+    debugging tools use."""
+    out = []
+    tokens, seg, ann = (batch["tokens"], batch["segment_ids"],
+                        batch["annotations"])
+    for b in range(tokens.shape[0]):
+        n_seg = int(seg[b].max())
+        for s in range(1, n_seg + 1):
+            mask = seg[b] == s
+            out.append((tokens[b][mask], ann[b, s - 1]))
+    return out

@@ -69,6 +69,15 @@ class PrefetchIterator:
     def __iter__(self):
         return self
 
+    def _raise_pending_error(self):
+        """Re-raise the producer's exception ON THE CONSUMER — with its
+        ORIGINAL traceback (the exception object carries the producer
+        frame's __traceback__, so the report points at the raising line
+        inside the source iterator, not at this queue plumbing)."""
+        err, self._error = self._error, None
+        self._done = True
+        raise err.with_traceback(err.__traceback__)
+
     def __next__(self):
         if self._done:
             raise StopIteration
@@ -80,16 +89,21 @@ class PrefetchIterator:
             except queue.Empty:
                 # The fill thread can only be gone after delivering the
                 # sentinel OR after close(); either way nothing more is
-                # coming — never block a training loop forever.
+                # coming — never block a training loop forever. A
+                # producer that DIED on an exception must surface that
+                # exception here, not a generic StopIteration that
+                # reads as clean end-of-data.
                 if self._stop.is_set() or not self._thread.is_alive():
+                    if self._error is not None:
+                        self.wait_s += time.perf_counter() - t0
+                        self._raise_pending_error()
                     self._done = True
                     raise StopIteration from None
         self.wait_s += time.perf_counter() - t0
         if item is _SENTINEL:
-            self._done = True
             if self._error is not None:
-                err, self._error = self._error, None
-                raise err
+                self._raise_pending_error()
+            self._done = True
             raise StopIteration
         self.batches += 1
         return item

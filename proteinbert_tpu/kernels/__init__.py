@@ -3,8 +3,10 @@
 from proteinbert_tpu.kernels.fused_block import (
     MAX_PALLAS_DIM,
     fused_local_track,
+    fused_local_track_segments,
     fused_local_track_valid,
     local_track_reference,
+    local_track_segment_reference,
     local_track_valid_reference,
     pallas_supported,
     track_halo,
@@ -13,8 +15,10 @@ from proteinbert_tpu.kernels.fused_block import (
 __all__ = [
     "MAX_PALLAS_DIM",
     "fused_local_track",
+    "fused_local_track_segments",
     "fused_local_track_valid",
     "local_track_reference",
+    "local_track_segment_reference",
     "local_track_valid_reference",
     "pallas_supported",
     "track_halo",

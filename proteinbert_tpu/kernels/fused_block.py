@@ -106,6 +106,90 @@ def local_track_reference(
     )
 
 
+def _segment_conv(
+    p: Params, x: jax.Array, segment_ids: jax.Array, dilation: int
+) -> jax.Array:
+    """'SAME' dilated conv whose taps NEVER cross a segment boundary.
+
+    Lowered as K shifted (B, L, C) @ (C, C) matmuls (the same implicit-
+    GEMM decomposition the Pallas kernel uses, _tap_matmuls): tap t of a
+    kernel-size-K, dilation-d conv reads x[l + (t-(K-1)/2)·d]; here that
+    shifted operand is ZEROED wherever its segment id differs from the
+    center position's (or the center is pad), so a contribution from
+    another packed protein is an exact 0.0 — multiplication by a zero
+    mask, not a subtraction — which is what lets the leakage test assert
+    BIT-identity across segments (tests/test_packing.py). FLOPs equal
+    the plain conv (K·C² MACs/position either way).
+    """
+    kernel = p["kernel"].astype(x.dtype)
+    taps = kernel.shape[0]
+    L = x.shape[1]
+    # 'SAME' halo, asymmetric for even kernels exactly like
+    # conv1d_apply's padding="SAME" (lo = total//2, extra on the right).
+    total = (taps - 1) * dilation
+    lo = total // 2
+    xp = jnp.pad(x, ((0, 0), (lo, total - lo), (0, 0)))
+    sp = jnp.pad(segment_ids, ((0, 0), (lo, total - lo)))
+    real = segment_ids > 0
+    acc = None
+    for t in range(taps):
+        off = t * dilation
+        xs = lax.slice_in_dim(xp, off, off + L, axis=1)
+        ss = lax.slice_in_dim(sp, off, off + L, axis=1)
+        mask = ((ss == segment_ids) & real).astype(x.dtype)[..., None]
+        part = (xs * mask) @ kernel[t]
+        acc = part if acc is None else acc + part
+    # Same remat tag as conv1d_apply so model.remat_policy="convs" also
+    # bites on the packed path; inert without remat.
+    return checkpoint_name(acc + p["bias"].astype(x.dtype), "conv_out")
+
+
+def local_track_segment_reference(
+    params: Params, x: jax.Array, broadcast_pos: jax.Array,
+    segment_ids: jax.Array,
+    narrow_dilation: int = 1, wide_dilation: int = 5,
+) -> jax.Array:
+    """Segment-aware local track for PACKED rows (data/packing.py).
+
+    Same dataflow as local_track_reference with two changes: the convs
+    are boundary-masked (`_segment_conv`), and `broadcast_pos` is
+    already per-POSITION (B, L, C) — each position receives its own
+    segment's global→local projection (gathered by the model), not one
+    row-wide vector.
+    """
+    from proteinbert_tpu.ops.layers import dense_apply, layer_norm_apply
+
+    narrow = _gelu(_segment_conv(params["narrow_conv"], x, segment_ids,
+                                 narrow_dilation))
+    wide = _gelu(_segment_conv(params["wide_conv"], x, segment_ids,
+                               wide_dilation))
+    h = layer_norm_apply(
+        params["local_ln1"], x + narrow + wide + broadcast_pos
+    )
+    return layer_norm_apply(
+        params["local_ln2"],
+        h + _gelu(dense_apply(params["local_dense"], h)),
+    )
+
+
+def fused_local_track_segments(
+    params: Params, x: jax.Array, broadcast_pos: jax.Array,
+    segment_ids: jax.Array,
+    narrow_dilation: int = 1, wide_dilation: int = 5,
+    interpret: bool = False,
+) -> jax.Array:
+    """GUARD: the Pallas kernel has no segment-boundary support yet, so
+    a packed row under cfg.use_pallas takes the XLA reference path
+    (semantically identical, boundary-masked). When the kernel learns
+    boundaries this becomes the dispatch point — callers already route
+    every packed use_pallas call here (models/proteinbert.block_apply),
+    so the swap will be one-line."""
+    del interpret  # reserved for the future kernel dispatch
+    return local_track_segment_reference(
+        params, x, broadcast_pos, segment_ids, narrow_dilation, wide_dilation
+    )
+
+
 def track_halo(params: Params, narrow_dilation: int = 1,
                wide_dilation: int = 5) -> int:
     """Context rows each side a shard needs for exact conv results (20 for

@@ -45,6 +45,7 @@ from proteinbert_tpu.data.vocab import PAD_ID
 from proteinbert_tpu.ops.attention import (
     global_attention_apply,
     global_attention_init,
+    packed_global_attention_apply,
 )
 from proteinbert_tpu.ops.layers import (
     conv1d_init,
@@ -112,18 +113,50 @@ def block_apply(
     global_: jax.Array,
     pad_mask: Optional[jax.Array],
     cfg: ModelConfig,
+    segment_ids: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array]:
-    """Apply one block. local (B,L,C), global (B,G), pad_mask (B,L) bool."""
+    """Apply one block. local (B,L,C), global (B,G), pad_mask (B,L) bool.
+
+    PACKED rows (data/packing.py): pass `segment_ids` (B,L) and a
+    per-SEGMENT global track (B,S,G). The local convs are boundary-
+    masked, the global→local broadcast is gathered per position from the
+    position's own segment, and attention/annotation state run per
+    segment — a packed row is numerically a batch of independent
+    proteins (tests/test_packing.py asserts bit-level isolation)."""
+    packed = segment_ids is not None
     # Local track (reference modules.py:201-217).
     broadcast = jax.nn.gelu(dense_apply(params["global_to_local"], global_))
     from proteinbert_tpu.kernels import (
-        fused_local_track, local_track_reference, pallas_supported,
+        fused_local_track, fused_local_track_segments,
+        local_track_reference, local_track_segment_reference,
+        pallas_supported,
     )
 
     track_params = {k: params[k] for k in ("narrow_conv", "wide_conv",
                                            "local_ln1", "local_dense",
                                            "local_ln2")}
-    if cfg.use_pallas and pallas_supported(
+    if packed:
+        # Gather each position's own segment's broadcast vector:
+        # (B, S, C) → (B, L, C), zero at pad so nothing row-wide leaks
+        # into the masked conv taps.
+        idx = jnp.clip(segment_ids - 1, 0)[..., None]
+        broadcast_pos = jnp.take_along_axis(broadcast, idx, axis=1)
+        broadcast_pos = jnp.where(
+            (segment_ids > 0)[..., None], broadcast_pos,
+            jnp.zeros((), broadcast_pos.dtype))
+        if cfg.use_pallas:
+            # Guard (kernels/fused_block.py): the kernel has no
+            # boundary support yet — delegates to the reference path.
+            local = fused_local_track_segments(
+                track_params, local, broadcast_pos, segment_ids,
+                1, cfg.wide_dilation, jax.default_backend() != "tpu",
+            )
+        else:
+            local = local_track_segment_reference(
+                track_params, local, broadcast_pos, segment_ids,
+                1, cfg.wide_dilation,
+            )
+    elif cfg.use_pallas and pallas_supported(
         cfg.local_dim, local.shape[1], cfg.dtype,
         cfg.narrow_kernel, cfg.wide_kernel, cfg.wide_dilation,
     ):
@@ -138,9 +171,16 @@ def block_apply(
             track_params, local, broadcast, 1, cfg.wide_dilation
         )
 
-    # Global track (reference modules.py:219-229).
+    # Global track (reference modules.py:219-229) — per segment when
+    # packed: every dense/LN is feature-last and shape-agnostic over the
+    # leading (B, S) axes, only attention needs the segment mask.
     dense1 = jax.nn.gelu(dense_apply(params["global_dense1"], global_))
-    attn = global_attention_apply(params["attention"], local, global_, pad_mask)
+    if packed:
+        attn = packed_global_attention_apply(
+            params["attention"], local, global_, segment_ids)
+    else:
+        attn = global_attention_apply(
+            params["attention"], local, global_, pad_mask)
     global_ = layer_norm_apply(params["global_ln1"], global_ + dense1 + attn)
     global_ = layer_norm_apply(
         params["global_ln2"],
@@ -194,6 +234,7 @@ def encode(
     annotations: jax.Array,
     cfg: ModelConfig,
     pad_mask: Optional[jax.Array] = None,
+    segment_ids: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Trunk forward: embeddings + N dual-track blocks, no output heads.
 
@@ -201,17 +242,24 @@ def encode(
     to the pretraining heads here and to fine-tuning task heads
     (models/finetune.py), which the reference only sketched in
     commented-out code (reference utils.py:348-493, SURVEY C14).
+
+    PACKED rows: pass `segment_ids` (B, L) with annotations shaped
+    (B, S, A) per segment; the global representation comes back
+    per-segment as (B, S, G) and every cross-position op is segment-
+    masked (see block_apply).
     """
     dtype = jnp.dtype(cfg.dtype)
     if pad_mask is None:
-        pad_mask = tokens != PAD_ID
+        pad_mask = (segment_ids > 0 if segment_ids is not None
+                    else tokens != PAD_ID)
 
     local = embedding_apply(params["embedding"], tokens, dtype)
     global_ = jax.nn.gelu(
         dense_apply(params["global_in"], annotations.astype(dtype))
     )
 
-    body = remat_wrap(partial(block_apply, cfg=cfg), cfg)
+    body = remat_wrap(
+        partial(block_apply, cfg=cfg, segment_ids=segment_ids), cfg)
 
     if cfg.scan_blocks:
         def scan_body(carry, blk):
@@ -236,19 +284,25 @@ def apply(
     annotations: jax.Array,
     cfg: ModelConfig,
     pad_mask: Optional[jax.Array] = None,
+    segment_ids: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Forward pass.
 
     Args:
       tokens: (B, L) int token ids (the corrupted "local" input).
       annotations: (B, A) float annotation vector (the corrupted "global"
-        input; reference input contract at modules.py:295-304).
+        input; reference input contract at modules.py:295-304) — or
+        (B, S, A) per-segment vectors when `segment_ids` is passed.
       pad_mask: (B, L) bool, True at real positions; derived from tokens
-        if omitted.
+        (or segment_ids) if omitted.
+      segment_ids: optional (B, L) int segment map for PACKED rows
+        (data/packing.py); 0 = pad, 1..S = packed protein index.
     Returns:
-      (local_logits (B, L, V), global_logits (B, A)) — LOGITS, in float32.
+      (local_logits (B, L, V), global_logits (B, A)) — LOGITS, in
+      float32; global_logits is (B, S, A) when packed.
     """
-    local, global_ = encode(params, tokens, annotations, cfg, pad_mask)
+    local, global_ = encode(params, tokens, annotations, cfg, pad_mask,
+                            segment_ids)
     local_logits = dense_apply(params["local_head"], local).astype(jnp.float32)
     global_logits = dense_apply(params["global_head"], global_).astype(jnp.float32)
     return local_logits, global_logits

@@ -95,3 +95,58 @@ def global_attention_apply(
     out = jnp.einsum("bhl,bhlv->bhv", weights, v)
     b, h, vd = out.shape
     return out.reshape(b, h * vd)
+
+
+def packed_global_attention_apply(
+    params: Params,
+    local: jax.Array,
+    global_: jax.Array,
+    segment_ids: jax.Array,
+) -> jax.Array:
+    """Per-SEGMENT global attention over a packed row (data/packing.py).
+
+    Each of a row's S packed proteins carries its own global vector and
+    attends ONLY over its own positions: scores outside the segment are
+    masked to -1e30, whose exp underflows to exactly 0.0 in float32 —
+    so another segment's values contribute exact zeros to the weighted
+    sum, and the cross-segment-leakage test can assert bit-identity
+    (tests/test_packing.py). Segment slots with no positions in the row
+    get a zero output (their uniform softmax over masked scores would
+    otherwise mix arbitrary values; they carry zero loss weight either
+    way, but zeroing keeps the (B, S, G) state leak-proof too).
+
+    Args:
+      local: (B, L, C) local track.
+      global_: (B, S, G) per-segment global track.
+      segment_ids: (B, L) int, 0 = pad, 1..S = segment index.
+    Returns:
+      (B, S, G) attention output in the activation dtype of `local`.
+    """
+    dtype = local.dtype
+    wq = params["wq"].astype(dtype)
+    wk = params["wk"].astype(dtype)
+    wv = params["wv"].astype(dtype)
+    key_dim = wq.shape[-1]
+    S = global_.shape[1]
+
+    q = jnp.tanh(jnp.einsum("bsg,hgk->bshk", global_, wq))
+    k = jnp.tanh(jnp.einsum("blc,hck->bhlk", local, wk))
+    v = jax.nn.gelu(jnp.einsum("blc,hcv->bhlv", local, wv))
+
+    scores = jnp.einsum("bshk,bhlk->bshl", q, k) / jnp.sqrt(
+        jnp.asarray(key_dim, dtype)
+    )
+    scores = scores.astype(jnp.float32)
+    seg_mask = (
+        segment_ids[:, None, :]
+        == jnp.arange(1, S + 1, dtype=segment_ids.dtype)[None, :, None]
+    )  # (B, S, L)
+    scores = jnp.where(seg_mask[:, :, None, :], scores, jnp.float32(-1e30))
+    weights = jax.nn.softmax(scores, axis=-1).astype(dtype)
+
+    out = jnp.einsum("bshl,bhlv->bshv", weights, v)
+    seg_exists = seg_mask.any(axis=-1)  # (B, S)
+    out = jnp.where(seg_exists[:, :, None, None], out,
+                    jnp.zeros((), dtype))
+    b, s, h, vd = out.shape
+    return out.reshape(b, s, h * vd)

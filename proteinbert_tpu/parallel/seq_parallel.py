@@ -225,6 +225,15 @@ def make_seq_parallel_train_step(mesh: Mesh, cfg: PretrainConfig):
     from proteinbert_tpu.train.schedule import make_optimizer, needs_loss_value
 
     def step(state, batch):
+        if "segment_ids" in batch:
+            raise NotImplementedError(
+                "packed batches (data.packing) are not supported by the "
+                "explicit sequence-parallel Pallas step: the fused kernel "
+                "has no segment-boundary support yet (its guard falls "
+                "back to XLA, which this hand-sharded path cannot use). "
+                "Disable model.use_pallas (the implicit-SPMD jit "
+                "seq-shards the boundary-masked packed model fine) or "
+                "turn packing off.")
         key, step_key = jax.random.split(state.key)
         X, Y, W = corrupt_batch(
             step_key, batch["tokens"], batch["annotations"],

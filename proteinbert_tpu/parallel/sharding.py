@@ -37,6 +37,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 def batch_sharding(mesh: Mesh) -> Dict[str, NamedSharding]:
     return {
         "tokens": NamedSharding(mesh, P(("data", "fsdp"), "seq")),
+        # Packed batches (data/packing.py): the per-position segment map
+        # shards exactly like the tokens it annotates; the per-segment
+        # (B, S, A) annotation tensor keeps batch-only sharding (the
+        # trailing spec axes replicate, so the 2D unpacked (B, A) shape
+        # uses the same entry).
+        "segment_ids": NamedSharding(mesh, P(("data", "fsdp"), "seq")),
         "annotations": NamedSharding(mesh, P(("data", "fsdp"), None)),
     }
 

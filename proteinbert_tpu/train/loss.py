@@ -65,6 +65,98 @@ def pretrain_loss(
     return total, metrics
 
 
+def packed_segment_losses(
+    local_logits: jax.Array,
+    global_logits: jax.Array,
+    targets: Dict[str, jax.Array],
+    weights: Dict[str, jax.Array],
+    segment_ids: jax.Array,
+) -> Dict[str, jax.Array]:
+    """Per-SEGMENT loss terms for a packed batch (data/packing.py).
+
+    Returns (B, S) arrays: "local" (mean token CE over the segment's
+    positions), "global" (mean annotation BCE over the segment's
+    weighted annotation dims), "local_acc", plus validity masks
+    "seg_valid" (segment has positions) and "seg_weighted" (segment has
+    global loss weight). These are exactly the quantities an UNPACKED
+    run computes per row, which is what the packed-vs-unpacked parity
+    test asserts (tests/test_packing.py).
+    """
+    S = global_logits.shape[1]
+    onehot = (
+        segment_ids[..., None] == jnp.arange(1, S + 1,
+                                             dtype=segment_ids.dtype)
+    ).astype(jnp.float32)  # (B, L, S)
+    tok_w = weights["local"]  # (B, L)
+
+    ce = optax.softmax_cross_entropy_with_integer_labels(
+        local_logits, targets["local"]
+    )  # (B, L)
+    seg_tokens = jnp.einsum("bl,bls->bs", tok_w, onehot)
+    seg_ce = jnp.einsum("bl,bls->bs", ce * tok_w, onehot)
+    denom = jnp.maximum(seg_tokens, 1.0)
+    per_seg_local = seg_ce / denom
+
+    correct = (local_logits.argmax(-1) == targets["local"]).astype(
+        jnp.float32)
+    per_seg_acc = jnp.einsum("bl,bls->bs", correct * tok_w, onehot) / denom
+
+    bce = optax.sigmoid_binary_cross_entropy(
+        global_logits, targets["global"]
+    )  # (B, S, A)
+    gw = weights["global"]  # (B, S, A)
+    gw_sum = gw.sum(axis=-1)
+    per_seg_global = (bce * gw).sum(axis=-1) / jnp.maximum(gw_sum, 1.0)
+
+    return {
+        "local": per_seg_local,
+        "global": per_seg_global,
+        "local_acc": per_seg_acc,
+        "seg_valid": (seg_tokens > 0).astype(jnp.float32),
+        "seg_weighted": (gw_sum > 0).astype(jnp.float32),
+    }
+
+
+def packed_pretrain_loss(
+    local_logits: jax.Array,
+    global_logits: jax.Array,
+    targets: Dict[str, jax.Array],
+    weights: Dict[str, jax.Array],
+    segment_ids: jax.Array,
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """`pretrain_loss` for PACKED batches, normalized PER SEGMENT.
+
+    Each term first averages within a segment, then averages over valid
+    segments — so a 900-residue protein and a 40-residue one packed
+    into the same row contribute equally, exactly as they would as two
+    unpacked rows under a per-row normalization (documented divergence
+    from the unpacked loss, which is token-weighted across the batch;
+    the per-term SCALE matches the unpacked loss on any single
+    sequence, which is the invariant transfer/eval comparisons need).
+
+    Args:
+      local_logits: (B, L, V) fp32.
+      global_logits: (B, S, A) fp32.
+      targets: {"local": (B, L) int ids, "global": (B, S, A) 0/1}.
+      weights: {"local": (B, L), "global": (B, S, A)} fp32 masks
+        (data/corruption.packed_weights).
+      segment_ids: (B, L) int, 0 = pad.
+    """
+    seg = packed_segment_losses(
+        local_logits, global_logits, targets, weights, segment_ids)
+    local_loss = _weighted_mean(seg["local"], seg["seg_valid"])
+    global_loss = _weighted_mean(seg["global"], seg["seg_weighted"])
+    local_acc = _weighted_mean(seg["local_acc"], seg["seg_valid"])
+    total = local_loss + global_loss
+    metrics = {
+        "loss": total,
+        "local_loss": local_loss,
+        "global_loss": global_loss,
+        "local_acc": local_acc,
+    }
+    return total, metrics
+
+
 def global_ranking_metrics(
     global_logits: jax.Array,
     targets: jax.Array,

@@ -29,37 +29,52 @@ PEAK_FLOPS = {
 }
 
 
-def forward_flops(cfg: ModelConfig, batch: int, seq_len: int) -> float:
-    """Analytic forward-pass FLOPs (2·MACs) for one batch."""
+def forward_flops(cfg: ModelConfig, batch: int, seq_len: int,
+                  nonpad_tokens: Optional[float] = None) -> float:
+    """Analytic forward-pass FLOPs (2·MACs) for one batch.
+
+    `nonpad_tokens` (total real tokens in the batch, default B·L) makes
+    the estimate reflect the ACTUAL per-batch work rather than the
+    padded shape: every L-proportional term — the convs, the local
+    dense/head, the attention K/V/score/sum — scales with real tokens,
+    since pad FLOPs produce no useful output. This is the honest
+    denominator for pad-adjusted MFU (bench.py --pack; ISSUE 4
+    satellite): a 70%-pad batch at the padded count reports an MFU
+    three times the useful-work utilisation.
+    """
     B, L = batch, seq_len
     C, G, A = cfg.local_dim, cfg.global_dim, cfg.num_annotations
     H, k = cfg.num_heads, cfg.key_dim
     v = cfg.value_dim
     K = cfg.narrow_kernel
+    # Total real-token count; L-proportional terms use T where the
+    # padded-shape expression has B·L.
+    T = float(B * L if nonpad_tokens is None else nonpad_tokens)
 
     per_block = (
-        2 * B * L * K * C * C          # narrow conv (modules.py:126 analogue)
-        + 2 * B * L * cfg.wide_kernel * C * C  # wide dilated conv
+        2 * T * K * C * C              # narrow conv (modules.py:126 analogue)
+        + 2 * T * cfg.wide_kernel * C * C  # wide dilated conv
         + 2 * B * G * C                # global->local broadcast dense
-        + 2 * B * L * C * C            # local residual dense
+        + 2 * T * C * C                # local residual dense
         + 2 * B * G * G                # global dense 1
         + 2 * B * H * G * k            # attention q
-        + 2 * B * L * H * C * k        # attention K
-        + 2 * B * L * H * C * v        # attention V
-        + 2 * B * H * L * k            # scores
-        + 2 * B * H * L * v            # weighted sum
+        + 2 * T * H * C * k            # attention K
+        + 2 * T * H * C * v            # attention V
+        + 2 * H * T * k                # scores
+        + 2 * H * T * v                # weighted sum
         + 2 * B * G * G                # global dense 2
     )
     io = (
         2 * B * A * G                  # global input dense
-        + 2 * B * L * C * cfg.vocab_size   # local head
+        + 2 * T * C * cfg.vocab_size   # local head
         + 2 * B * G * A                # global head
     )
     return float(cfg.num_blocks * per_block + io)
 
 
-def train_flops(cfg: ModelConfig, batch: int, seq_len: int) -> float:
-    return 3.0 * forward_flops(cfg, batch, seq_len)
+def train_flops(cfg: ModelConfig, batch: int, seq_len: int,
+                nonpad_tokens: Optional[float] = None) -> float:
+    return 3.0 * forward_flops(cfg, batch, seq_len, nonpad_tokens)
 
 
 def peak_flops_per_chip(device: Optional[jax.Device] = None) -> float:

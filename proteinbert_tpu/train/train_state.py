@@ -43,9 +43,10 @@ DONATE_STATE = () if os.environ.get("PBT_DISABLE_DONATION") else (0,)
 
 from proteinbert_tpu.configs import PretrainConfig
 from proteinbert_tpu.models import proteinbert
-from proteinbert_tpu.data.corruption import corrupt_batch
+from proteinbert_tpu.data.corruption import corrupt_batch, corrupt_packed_batch
 from proteinbert_tpu.train.loss import (
-    global_ranking_metrics, global_ranking_stats, pretrain_loss,
+    global_ranking_metrics, global_ranking_stats, packed_pretrain_loss,
+    pretrain_loss,
 )
 from proteinbert_tpu.train.schedule import (
     effective_lr, make_optimizer, needs_loss_value, plateau_uses_eval,
@@ -81,8 +82,37 @@ def corrupt_forward_grads(
     the clean batch, forward, loss, backward — shared verbatim by the
     default step below and the ZeRO-1 step (parallel/zero.py), so the
     corruption plumbing and loss contract cannot drift between them.
-    Returns (next state key, grads, loss metrics)."""
+    Returns (next state key, grads, loss metrics).
+
+    A batch carrying a "segment_ids" key is a PACKED batch
+    (data/packing.py): corruption, model, and loss take the segment-
+    aware path (per-segment annotation state + per-segment loss
+    normalization), selected at trace time from the batch's pytree
+    structure — no config flag needed on device."""
     key, step_key = jax.random.split(state.key)
+    if "segment_ids" in batch:
+        seg = batch["segment_ids"]
+        X, Y, W = corrupt_packed_batch(
+            step_key,
+            batch["tokens"],
+            seg,
+            batch["annotations"],
+            token_randomize_prob=cfg.data.token_randomize_prob,
+            annotation_corrupt_prob=cfg.data.annotation_corrupt_prob,
+            annotation_drop_prob=cfg.data.annotation_drop_prob,
+            annotation_add_prob=cfg.data.annotation_add_prob,
+        )
+
+        def loss_fn(params):
+            local_logits, global_logits = proteinbert.apply(
+                params, X["local"], X["global"], cfg.model,
+                segment_ids=seg,
+            )
+            return packed_pretrain_loss(
+                local_logits, global_logits, Y, W, seg)
+
+        grads, metrics = jax.grad(loss_fn, has_aux=True)(state.params)
+        return key, grads, metrics
     X, Y, W = corrupt_batch(
         step_key,
         batch["tokens"],
@@ -202,7 +232,37 @@ def eval_step(
     state: TrainState, batch: Dict[str, jax.Array], key: jax.Array,
     cfg: PretrainConfig,
 ) -> Dict[str, jax.Array]:
-    """Corrupted-input eval with a caller-provided key (deterministic)."""
+    """Corrupted-input eval with a caller-provided key (deterministic).
+
+    Packed batches (a "segment_ids" key) are scored with the per-segment
+    loss; the ranking metrics see each packed protein as its own row
+    ((B, S, A) flattened to (B·S, A) — empty segment slots carry zero
+    weight and are excluded by the metrics' own validity masks)."""
+    if "segment_ids" in batch:
+        seg = batch["segment_ids"]
+        X, Y, W = corrupt_packed_batch(
+            key,
+            batch["tokens"],
+            seg,
+            batch["annotations"],
+            token_randomize_prob=cfg.data.token_randomize_prob,
+            annotation_corrupt_prob=cfg.data.annotation_corrupt_prob,
+            annotation_drop_prob=cfg.data.annotation_drop_prob,
+            annotation_add_prob=cfg.data.annotation_add_prob,
+        )
+        local_logits, global_logits = proteinbert.apply(
+            state.params, X["local"], X["global"], cfg.model,
+            segment_ids=seg,
+        )
+        _, metrics = packed_pretrain_loss(
+            local_logits, global_logits, Y, W, seg)
+        A = global_logits.shape[-1]
+        flat = lambda a: a.reshape(-1, A)  # noqa: E731
+        gl, gy, gw = (flat(global_logits), flat(Y["global"]),
+                      flat(W["global"]))
+        metrics.update(global_ranking_metrics(gl, gy, gw))
+        metrics["ranking_stats"] = global_ranking_stats(gl, gy, gw)
+        return metrics
     X, Y, W = corrupt_batch(
         key,
         batch["tokens"],

@@ -1,0 +1,114 @@
+"""Multi-device PACKED-batch parity child (ISSUE 4 satellite).
+
+Packed batches add a third input tensor (segment_ids, sharded like
+tokens) and a per-segment (B, S, A) annotation tensor to the sharding
+rules; this child proves, in its own process with 8 virtual CPU
+devices, that the sharded packed train step is numerically identical to
+the single-device packed step — including under the ZeRO-1 zero-update
+path (whose shard_map in/out specs must digest the packed grads tree).
+
+Usage: python tests/multidevice_packed_child.py {dp|zero}
+Prints one JSON line with the compared losses. Opt-in via the parent
+tests at the bottom of tests/test_packing.py (PBT_RUN_PACKED_MD=1, same
+gate style as the PBT_RUN_TIER64 pod tier; tools/run_tier1.sh
+--packed-md).
+"""
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+MODEL = dict(local_dim=16, global_dim=64, key_dim=16, num_heads=4,
+             num_blocks=2, num_annotations=64, dtype="float32")
+
+
+def _parity(scenario):
+    import numpy as np
+
+    import jax
+    from proteinbert_tpu.configs import (
+        DataConfig, MeshConfig, ModelConfig, OptimizerConfig,
+        ParallelConfig, PretrainConfig, TrainConfig,
+    )
+    from proteinbert_tpu.data import (
+        InMemoryPretrainingDataset, make_packed_iterator,
+    )
+    from proteinbert_tpu.data.synthetic import make_random_proteins
+    from proteinbert_tpu.parallel import (
+        batch_sharding, make_mesh, shard_train_state,
+    )
+    from proteinbert_tpu.parallel.sharding import state_sharding
+    from proteinbert_tpu.parallel.zero import make_zero_train_step
+    from proteinbert_tpu.train import create_train_state, train_step
+
+    zero = scenario == "zero"
+    mesh_cfg = MeshConfig(data=4, fsdp=2)
+    cfg = PretrainConfig(
+        model=ModelConfig(**MODEL),
+        data=DataConfig(seq_len=64, batch_size=8, packing=True,
+                        pack_max_segments=4),
+        optimizer=OptimizerConfig(learning_rate=1e-3, warmup_steps=10),
+        mesh=mesh_cfg,
+        parallel=ParallelConfig(zero_update=zero),
+        train=TrainConfig(max_steps=2),
+    )
+    rng = np.random.default_rng(0)
+    seqs, ann = make_random_proteins(
+        64, rng, num_annotations=MODEL["num_annotations"], max_len=24)
+    seqs = [s or "A" for s in seqs]
+    ds = InMemoryPretrainingDataset(seqs, ann, cfg.data.seq_len)
+    batch = next(make_packed_iterator(ds, cfg.data.batch_size, seed=0,
+                                      max_segments=4))
+    assert max(int(s.max()) for s in batch["segment_ids"]) >= 2
+
+    ref_state, ref_m = train_step(
+        create_train_state(jax.random.PRNGKey(0), cfg), dict(batch), cfg)
+
+    mesh = make_mesh(mesh_cfg)
+    state = create_train_state(jax.random.PRNGKey(0), cfg)
+    if zero:
+        abstract = jax.eval_shape(lambda: state)
+        state = jax.device_put(state, state_sharding(mesh, abstract,
+                                                     zero_update=True))
+        step = make_zero_train_step(mesh, cfg)
+        step_fn = lambda s, b: step(s, b)  # noqa: E731
+    else:
+        state = shard_train_state(state, mesh)
+        step_fn = lambda s, b: train_step(s, b, cfg)  # noqa: E731
+    bsh = batch_sharding(mesh)
+    dbatch = {k: jax.device_put(v, bsh[k]) for k, v in batch.items()}
+    new_state, m = step_fn(state, dbatch)
+
+    ref_loss, got_loss = float(ref_m["loss"]), float(m["loss"])
+    assert abs(got_loss - ref_loss) <= 2e-5 * max(1.0, abs(ref_loss)), (
+        ref_loss, got_loss)
+    max_err = 0.0
+    for r, g in zip(jax.tree.leaves(ref_state.params),
+                    jax.tree.leaves(new_state.params)):
+        err = float(np.max(np.abs(
+            np.asarray(r, np.float64)
+            - np.asarray(jax.device_get(g), np.float64))))
+        max_err = max(max_err, err)
+    assert max_err < 2e-5, (scenario, max_err)
+    return {"mesh": dict(mesh.shape), "zero_update": zero,
+            "ref_loss": ref_loss, "sharded_loss": got_loss,
+            "max_param_err": max_err}
+
+
+def main():
+    scenario = sys.argv[1]
+    import jax
+
+    from proteinbert_tpu.utils.compat import request_cpu_devices
+
+    request_cpu_devices(8)
+    assert jax.device_count() == 8, jax.device_count()
+    out = _parity(scenario)
+    print(json.dumps({"scenario": scenario, "ok": True, **out}))
+
+
+if __name__ == "__main__":
+    main()

@@ -371,6 +371,29 @@ def test_prefetch_propagates_errors():
         next(it)
 
 
+def test_prefetch_raising_source_surfaces_within_one_next():
+    """ISSUE 4 satellite: a source that raises BEFORE yielding anything
+    must surface its exception — with the producer's original traceback,
+    not a generic StopIteration — at the very first __next__."""
+    import traceback
+
+    from proteinbert_tpu.data.prefetch import prefetch
+
+    def bad():
+        raise ValueError("broken at batch 0")
+        yield  # pragma: no cover
+
+    it = prefetch(bad(), depth=2)
+    with pytest.raises(ValueError, match="broken at batch 0") as exc_info:
+        next(it)
+    # the traceback points into the producer, not only the queue plumbing
+    frames = traceback.extract_tb(exc_info.value.__traceback__)
+    assert any(f.name == "bad" for f in frames), [f.name for f in frames]
+    # and the iterator is cleanly done afterwards, not wedged
+    with pytest.raises(StopIteration):
+        next(it)
+
+
 def test_prefetch_close_stops_thread():
     import itertools
 

@@ -1,0 +1,438 @@
+"""Segment-aware sequence packing (ISSUE 4): packer determinism and
+multi-host lockstep, packed-vs-unpacked model/loss parity, the
+cross-segment-leakage bit-identity proof, and the pad_fraction /
+dropped-row telemetry shared with the bucketed iterator.
+
+Cost discipline: ONE canonical fp32 tiny model config and ONE packed
+shape serve every jitted test in this module (cfg is a static jit arg —
+every variant recompiles); the planner/iterator tests are pure numpy.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from proteinbert_tpu.configs import (
+    DataConfig, ModelConfig, OptimizerConfig, PretrainConfig, TrainConfig,
+)
+from proteinbert_tpu.data import (
+    InMemoryPretrainingDataset, make_packed_iterator,
+)
+from proteinbert_tpu.data.corruption import corrupt_packed_batch, packed_weights
+from proteinbert_tpu.data.packing import PackPlanner, pad_fraction, unpack_segments
+from proteinbert_tpu.data.vocab import N_SPECIAL, PAD_ID
+from proteinbert_tpu.models import proteinbert
+from proteinbert_tpu.train.loss import (
+    packed_pretrain_loss, packed_segment_losses, pretrain_loss,
+)
+
+SEQ_LEN = 128
+MAX_SEG = 4
+A = 32
+
+CFG = ModelConfig(local_dim=32, global_dim=64, key_dim=16, num_heads=4,
+                  num_blocks=2, num_annotations=A, dtype="float32")
+
+
+def _corpus(n=64, max_len=50, seed=0):
+    from proteinbert_tpu.data.synthetic import make_random_proteins
+
+    rng = np.random.default_rng(seed)
+    seqs, ann = make_random_proteins(n, rng, num_annotations=A,
+                                     max_len=max_len, density=0.1)
+    # Guarantee every row exists (length >= 1) so per-sequence parity
+    # bookkeeping is simple.
+    seqs = [s or "A" for s in seqs]
+    return InMemoryPretrainingDataset(seqs, ann, SEQ_LEN)
+
+
+@pytest.fixture(scope="module")
+def ds():
+    return _corpus()
+
+
+@pytest.fixture(scope="module")
+def packed_batch(ds):
+    return next(make_packed_iterator(ds, batch_size=2, seed=0,
+                                     max_segments=MAX_SEG))
+
+
+@pytest.fixture(scope="module")
+def params():
+    return proteinbert.init(jax.random.PRNGKey(0), CFG)
+
+
+# ------------------------------------------------------------- planner
+
+def test_planner_first_fit_and_bounds():
+    p = PackPlanner(seq_len=100, max_segments=3, max_open=4)
+    closed = []
+    for rid, ln in enumerate([60, 30, 50, 40, 10]):
+        closed += p.add(rid, ln)
+    closed += p.flush()
+    rows = {r for g in closed for r in g}
+    assert rows == set(range(5))  # nothing lost
+    for g in closed:
+        assert len(g) <= 3
+    # first-fit: 30 lands with 60 (fits), 40 with 50, 10 back with 60+30
+    assert [0, 1, 4] in closed and [2, 3] in closed
+
+
+def test_planner_full_row_and_segment_cap():
+    p = PackPlanner(seq_len=100, max_segments=2, max_open=8)
+    # A full-length row closes immediately (remaining 0 < min fit).
+    assert p.add(0, 100) == [[0]]
+    # Segment cap closes a row even with capacity left.
+    assert p.add(1, 10) == []
+    assert p.add(2, 10) == [[1, 2]]
+    assert p.flush() == []
+
+
+def test_packed_iterator_shapes_and_invariants(ds, packed_batch):
+    b = packed_batch
+    assert b["tokens"].shape == (2, SEQ_LEN)
+    assert b["segment_ids"].shape == (2, SEQ_LEN)
+    assert b["annotations"].shape == (2, MAX_SEG, A)
+    # pad positions and segment-0 positions coincide exactly
+    np.testing.assert_array_equal(b["tokens"] == PAD_ID,
+                                  b["segment_ids"] == 0)
+    # segments are contiguous, 1..n in order, no interior pad
+    for row in b["segment_ids"]:
+        nz = row[row > 0]
+        assert (np.diff(nz) >= 0).all() and nz[0] == 1
+    # every packed segment round-trips to a dataset row
+    tok_set = {tuple(t[t != PAD_ID]) for t in ds.tokens}
+    for toks, _ in unpack_segments(b):
+        assert tuple(toks) in tok_set
+    # packing actually packs: multiple segments and low pad on this corpus
+    assert all(row.max() >= 2 for row in b["segment_ids"])
+    assert pad_fraction(b["tokens"]) < 0.5
+
+
+def test_packed_iterator_deterministic_and_restart(ds):
+    a = [next(it) for it in [make_packed_iterator(ds, 2, seed=3)] for _ in range(4)]
+    it2 = make_packed_iterator(ds, 2, seed=3)
+    b = [next(it2) for _ in range(4)]
+    for x, y in zip(a, b):
+        for k in x:
+            np.testing.assert_array_equal(x[k], y[k])
+    # skip_batches replays the plan without data: batch 2 == batch 2
+    it3 = make_packed_iterator(ds, 2, seed=3, skip_batches=2)
+    resumed = next(it3)
+    for k in resumed:
+        np.testing.assert_array_equal(resumed[k], a[2][k])
+
+
+def test_packed_iterator_multihost_lockstep(ds):
+    """Two hosts with the same seed agree on the global packing plan and
+    take disjoint slices of it (the multi-host invariant collective
+    steps require)."""
+    h0 = next(make_packed_iterator(ds, 2, seed=1, process_index=0,
+                                   process_count=2))
+    h1 = next(make_packed_iterator(ds, 2, seed=1, process_index=1,
+                                   process_count=2))
+    assert h0["tokens"].shape == h1["tokens"].shape
+    seqs0 = {tuple(t) for t, _ in unpack_segments(h0)}
+    seqs1 = {tuple(t) for t, _ in unpack_segments(h1)}
+    assert seqs0 and seqs1 and not (seqs0 & seqs1)
+
+
+def test_pad_fraction_and_drop_metrics(ds):
+    """Packed and bucketed iterators report pad_fraction under the SAME
+    metric name (strategy-labeled) plus dropped-row counters — the
+    cross-strategy comparison contract (ISSUE 4 satellite)."""
+    from proteinbert_tpu.data.dataset import make_bucketed_iterator
+    from proteinbert_tpu.obs import MetricsRegistry
+
+    reg = MetricsRegistry()
+    n_pack = sum(1 for _ in make_packed_iterator(
+        ds, 2, seed=0, num_epochs=1, metrics=reg))
+    snap = reg.snapshot()
+    g = snap["gauges"]['data_pad_fraction{strategy="packed"}']
+    assert 0.0 <= g < 1.0 and n_pack > 0
+    assert snap["counters"]["data_packed_rows_total"] == 2 * n_pack
+    assert snap["counters"]["data_packed_segments_total"] >= 2 * n_pack
+    # the sub-batch remainder is counted, not silently lost
+    dropped = snap["counters"].get(
+        'data_dropped_rows_total{strategy="packed"}', 0)
+    segs = snap["counters"]["data_packed_segments_total"]
+    assert segs + dropped == len(ds)
+
+    reg2 = MetricsRegistry()
+    n_buck = sum(1 for _ in make_bucketed_iterator(
+        ds, 2, buckets=(64, SEQ_LEN), seed=0, num_epochs=1, metrics=reg2))
+    snap2 = reg2.snapshot()
+    assert 'data_pad_fraction{strategy="bucketed"}' in snap2["gauges"]
+    rows_emitted = 2 * n_buck
+    dropped2 = snap2["counters"].get(
+        'data_dropped_rows_total{strategy="bucketed"}', 0)
+    assert rows_emitted + dropped2 == len(ds)
+
+
+# ---------------------------------------------------------- corruption
+
+def test_packed_corruption_protects_every_segments_specials(packed_batch):
+    tokens = jnp.asarray(packed_batch["tokens"])
+    seg = jnp.asarray(packed_batch["segment_ids"])
+    ann = jnp.asarray(packed_batch["annotations"])
+    X, Y, W = corrupt_packed_batch(jax.random.PRNGKey(7), tokens, seg, ann,
+                                   token_randomize_prob=0.9)
+    special = np.asarray(tokens) < N_SPECIAL  # <pad>/<sos>/<eos> anywhere
+    np.testing.assert_array_equal(np.asarray(X["local"])[special],
+                                  np.asarray(tokens)[special])
+    # weights: local == real positions; global == segment exists AND has
+    # a positive annotation
+    np.testing.assert_array_equal(np.asarray(W["local"]),
+                                  (np.asarray(seg) > 0).astype(np.float32))
+    gw = np.asarray(W["global"])
+    seg_np = np.asarray(seg)
+    ann_np = np.asarray(ann)
+    for b in range(gw.shape[0]):
+        for s in range(gw.shape[1]):
+            exists = (seg_np[b] == s + 1).any()
+            expect = 1.0 if (exists and ann_np[b, s].sum() > 0) else 0.0
+            assert (gw[b, s] == expect).all()
+
+
+def test_packed_annotation_corruption_is_per_segment(packed_batch):
+    """The keep/hide draw is independent per packed protein — find a key
+    where two segments of one row take different branches."""
+    tokens = jnp.asarray(packed_batch["tokens"])
+    seg = jnp.asarray(packed_batch["segment_ids"])
+    ann = jnp.ones_like(jnp.asarray(packed_batch["annotations"]))
+    seen_mixed = False
+    for k in range(8):
+        X, _, _ = corrupt_packed_batch(
+            jax.random.PRNGKey(k), tokens, seg, ann,
+            annotation_corrupt_prob=0.5, annotation_drop_prob=0.0,
+            annotation_add_prob=0.0)
+        hidden = np.asarray(X["global"]).sum(-1) == 0  # (B, S)
+        if hidden.any() and (~hidden).any():
+            seen_mixed = True
+            break
+    assert seen_mixed
+
+
+# ------------------------------------------------- model parity / leak
+
+def _solo_rows(packed_batch):
+    """Each packed protein alone in its own (1, L) row via the S=1
+    packed path — the pad-correct per-sequence baseline."""
+    rows = []
+    for toks, ann in unpack_segments(packed_batch):
+        t = np.zeros((SEQ_LEN,), np.int32)
+        t[: len(toks)] = toks
+        s = np.zeros((SEQ_LEN,), np.int32)
+        s[: len(toks)] = 1
+        rows.append((t, s, ann))
+    return rows
+
+
+def test_packed_vs_solo_per_sequence_parity(params, packed_batch):
+    """Packed-on vs packed-off parity: the same proteins run (a) packed
+    several-per-row and (b) one-per-row, and the per-sequence local
+    logits, global vectors, and losses agree within fp32 tolerance (the
+    two programs have different shapes, so XLA's reduction orders differ
+    by ~1e-6 — bit-identity is asserted by the leakage test, which
+    compares within ONE program)."""
+    seg = jnp.asarray(packed_batch["segment_ids"])
+    ll_p, gl_p = proteinbert.apply(
+        params, jnp.asarray(packed_batch["tokens"]),
+        jnp.asarray(packed_batch["annotations"]), CFG, segment_ids=seg)
+    Y = {"local": jnp.asarray(packed_batch["tokens"]),
+         "global": jnp.asarray(packed_batch["annotations"])}
+    W = packed_weights(Y["local"], seg, Y["global"])
+    per_seg = jax.tree.map(np.asarray, packed_segment_losses(
+        ll_p, gl_p, Y, W, seg))
+    ll_p, gl_p = np.asarray(ll_p), np.asarray(gl_p)
+
+    solo = _solo_rows(packed_batch)
+    i = 0
+    for b in range(packed_batch["tokens"].shape[0]):
+        for s in range(1, int(packed_batch["segment_ids"][b].max()) + 1):
+            t, sid, ann = solo[i]
+            i += 1
+            ll1, gl1 = proteinbert.apply(
+                params, jnp.asarray(t[None]), jnp.asarray(ann[None, None]),
+                CFG, segment_ids=jnp.asarray(sid[None]))
+            n = int(sid.sum())
+            mask = packed_batch["segment_ids"][b] == s
+            np.testing.assert_allclose(ll_p[b][mask], np.asarray(ll1)[0, :n],
+                                       atol=1e-5, rtol=1e-5)
+            np.testing.assert_allclose(gl_p[b, s - 1], np.asarray(gl1)[0, 0],
+                                       atol=1e-5, rtol=1e-5)
+            # per-sequence losses: packed per-segment vs solo per-segment
+            Y1 = {"local": jnp.asarray(t[None]),
+                  "global": jnp.asarray(ann[None, None])}
+            W1 = packed_weights(Y1["local"], jnp.asarray(sid[None]),
+                                Y1["global"])
+            solo_seg = jax.tree.map(np.asarray, packed_segment_losses(
+                ll1, gl1, Y1, W1, jnp.asarray(sid[None])))
+            np.testing.assert_allclose(per_seg["local"][b, s - 1],
+                                       solo_seg["local"][0, 0], atol=1e-5)
+            np.testing.assert_allclose(per_seg["global"][b, s - 1],
+                                       solo_seg["global"][0, 0], atol=1e-5)
+    assert i == len(solo)
+
+
+def test_single_segment_full_row_matches_unpacked_model(params):
+    """On rows with NO padding the segment-aware path (tap-decomposed
+    masked convs + per-segment attention) must reproduce the plain
+    unpacked model within fp32 tolerance — this pins the implicit-GEMM
+    conv decomposition against lax.conv_general_dilated. (On PADDED
+    rows the two paths deliberately diverge: the unpacked convs read
+    pad-position activations near the tail, the packed path masks them
+    — docs/data.md 'Packing' section.)"""
+    rng = np.random.default_rng(5)
+    from proteinbert_tpu.data.vocab import ALPHABET
+
+    seqs = ["".join(rng.choice(list(ALPHABET), size=SEQ_LEN - 2))
+            for _ in range(2)]
+    ann = (rng.random((2, A)) < 0.1).astype(np.float32)
+    full_ds = InMemoryPretrainingDataset(seqs, ann, SEQ_LEN)
+    full = full_ds.tokens
+    assert (full != PAD_ID).all()
+    ll_u, gl_u = proteinbert.apply(params, jnp.asarray(full),
+                                   jnp.asarray(ann), CFG)
+    seg = np.ones_like(full)
+    ll_p, gl_p = proteinbert.apply(params, jnp.asarray(full),
+                                   jnp.asarray(ann[:, None, :]), CFG,
+                                   segment_ids=jnp.asarray(seg))
+    np.testing.assert_allclose(np.asarray(ll_u), np.asarray(ll_p),
+                               atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(gl_u), np.asarray(gl_p)[:, 0],
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_cross_segment_leakage_bit_identical(params, packed_batch):
+    """THE leakage proof (ISSUE 4 acceptance): perturb one segment's
+    tokens AND annotations; every other segment's local outputs, global
+    vector, and per-segment losses are BIT-identical (same compiled
+    program, so masked contributions are exact zeros — multiplication
+    by a zero mask / exp-underflowed softmax weights, not small
+    numbers)."""
+    fwd = jax.jit(lambda t, a, s: proteinbert.apply(
+        params, t, a, CFG, segment_ids=s))
+    seg = jnp.asarray(packed_batch["segment_ids"])
+
+    def outputs(tokens_np, ann_np):
+        ll, gl = fwd(jnp.asarray(tokens_np), jnp.asarray(ann_np), seg)
+        Y = {"local": jnp.asarray(tokens_np), "global": jnp.asarray(ann_np)}
+        W = packed_weights(Y["local"], seg, Y["global"])
+        losses = packed_segment_losses(ll, gl, Y, W, seg)
+        return (np.asarray(ll), np.asarray(gl),
+                jax.tree.map(np.asarray, losses))
+
+    ll0, gl0, seg0 = outputs(packed_batch["tokens"],
+                             packed_batch["annotations"])
+    t1 = np.array(packed_batch["tokens"])
+    a1 = np.array(packed_batch["annotations"])
+    pos = np.flatnonzero(packed_batch["segment_ids"][0] == 1)
+    t1[0, pos[1:-1]] = ((t1[0, pos[1:-1]] - N_SPECIAL + 7)
+                        % (26 - N_SPECIAL)) + N_SPECIAL
+    a1[0, 0] = 1.0 - a1[0, 0]
+    ll1, gl1, seg1 = outputs(t1, a1)
+
+    # the perturbed segment itself did change (the test has teeth)
+    assert not np.array_equal(ll0[0][pos], ll1[0][pos])
+    # every OTHER segment: bit-identical local slice, global row, losses
+    other = np.asarray(packed_batch["segment_ids"][0]) >= 2
+    np.testing.assert_array_equal(ll0[0][other], ll1[0][other])
+    np.testing.assert_array_equal(gl0[0, 1:], gl1[0, 1:])
+    for k in ("local", "global", "local_acc"):
+        np.testing.assert_array_equal(seg0[k][0, 1:], seg1[k][0, 1:])
+    # untouched ROWS are bit-identical wholesale
+    np.testing.assert_array_equal(ll0[1:], ll1[1:])
+    np.testing.assert_array_equal(gl0[1:], gl1[1:])
+    for k in ("local", "global"):
+        np.testing.assert_array_equal(seg0[k][1:], seg1[k][1:])
+
+
+# -------------------------------------------------------- train / loss
+
+def test_packed_loss_normalizes_per_segment():
+    """A long and a short segment contribute equally: per-token CE of
+    1.0 on both -> local_loss 1.0 regardless of length ratio."""
+    B, L, S, V = 1, 16, 2, 5
+    seg = jnp.asarray([[1] * 12 + [2] * 3 + [0]], jnp.int32)
+    tgt = jnp.zeros((B, L), jnp.int32)
+    # logits chosen so CE is identical at every position
+    ll = jnp.zeros((B, L, V), jnp.float32)
+    gl = jnp.zeros((B, S, 3), jnp.float32)
+    Y = {"local": tgt, "global": jnp.ones((B, S, 3), jnp.float32)}
+    W = {"local": (seg > 0).astype(jnp.float32),
+         "global": jnp.ones((B, S, 3), jnp.float32)}
+    total, m = packed_pretrain_loss(ll, gl, Y, W, seg)
+    expect_ce = float(np.log(V))
+    np.testing.assert_allclose(float(m["local_loss"]), expect_ce, rtol=1e-6)
+    # and the unpacked token-weighted loss would give the same here
+    # (uniform CE), so the per-segment normalization is scale-compatible
+    np.testing.assert_allclose(float(m["global_loss"]),
+                               float(np.log(1 + np.e ** -0)), rtol=1e-5)
+
+
+def test_packed_train_and_eval_step(packed_batch):
+    """End-to-end: the jitted train/eval steps take the packed branch
+    from the batch's pytree structure, losses are finite, params move."""
+    from proteinbert_tpu.train import create_train_state
+    from proteinbert_tpu.train.train_state import eval_step, train_step
+
+    cfg = PretrainConfig(
+        model=CFG,
+        data=DataConfig(seq_len=SEQ_LEN, batch_size=2, packing=True,
+                        pack_max_segments=MAX_SEG),
+        optimizer=OptimizerConfig(warmup_steps=5),
+        train=TrainConfig(max_steps=3))
+    state = create_train_state(jax.random.PRNGKey(0), cfg)
+    p0 = jax.tree.leaves(state.params)[0].copy()
+    state, m = train_step(state, packed_batch, cfg)
+    state, m = train_step(state, packed_batch, cfg)
+    assert np.isfinite(float(m["loss"])) and float(m["grad_norm"]) > 0
+    assert not np.allclose(np.asarray(jax.tree.leaves(state.params)[0]),
+                           np.asarray(p0))
+    em = eval_step(state, packed_batch, jax.random.PRNGKey(1), cfg)
+    assert np.isfinite(float(em["loss"]))
+    assert 0.0 <= float(em["global_auroc"]) <= 1.0
+    assert "ranking_stats" in em
+
+
+# --------------------------------------- opt-in multi-device parity tier
+# Same gate style as the PBT_RUN_TIER64 pod tier: slow-marked (tier-1's
+# -m 'not slow' never collects it) AND env-gated, spawning a fresh
+# 8-virtual-device child so the packed sharding rules (segment_ids like
+# tokens; (B, S, A) annotations batch-sharded) are proven off the
+# in-suite process. tools/run_tier1.sh --packed-md runs it.
+
+import subprocess  # noqa: E402
+import sys  # noqa: E402
+import os  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_md = pytest.mark.skipif(
+    not os.environ.get("PBT_RUN_PACKED_MD"),
+    reason="multi-device packed tier is opt-in: set PBT_RUN_PACKED_MD=1 "
+           "(or run tools/run_tier1.sh --packed-md)")
+
+
+@pytest.mark.slow
+@_md
+@pytest.mark.parametrize("scenario", ["dp", "zero"])
+def test_multidevice_packed_parity_child(scenario):
+    import json
+
+    from proteinbert_tpu.utils.compat import scrub_device_count_flag
+
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = scrub_device_count_flag(env.get("XLA_FLAGS", ""))
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "tests", "multidevice_packed_child.py"),
+         scenario],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=900)
+    assert out.returncode == 0, (out.stdout[-2000:], out.stderr[-3000:])
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["ok"] and rec["scenario"] == scenario
+    assert abs(rec["sharded_loss"] - rec["ref_loss"]) <= 2e-5

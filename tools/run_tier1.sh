@@ -8,13 +8,21 @@
 # without the flag; the pod tier's pass/fail is OR-ed into the exit
 # code but its dots are reported separately (the DOTS_PASSED contract
 # counts tier-1 only).
+#
+# --packed-md: ALSO run the opt-in multi-device PACKED-batch parity
+# tier (tests/test_packing.py slow lane, PBT_RUN_PACKED_MD gate — same
+# style as --pod64): fresh 8-virtual-device children prove the packed
+# sharding rules (segment_ids like tokens) under plain DP+fsdp and the
+# ZeRO-1 zero-update.
 set -o pipefail
 
 POD64=0
+PACKED_MD=0
 for arg in "$@"; do
   case "$arg" in
     --pod64) POD64=1 ;;
-    *) echo "unknown flag: $arg (supported: --pod64)" >&2; exit 2 ;;
+    --packed-md) PACKED_MD=1 ;;
+    *) echo "unknown flag: $arg (supported: --pod64, --packed-md)" >&2; exit 2 ;;
   esac
 done
 
@@ -31,6 +39,15 @@ echo "=== telemetry events-schema validator self-test ==="
 python "$(dirname "$0")/validate_events.py" --self-test
 rcv=$?
 [ "$rc" -eq 0 ] && rc=$rcv
+
+if [ "$PACKED_MD" = "1" ]; then
+  echo "=== packed multi-device parity tier (8 virtual devices, opt-in) ==="
+  timeout -k 10 900 env JAX_PLATFORMS=cpu PBT_RUN_PACKED_MD=1 \
+    python -m pytest tests/test_packing.py -q -m 'slow' \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+  rcp=$?
+  [ "$rc" -eq 0 ] && rc=$rcp
+fi
 
 if [ "$POD64" = "1" ]; then
   echo "=== pod64 tier (64 virtual devices, opt-in) ==="

@@ -761,6 +761,281 @@ def run_pack():
     print(json.dumps(record))
 
 
+def run_serve():
+    """`bench.py --serve`: sustained-load online serving vs the
+    one-request-at-a-time offline baseline — one JSON line, CPU-
+    measurable (ISSUE 5 acceptance).
+
+    Three phases over one tiny trunk (untrained params: FLOPs and
+    dispatch behavior are weight-independent):
+
+    1. **baseline** — sequential single-request `inference.embed`
+       calls (batch 1, every request padded to the full seq_len): the
+       only serving story the repo had before the serve/ subsystem.
+    2. **served** — the same request population pushed through
+       `serve.Server` (continuous micro-batching over length buckets,
+       cache OFF so every row pays a real model call), in two load
+       shapes: a SATURATED closed loop (N concurrent client threads,
+       enough to keep every bucket's group full — the throughput
+       number and the ≥3x-vs-baseline claim), then a LIGHT load
+       (fewer clients than one micro-batch) where end-to-end latency
+       is the scheduler's contract rather than queueing theory: p99
+       must stay under max_wait + one batch time (slowest observed
+       batch, plus a small OS-jitter allowance on a shared CI box).
+    3. **contracts** — (a) served-vs-offline BIT-parity per bucket: a
+       full micro-batch formed deterministically through submit()+
+       poll() must equal `inference.embed(bucketed=True)` at the same
+       (bucket_len, batch_class) shape; (b) queue overflow on a server
+       with a tiny bounded queue: every overflow victim observes a
+       typed QueueFullError (rejected, never dropped).
+
+    Exit code is nonzero when a CONTRACT fails (parity, lost requests,
+    un-rejected overflow); the speedup is reported, not gated — wall-
+    clock ratios on a noisy CI box are evidence, not invariants. The
+    capture is mirrored as a `note` on bench_events.jsonl like the
+    other sweeps.
+
+    Knobs: PBT_SERVE_BENCH_SEQ_LEN (512), PBT_SERVE_BENCH_DIM (64),
+    PBT_SERVE_BENCH_REQUESTS (96), PBT_SERVE_BENCH_CLIENTS (16),
+    PBT_SERVE_BENCH_MAX_BATCH (8), PBT_SERVE_BENCH_MEDIAN_LEN
+    (seq_len // 8).
+    """
+    import threading
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS", "") != "tpu":
+        force_cpu_backend()
+    enable_compile_cache()
+
+    from proteinbert_tpu import inference
+    from proteinbert_tpu.configs import (
+        DataConfig, ModelConfig, OptimizerConfig, PretrainConfig,
+        TrainConfig,
+    )
+    from proteinbert_tpu.data.vocab import ALPHABET
+    from proteinbert_tpu.serve import QueueFullError, Server
+    from proteinbert_tpu.train import create_train_state
+
+    seq_len = int(os.environ.get("PBT_SERVE_BENCH_SEQ_LEN", 512))
+    dim = int(os.environ.get("PBT_SERVE_BENCH_DIM", 64))
+    n_requests = int(os.environ.get("PBT_SERVE_BENCH_REQUESTS", 96))
+    n_clients = int(os.environ.get("PBT_SERVE_BENCH_CLIENTS", 32))
+    max_batch = int(os.environ.get("PBT_SERVE_BENCH_MAX_BATCH", 8))
+    median = int(os.environ.get("PBT_SERVE_BENCH_MEDIAN_LEN", seq_len // 10))
+    max_wait_s = 0.01
+
+    model = ModelConfig(local_dim=dim, global_dim=2 * dim, key_dim=16,
+                        num_heads=4, num_blocks=2,
+                        num_annotations=max(4 * dim, 128), dtype="float32")
+    buckets = tuple(sorted({max(16, seq_len // 8), seq_len // 4,
+                            seq_len // 2, seq_len}))
+    cfg = PretrainConfig(
+        model=model,
+        data=DataConfig(seq_len=seq_len, batch_size=max_batch,
+                        buckets=buckets),
+        optimizer=OptimizerConfig(warmup_steps=10),
+        train=TrainConfig(max_steps=1))
+    params = create_train_state(jax.random.PRNGKey(0), cfg).params
+
+    # UniRef-like ragged lengths, clipped to the model window.
+    rng = np.random.default_rng(0)
+    lengths = np.clip(
+        rng.lognormal(mean=np.log(median), sigma=0.45, size=n_requests),
+        10, seq_len - 2).astype(np.int64)
+    alphabet = np.array(list(ALPHABET))
+    seqs = ["".join(rng.choice(alphabet, size=int(L))) for L in lengths]
+
+    # ---- phase 1: sequential single-request offline baseline ----------
+    inference.embed(params, cfg, [seqs[0]], batch_size=1)  # compile
+    base_n = min(n_requests, max(2 * max_batch, 24))
+    t0 = time.perf_counter()
+    for s in seqs[:base_n]:
+        inference.embed(params, cfg, [s], batch_size=1)
+    base_dt = time.perf_counter() - t0
+    baseline = {"requests": base_n,
+                "requests_per_sec": round(base_n / base_dt, 2),
+                "ms_per_request": round(base_dt / base_n * 1e3, 2)}
+
+    # ---- phase 2: sustained concurrent load through the server --------
+    from proteinbert_tpu.obs import Telemetry
+
+    failures = []
+    # Metrics-only telemetry (no events file): the registry's
+    # serve_batch_seconds histogram supplies the p99-bound batch time.
+    server = Server(params, cfg, max_batch=max_batch, max_wait_s=max_wait_s,
+                    queue_depth=4 * n_requests, cache_size=0,
+                    warm_kinds=("embed",), telemetry=Telemetry())
+    t0 = time.perf_counter()
+    server.start()
+    warm_s = time.perf_counter() - t0
+    def run_load(indices, clients) -> tuple:
+        results = {}
+
+        def client(worker: int) -> None:
+            for i in indices[worker::clients]:
+                try:
+                    results[i] = server.embed(seqs[i], timeout=120)
+                except Exception as e:  # noqa: BLE001 — report, don't hang
+                    failures.append(f"request {i}: {type(e).__name__}: {e}")
+
+        threads = [threading.Thread(target=client, args=(w,))
+                   for w in range(clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(300)
+        dt = time.perf_counter() - t0
+        # Quiesce: a request's future resolves BEFORE the scheduler
+        # records its latency, so returning the moment all futures are
+        # done races the last batch's bookkeeping (and a stale
+        # saturated-phase sample landing in the light window would be a
+        # spurious p99 failure). rows_total is bumped after the whole
+        # batch's latencies are observed — wait for it to go stable
+        # with nothing queued or pending.
+        deadline = time.monotonic() + 5.0
+        prev = -1
+        while time.monotonic() < deadline:
+            cur = server.scheduler.rows_total
+            pending = server.scheduler.pending_rows()
+            if cur == prev and len(server.queue) == 0 and pending == 0:
+                break
+            prev = cur
+            time.sleep(0.02)
+        return results, dt
+
+    # Saturated closed loop: enough concurrent clients that every
+    # bucket's group keeps filling — the throughput measurement.
+    sat_results, sat_dt = run_load(list(range(n_requests)), n_clients)
+    sat_stats = server.stats()
+    if len(sat_results) != n_requests:
+        failures.append(
+            f"lost requests: {n_requests - len(sat_results)} of "
+            f"{n_requests} never resolved")
+
+    # Light load: fewer clients than one micro-batch, so nothing queues
+    # behind a saturated device — end-to-end latency is the scheduler
+    # contract (≤ max_wait + one batch time), not queueing delay.
+    light_n = max(max_batch, n_requests // 4)
+    light_window = type(server.latencies)()
+    server.latencies = light_window  # fresh percentile ring
+    light_results, _ = run_load(list(range(light_n)),
+                                max(2, max_batch // 2))
+    batch_h = server.tele.metrics.histogram("serve_batch_seconds")
+    max_batch_s = batch_h.max if batch_h.count else 0.0
+    server.drain(timeout=60)
+    p99 = light_window.percentile(99) or 0.0
+    # Allowance on top of the contract bound: the scheduler's idle park
+    # (max_wait/2) plus thread-wakeup jitter on a shared CI box. The
+    # bound is REPORTED (light_p99_within_bound), not a gate failure:
+    # wall-clock on a noisy CI box is evidence, not an invariant — the
+    # light window holds ~light_n samples, so its p99 is effectively
+    # the max sample and one OS scheduling hiccup would flake tier-1.
+    p99_bound = max_wait_s + max_batch_s + max_wait_s / 2 + 0.01
+    if len(light_results) != light_n:
+        failures.append(f"light phase lost requests: "
+                        f"{light_n - len(light_results)} of {light_n} "
+                        "never resolved")
+    served = {
+        "requests": len(sat_results),
+        "clients": n_clients,
+        "requests_per_sec": round(n_requests / sat_dt, 2),
+        "saturated_p50_ms": round(
+            (sat_stats["latency"]["p50_s"] or 0.0) * 1e3, 2),
+        "saturated_p99_ms": round(
+            (sat_stats["latency"]["p99_s"] or 0.0) * 1e3, 2),
+        "light_p50_ms": round((light_window.percentile(50) or 0.0) * 1e3,
+                              2),
+        "light_p99_ms": round(p99 * 1e3, 2),
+        "max_wait_ms": round(max_wait_s * 1e3, 2),
+        "max_batch_ms": round(max_batch_s * 1e3, 2),
+        "light_p99_bound_ms": round(p99_bound * 1e3, 2),
+        "light_p99_within_bound": bool(p99 <= p99_bound),
+        "batches": sat_stats["batches"],
+        "mean_rows_per_batch": round(
+            sat_stats["batched_rows"] / max(sat_stats["batches"], 1), 2),
+        "warmup_s": round(warm_s, 2),
+    }
+
+    # ---- phase 3a: served-vs-offline bit-parity per bucket ------------
+    parity = {}
+    by_bucket = {}
+    for s in seqs:
+        by_bucket.setdefault(server.dispatcher.bucket_len(len(s)), []).append(s)
+    for bucket, group in sorted(by_bucket.items()):
+        group = group[:max_batch]
+        psrv = Server(params, cfg, max_batch=len(group), max_wait_s=60.0,
+                      cache_size=0, warm_kinds=())
+        futures = [psrv.submit("embed", s) for s in group]
+        psrv.scheduler.poll()  # deterministic single-batch formation
+        offline = inference.embed(params, cfg, group, bucketed=True,
+                                  buckets=buckets, batch_size=len(group))
+        ok = all(
+            np.array_equal(f.result(timeout=0)["global"],
+                           offline["global"][i])
+            and np.array_equal(f.result(timeout=0)["local_mean"],
+                               offline["local_mean"][i])
+            for i, f in enumerate(futures))
+        parity[str(bucket)] = {"rows": len(group), "bit_identical": ok}
+        if not ok:
+            failures.append(f"served-vs-offline parity broke in "
+                            f"bucket {bucket}")
+
+    # ---- phase 3b: overflow is rejected, never dropped ----------------
+    depth = max(2, max_batch // 2)
+    osrv = Server(params, cfg, max_batch=max_batch, max_wait_s=60.0,
+                  queue_depth=depth, cache_size=0, warm_kinds=())
+    burst = [osrv.submit("embed", s) for s in seqs[: depth + 6]]
+    rejected = sum(
+        1 for f in burst
+        if f.done() and isinstance(f.exception(), QueueFullError))
+    osrv.abort()
+    resolved = sum(1 for f in burst if f.done())
+    overflow = {"submitted": len(burst), "queue_depth": depth,
+                "rejected_queue_full": rejected,
+                "all_observed": resolved == len(burst)}
+    if rejected != 6:
+        failures.append(f"expected 6 overflow rejections, saw {rejected}")
+    if resolved != len(burst):
+        failures.append("overflow burst had silently dropped requests")
+
+    record = {
+        "metric": "serve_load",
+        "platform": jax.devices()[0].platform,
+        "seq_len": seq_len, "model_dim": dim, "median_len": median,
+        "buckets": list(buckets), "max_batch": max_batch,
+        "n_requests": n_requests,
+        "baseline_sequential": baseline,
+        "served": served,
+        "speedup_x": round(served["requests_per_sec"]
+                           / max(baseline["requests_per_sec"], 1e-9), 2),
+        "parity_per_bucket": parity,
+        "overflow": overflow,
+        "failures": failures,
+    }
+    try:  # mirror onto the shared bench event stream (best-effort)
+        from proteinbert_tpu.obs.events import EventLog
+
+        ev = EventLog(os.path.join(os.path.dirname(LAST_GOOD_PATH),
+                                   "bench_events.jsonl"))
+        ev.emit("note", source="bench", kind="serve_capture",
+                platform=record["platform"], seq_len=seq_len,
+                n_requests=n_requests, speedup_x=record["speedup_x"],
+                served_requests_per_sec=served["requests_per_sec"],
+                light_p99_ms=served["light_p99_ms"],
+                rejected_queue_full=overflow["rejected_queue_full"],
+                failures=len(failures))
+        ev.close()
+    except Exception as e:
+        print(f"bench events stream unavailable: {e}", file=sys.stderr)
+    print(json.dumps(record))
+    if failures:
+        for f in failures:
+            print(f"SERVE CONTRACT FAILURE: {f}", file=sys.stderr)
+        sys.exit(1)
+
+
 def run_comm():
     """`bench.py --comm`: per-step collective bytes + per-chip state
     bytes, replicated vs ZeRO-1 zero-update, on a CPU-virtual mesh —
@@ -918,6 +1193,12 @@ def main():
                          "effective MFU) on a realistic length "
                          "distribution and emit one JSON line — "
                          "CI-measurable without a TPU")
+    ap.add_argument("--serve", action="store_true",
+                    help="sustained-load online serving vs the "
+                         "sequential single-request baseline: "
+                         "throughput, p50/p99 latency, per-bucket "
+                         "bit-parity, queue-overflow rejection — one "
+                         "JSON line, CI-measurable without a TPU")
     ap.add_argument("--comm", action="store_true",
                     help="compile the train step replicated vs ZeRO-1 "
                          "zero-update on a CPU-virtual mesh and emit one "
@@ -932,6 +1213,10 @@ def main():
 
     if cli.pack:
         run_pack()
+        return
+
+    if cli.serve:
+        run_serve()
         return
 
     if cli.comm:

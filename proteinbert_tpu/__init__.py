@@ -21,6 +21,8 @@ Package map (≈ reference layer map, SURVEY.md §1):
   parallel/  mesh, sharding rules, sequence parallelism (reference: absent)
   train/     pretrain/fine-tune engines, schedules, checkpointing
              (reference ProteinBERT/utils.py)
+  serve/     online inference: continuous micro-batching over length
+             buckets, result cache, HTTP endpoint (reference: absent)
   utils/     logging/profiling/task-array utilities
              (reference ProteinBERT/shared_utils/util.py)
   cli/       entry points (reference create_uniref_db.py etc.)

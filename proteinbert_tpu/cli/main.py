@@ -21,6 +21,8 @@ is one console with subcommands:
   embed              trunk representations for sequences → HDF5/NPZ
   predict-go         GO-annotation probabilities from sequence alone
   predict-residues   fill '?'-masked residues, report per-position probs
+  serve              online JSON/HTTP inference server (continuous
+                     micro-batching over length buckets, docs/serving.md)
 
 Cluster sharding (reference C17 parity): create-uniref-db reads
 --task-index/--task-count or SLURM array env vars (utils/sharding.py) and
@@ -969,6 +971,90 @@ def cmd_predict_residues(args) -> int:
     return 0
 
 
+def cmd_serve(args) -> int:
+    """Online inference server (ISSUE 5 tentpole): the serving subsystem
+    of proteinbert_tpu/serve/ behind a stdlib HTTP JSON endpoint.
+    Continuous micro-batching over the run's length buckets
+    (cfg.data.buckets, else one full-length bucket), bounded queue with
+    typed rejections, LRU result cache, graceful drain on SIGTERM/
+    SIGINT (in-flight batches finish; new work gets 503)."""
+    import threading
+    import time as _time
+
+    from proteinbert_tpu.serve import Server
+    from proteinbert_tpu.serve.http import make_http_server
+    from proteinbert_tpu.train.resilience import GracefulShutdown
+
+    params, cfg = _load_inference_trunk(args)
+
+    mesh = None
+    if args.mesh:
+        from proteinbert_tpu.parallel import make_mesh
+
+        mesh = make_mesh(cfg.mesh)
+        log(f"serving with batch-dim sharding over {dict(mesh.shape)} "
+            f"({mesh.size} devices)")
+
+    tele = None
+    if args.events_jsonl:
+        from proteinbert_tpu.obs import Telemetry
+
+        tele = Telemetry(events_path=args.events_jsonl)
+        tele.flight.install_excepthook()
+
+    server = Server(
+        params, cfg,
+        max_batch=args.max_batch,
+        max_wait_s=args.max_wait_ms / 1000.0,
+        queue_depth=args.queue_depth,
+        cache_size=args.cache_size,
+        default_deadline_s=(args.deadline_ms / 1000.0
+                            if args.deadline_ms is not None else None),
+        on_long=args.on_long,
+        mesh=mesh,
+        telemetry=tele,
+    )
+    log(f"warming {len(server.dispatcher.buckets)} bucket(s) x "
+        f"{len(server.dispatcher.batch_classes)} batch class(es): "
+        f"buckets={list(server.dispatcher.buckets)}")
+    server.start()
+    httpd = make_http_server(server, args.host, args.port)
+    port = httpd.server_address[1]
+    if args.port_file:
+        with open(args.port_file, "w") as f:
+            f.write(str(port))
+    log(f"serving on http://{args.host}:{port} "
+        f"(max_batch={args.max_batch}, max_wait={args.max_wait_ms}ms, "
+        f"queue_depth={args.queue_depth})")
+    http_thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    try:
+        with GracefulShutdown() as stop:
+            http_thread.start()
+            while not stop.requested:
+                _time.sleep(0.05)
+                if args.max_requests and (
+                        server.completed_total + server.cache_hit_returns
+                        + sum(server.rejected_total.values())
+                        >= args.max_requests):
+                    log(f"--max-requests {args.max_requests} reached")
+                    break
+    finally:
+        # Graceful drain: stop accepting HTTP, finish queued/in-flight
+        # batches, then emit serve_end + export metrics.
+        httpd.shutdown()
+        httpd.server_close()
+        server.drain(timeout=60)
+        if tele is not None:
+            _export_metrics(tele)
+            tele.close()
+    stats = server.stats()
+    log(f"served {stats['completed']} requests "
+        f"({stats['cache_hit_returns']} cache hits, "
+        f"{sum(stats['rejected'].values())} rejected); "
+        f"p50 {stats['latency']['p50_s']}s p99 {stats['latency']['p99_s']}s")
+    return 0
+
+
 # ------------------------------------------------------------------ parser
 
 def build_parser() -> argparse.ArgumentParser:
@@ -1207,6 +1293,47 @@ def build_parser() -> argparse.ArgumentParser:
                         help="fill '?'-masked residues via the local head")
     add_infer_args(pr)
     pr.set_defaults(fn=cmd_predict_residues)
+
+    sv = sub.add_parser("serve",
+                        help="online JSON/HTTP inference server "
+                             "(continuous micro-batching)")
+    sv.add_argument("--pretrained", required=True,
+                    help="pretrain checkpoint dir for the trunk")
+    sv.add_argument("--preset", default="tiny",
+                    choices=["tiny", "base", "long", "large"])
+    sv.add_argument("--pretrained-set", action="append",
+                    metavar="PATH=VALUE",
+                    help="config override the pretrain run was made with")
+    sv.add_argument("--host", default="127.0.0.1")
+    sv.add_argument("--port", type=int, default=8476,
+                    help="0 = ephemeral (read it back via --port-file)")
+    sv.add_argument("--port-file", type=creatable_path,
+                    help="write the bound port here once listening")
+    sv.add_argument("--max-batch", type=int, default=8,
+                    help="micro-batch size cap (dispatch when a "
+                         "(kind, bucket) group reaches it)")
+    sv.add_argument("--max-wait-ms", type=float, default=10.0,
+                    help="max queueing delay before an under-full "
+                         "batch dispatches anyway")
+    sv.add_argument("--queue-depth", type=int, default=64,
+                    help="admission-control bound; overflow evicts the "
+                         "oldest queued request with a 429")
+    sv.add_argument("--cache-size", type=int, default=1024,
+                    help="LRU result-cache entries (0 disables)")
+    sv.add_argument("--deadline-ms", type=float,
+                    help="default per-request deadline (504 when missed)")
+    sv.add_argument("--on-long", default="truncate",
+                    choices=["truncate", "reject"],
+                    help="over-window sequences: truncate-and-count or "
+                         "reject with 400")
+    sv.add_argument("--mesh", action="store_true",
+                    help="shard served batches over the device mesh "
+                         "batch dim")
+    sv.add_argument("--max-requests", type=int,
+                    help="exit after this many requests (smoke tests)")
+    sv.add_argument("--events-jsonl", type=creatable_path,
+                    help="append serve_* run events to this JSONL stream")
+    sv.set_defaults(fn=cmd_serve)
 
     return p
 

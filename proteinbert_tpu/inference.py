@@ -28,6 +28,7 @@ whose GO terms are unknown.
 
 from __future__ import annotations
 
+import logging
 from functools import partial
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -39,7 +40,21 @@ from proteinbert_tpu.configs import ModelConfig, PretrainConfig
 from proteinbert_tpu.data.vocab import EOS_ID, PAD_ID, SOS_ID, UNK_ID, get_vocab
 from proteinbert_tpu.models import proteinbert
 
+logger = logging.getLogger(__name__)
+
 MASK_CHAR = "?"  # maps to <unk>: the "residue unknown, predict it" input
+
+# Process-wide count of sequences whose tail was truncated to fit the
+# model window (the serving layer additionally counts its own
+# serve_truncated_total metric). Mutable one-slot list so callers can
+# read a stable reference.
+TRUNCATED_TOTAL = [0]
+
+
+class SequenceTooLongError(ValueError):
+    """A sequence exceeds the model window (seq_len - 2 residues) and the
+    caller asked for rejection instead of truncate-and-count
+    (`on_overflow="error"`, or the serving layer's `on_long="reject"`)."""
 
 
 def load_state(checkpoint_dir: str, cfg: PretrainConfig):
@@ -96,19 +111,81 @@ def _residue_probs_batch(params, tokens, annotations, cfg: ModelConfig):
     return jax.nn.softmax(local_logits, -1)
 
 
-def _tokenize_masked(seqs: Sequence[str], seq_len: int) -> np.ndarray:
+def _tokenize_masked(seqs: Sequence[str], seq_len: int,
+                     on_overflow: str = "warn") -> np.ndarray:
     """Tokenize with MASK_CHAR → <unk> (no random crop: inference is
-    deterministic; over-length sequences keep their first seq_len-2
-    residues)."""
+    deterministic).
+
+    Over-length handling is never silent (the seed behavior clipped
+    quietly): sequences longer than seq_len-2 residues are either
+    rejected with SequenceTooLongError (`on_overflow="error"`) or
+    truncated AND counted in TRUNCATED_TOTAL, with one warning per call
+    (`on_overflow="warn"`, the default; "count" skips the log line for
+    callers that surface the count themselves — the serving layer does,
+    via its own serve_truncated_total metric in Server.submit).
+    """
+    if on_overflow not in ("warn", "error", "count"):
+        raise ValueError(f"on_overflow must be 'warn', 'error', or "
+                         f"'count', got {on_overflow!r}")
+    window = seq_len - 2
+    too_long = [i for i, s in enumerate(seqs) if len(s) > window]
+    if too_long:
+        if on_overflow == "error":
+            raise SequenceTooLongError(
+                f"{len(too_long)} sequence(s) exceed the model window of "
+                f"{window} residues (first: index {too_long[0]}, length "
+                f"{len(seqs[too_long[0]])}); raise data.seq_len, split "
+                "the sequence, or allow truncation")
+        TRUNCATED_TOTAL[0] += len(too_long)
+        if on_overflow == "warn":
+            logger.warning(
+                "truncating %d sequence(s) longer than the %d-residue "
+                "model window to their first %d residues (counted in "
+                "inference.TRUNCATED_TOTAL)", len(too_long), window,
+                window)
     vocab = get_vocab()
     out = np.full((len(seqs), seq_len), PAD_ID, dtype=np.int32)
     for i, seq in enumerate(seqs):
-        seq = seq[: seq_len - 2]
+        seq = seq[:window]
         ids = vocab.encode(seq)  # MASK_CHAR is outside the alphabet → <unk>
         out[i, 0] = SOS_ID
         out[i, 1 : 1 + len(ids)] = ids
         out[i, 1 + len(ids)] = EOS_ID
     return out
+
+
+def check_annotations(annotations: Optional[np.ndarray], n: int,
+                      cfg: PretrainConfig) -> np.ndarray:
+    """Default-and-validate a query annotation matrix to (n, A) float32
+    (None → the trained "no annotations known" all-zero input). Shared
+    by the offline batch path, the bucketed path, and the serving
+    layer's submit-time validation."""
+    if annotations is None:
+        annotations = np.zeros((n, cfg.model.num_annotations), np.float32)
+    annotations = np.asarray(annotations, np.float32)
+    if annotations.shape != (n, cfg.model.num_annotations):
+        raise ValueError(
+            f"annotations shape {annotations.shape} != "
+            f"({n}, {cfg.model.num_annotations})"
+        )
+    return annotations
+
+
+def fill_masked_residues(seq: str, probs: np.ndarray, window: int) -> str:
+    """Fill each MASK_CHAR in seq[:window] with the argmax amino acid
+    from `probs` — one (L, V) softmax row, position 0 = <sos> — never
+    choosing pad/sos/eos/unk; the un-modeled tail beyond `window`
+    passes through unchanged. Shared by offline `predict_residues` and
+    the serving finalizer (serve/server.py) so the fill rule cannot
+    drift between the two surfaces."""
+    aa = np.asarray(probs).copy()
+    aa[:, : UNK_ID + 1] = 0.0  # only amino-acid tokens are valid fills
+    vocab = get_vocab()
+    chars = list(seq[:window])
+    for pos, ch in enumerate(chars):
+        if ch == MASK_CHAR:
+            chars[pos] = vocab.itos[int(aa[pos + 1].argmax())]
+    return "".join(chars) + seq[window:]
 
 
 def _batched(
@@ -123,14 +200,7 @@ def _batched(
     n = tokens.shape[0]
     if n == 0:
         raise ValueError("no sequences given")
-    if annotations is None:
-        annotations = np.zeros((n, cfg.model.num_annotations), np.float32)
-    annotations = np.asarray(annotations, np.float32)
-    if annotations.shape != (n, cfg.model.num_annotations):
-        raise ValueError(
-            f"annotations shape {annotations.shape} != "
-            f"({n}, {cfg.model.num_annotations})"
-        )
+    annotations = check_annotations(annotations, n, cfg)
     outs = []
     for start in range(0, n, batch_size):
         tb = tokens[start : start + batch_size]
@@ -147,7 +217,7 @@ def _batched(
 def embed_batches(
     params, cfg: PretrainConfig, seqs: Sequence[str],
     annotations: Optional[np.ndarray] = None, batch_size: int = 32,
-    per_residue: bool = False,
+    per_residue: bool = False, on_overflow: str = "warn",
 ):
     """Yield per-batch representation dicts — the streaming form of
     `embed` (host memory stays O(batch), so million-sequence FASTA runs
@@ -165,7 +235,7 @@ def embed_batches(
     for start in range(0, n, batch_size):
         # Tokenize per chunk — this is what keeps host memory O(batch).
         chunk_tokens = _tokenize_masked(seqs[start : start + batch_size],
-                                        cfg.data.seq_len)
+                                        cfg.data.seq_len, on_overflow)
         chunk_ann = (annotations[start : start + batch_size]
                      if annotations is not None else None)
         out = _batched(
@@ -176,10 +246,31 @@ def embed_batches(
         yield out
 
 
+def _bucketed_rows(params, cfg: PretrainConfig, kind: str,
+                   tokens: np.ndarray, annotations: Optional[np.ndarray],
+                   batch_size: int, buckets):
+    """Route an offline batch job through the serving layer's bucket
+    dispatcher (serve/dispatch.py): rows grouped by length bucket, each
+    group run at its bucket length instead of the full seq_len, results
+    reassembled in input order. Shares the jitted kernels with the
+    unbucketed path, so with buckets=(seq_len,) the output is
+    bit-identical to it (tests/test_serve.py proves this)."""
+    from proteinbert_tpu.serve.dispatch import BucketDispatcher
+
+    if tokens.shape[0] == 0:
+        raise ValueError("no sequences given")
+
+    dispatcher = BucketDispatcher(
+        params, cfg, buckets=buckets, max_batch=batch_size,
+        batch_classes=(batch_size,))
+    return dispatcher.run_rows(kind, tokens, annotations, batch_size)
+
+
 def embed(
     params, cfg: PretrainConfig, seqs: Sequence[str],
     annotations: Optional[np.ndarray] = None, batch_size: int = 32,
-    per_residue: bool = False,
+    per_residue: bool = False, bucketed: bool = False, buckets=None,
+    on_overflow: str = "warn",
 ) -> Dict[str, np.ndarray]:
     """Trunk representations for downstream use.
 
@@ -187,15 +278,36 @@ def embed(
     `per_residue=True`, "local": (N, seq_len, C) plus "tokens":
     (N, seq_len) int32 so callers can mask pad positions themselves.
     Holds all N rows in memory; for large N use `embed_batches`.
+
+    `bucketed=True` routes through the serving bucket dispatcher: rows
+    run at their length bucket (`buckets` ascending, last == seq_len;
+    default cfg.data.buckets, else the single full-length bucket)
+    instead of all padding to seq_len — same numbers, fewer FLOPs for
+    short sequences. Incompatible with `per_residue` (whose output is
+    full-seq_len shaped by contract).
     """
+    if bucketed:
+        if per_residue:
+            raise ValueError(
+                "per_residue output is (N, seq_len, C) by contract; "
+                "bucketed execution would change its shape — use "
+                "bucketed=False for per-residue embeddings")
+        n = len(seqs)
+        if n == 0:
+            raise ValueError("no sequences given")
+        tokens = _tokenize_masked(seqs, cfg.data.seq_len, on_overflow)
+        annotations = check_annotations(annotations, n, cfg)
+        return _bucketed_rows(params, cfg, "embed", tokens, annotations,
+                              batch_size, buckets)
     outs = list(embed_batches(params, cfg, seqs, annotations, batch_size,
-                              per_residue))
+                              per_residue, on_overflow))
     return {k: np.concatenate([o[k] for o in outs]) for k in outs[0]}
 
 
 def predict_go(
     params, cfg: PretrainConfig, seqs: Sequence[str],
     batch_size: int = 32, top_k: Optional[int] = None,
+    bucketed: bool = False, buckets=None, on_overflow: str = "warn",
 ):
     """GO-annotation probabilities from sequence alone.
 
@@ -203,10 +315,16 @@ def predict_go(
     N descending [(annotation_index, prob), ...] lists. The indices are
     rows of the HDF5 builder's `included_annotations` mapping
     (etl/h5_builder.py) — join against the GO-meta CSV for names.
+    `bucketed=True` runs each row at its length bucket (see `embed`).
     """
-    tokens = _tokenize_masked(seqs, cfg.data.seq_len)
-    outs = _batched(params, cfg, tokens, None, batch_size, _go_probs_batch)
-    probs = np.concatenate(outs)
+    tokens = _tokenize_masked(seqs, cfg.data.seq_len, on_overflow)
+    if bucketed:
+        probs = _bucketed_rows(params, cfg, "predict_go", tokens, None,
+                               batch_size, buckets)
+    else:
+        outs = _batched(params, cfg, tokens, None, batch_size,
+                        _go_probs_batch)
+        probs = np.concatenate(outs)
     if top_k is None:
         return probs
     k = min(top_k, probs.shape[1])
@@ -219,6 +337,7 @@ def predict_go(
 
 def predict_residues(
     params, cfg: PretrainConfig, seqs: Sequence[str], batch_size: int = 32,
+    bucketed: bool = False, buckets=None, on_overflow: str = "warn",
 ) -> Tuple[List[str], np.ndarray]:
     """Per-position amino-acid prediction; '?' marks residues to fill.
 
@@ -230,6 +349,10 @@ def predict_residues(
     Sequences longer than cfg.data.seq_len - 2 with a '?' in the
     truncated tail are rejected (the model never sees those positions,
     so "filling" them would silently return the mask unchanged).
+
+    `bucketed=True` runs each row at its length bucket (see `embed`);
+    probability rows beyond a row's bucket length come back zero-filled
+    (those positions are pad by construction).
     """
     window = cfg.data.seq_len - 2
     for i, seq in enumerate(seqs):
@@ -239,19 +362,14 @@ def predict_residues(
                 f"{window} — outside the model's seq_len window; raise "
                 "data.seq_len (--pretrained-set data.seq_len=...) or "
                 "split the sequence")
-    tokens = _tokenize_masked(seqs, cfg.data.seq_len)
-    outs = _batched(params, cfg, tokens, None, batch_size,
-                    _residue_probs_batch)
-    probs = np.concatenate(outs)
-    vocab = get_vocab()
-    # Only amino-acid tokens are valid fills (never pad/sos/eos/unk).
-    aa_probs = probs.copy()
-    aa_probs[:, :, : UNK_ID + 1] = 0.0
-    filled = []
-    for i, seq in enumerate(seqs):
-        chars = list(seq[:window])
-        for pos, ch in enumerate(chars):
-            if ch == MASK_CHAR:
-                chars[pos] = vocab.itos[int(aa_probs[i, pos + 1].argmax())]
-        filled.append("".join(chars) + seq[window:])
+    tokens = _tokenize_masked(seqs, cfg.data.seq_len, on_overflow)
+    if bucketed:
+        probs = _bucketed_rows(params, cfg, "predict_residues", tokens,
+                               None, batch_size, buckets)
+    else:
+        outs = _batched(params, cfg, tokens, None, batch_size,
+                        _residue_probs_batch)
+        probs = np.concatenate(outs)
+    filled = [fill_masked_residues(seq, probs[i], window)
+              for i, seq in enumerate(seqs)]
     return filled, probs

@@ -38,7 +38,8 @@ import time
 from typing import Any, Dict, Optional
 
 from proteinbert_tpu.obs.events import (
-    CKPT_PHASES, EVENT_FIELDS, OUTCOMES, SCHEMA_VERSION, EventLog,
+    CKPT_PHASES, EVENT_FIELDS, OUTCOMES, SCHEMA_VERSION,
+    SERVE_OUTCOMES, SERVE_REJECT_REASONS, EventLog,
     build_record, make_example, make_record, read_events, sanitize,
     validate_record,
 )
@@ -149,6 +150,7 @@ __all__ = [
     "EventLog", "read_events", "validate_record", "make_record",
     "make_example", "sanitize",
     "SCHEMA_VERSION", "EVENT_FIELDS", "CKPT_PHASES", "OUTCOMES",
+    "SERVE_OUTCOMES", "SERVE_REJECT_REASONS",
     "MetricsRegistry",
     "SpanCollector", "span", "step_span",
     "FlightRecorder", "flight_path", "validate_flight_dump",

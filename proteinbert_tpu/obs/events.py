@@ -54,10 +54,24 @@ EVENT_FIELDS: Dict[str, Dict[str, Any]] = {
     # Generic annotated event for tools (tpu_watch, bench) that share
     # the stream format without being training runs.
     "note": {"source": str},
+    # ---- online serving lifecycle (proteinbert_tpu/serve/) ----
+    # Server manifest: serving config (buckets, batch classes, queue
+    # depth, cache size) — the serving counterpart of run_start.
+    "serve_start": {"config": dict, "pid": int},
+    # One per dispatched micro-batch: which compiled shape class ran and
+    # how full it was (rows ≤ the padded batch class size).
+    "serve_batch": {"kind": str, "bucket_len": int, "rows": int},
+    # One per rejected request: reason in SERVE_REJECT_REASONS.
+    "serve_reject": {"reason": str},
+    # Terminal serving record; outcome in SERVE_OUTCOMES, stats is
+    # Server.stats() (requests/rejections/cache hit rate/latency).
+    "serve_end": {"outcome": str, "stats": dict},
 }
 
 CKPT_PHASES = ("dispatch", "landed", "save")
 OUTCOMES = ("completed", "preempted", "early_stopped", "nan_halt", "error")
+SERVE_OUTCOMES = ("drained", "aborted")
+SERVE_REJECT_REASONS = ("queue_full", "deadline", "closed", "too_long")
 
 
 def sanitize(value: Any) -> Any:
@@ -141,6 +155,19 @@ def validate_record(rec: Any) -> None:
     if event == "run_end" and rec["outcome"] not in OUTCOMES:
         raise ValueError(f"run_end.outcome {rec['outcome']!r} not in "
                          f"{OUTCOMES}")
+    if event == "serve_end" and rec["outcome"] not in SERVE_OUTCOMES:
+        raise ValueError(f"serve_end.outcome {rec['outcome']!r} not in "
+                         f"{SERVE_OUTCOMES}")
+    if event == "serve_reject" and rec["reason"] not in SERVE_REJECT_REASONS:
+        raise ValueError(f"serve_reject.reason {rec['reason']!r} not in "
+                         f"{SERVE_REJECT_REASONS}")
+    if event == "serve_batch":
+        for field in ("bucket_len", "rows"):
+            v = rec[field]
+            if isinstance(v, bool) or v < 0:
+                raise ValueError(
+                    f"serve_batch.{field} must be a non-negative int, "
+                    f"got {v!r}")
 
 
 def make_example(event: str) -> Dict[str, Any]:
@@ -157,6 +184,10 @@ def make_example(event: str) -> Dict[str, Any]:
         "nan_halt": {"step": 1, "metrics": {"loss": None}},
         "run_end": {"outcome": "completed", "perf": {}},
         "note": {"source": "self_test"},
+        "serve_start": {"config": {"max_batch": 8}, "pid": 1},
+        "serve_batch": {"kind": "embed", "bucket_len": 128, "rows": 4},
+        "serve_reject": {"reason": "queue_full"},
+        "serve_end": {"outcome": "drained", "stats": {"requests": 0}},
     }
     return make_record(event, seq=0, t=0.0, **payloads[event])
 

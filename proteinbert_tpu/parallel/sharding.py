@@ -47,6 +47,20 @@ def batch_sharding(mesh: Mesh) -> Dict[str, NamedSharding]:
     }
 
 
+def serve_batch_sharding(mesh: Mesh) -> Dict[str, NamedSharding]:
+    """Sharding for SERVED micro-batches (serve/dispatch.py): batch dim
+    over the joint ('data','fsdp') replica axis, sequence dim
+    replicated. Unlike training's `batch_sharding`, the L axis does NOT
+    carry 'seq' — served batches are sliced to ragged bucket lengths
+    that need not divide the seq extent, and a single forward pass has
+    no optimizer state to amortize a halo exchange against; batch-dim
+    data parallelism is the whole win."""
+    return {
+        "tokens": NamedSharding(mesh, P(("data", "fsdp"), None)),
+        "annotations": NamedSharding(mesh, P(("data", "fsdp"), None)),
+    }
+
+
 def _path_has(path, name: str) -> bool:
     for p in path:
         key = getattr(p, "key", None)

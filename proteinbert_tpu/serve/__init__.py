@@ -1,0 +1,68 @@
+"""Online serving subsystem (ISSUE 5 tentpole).
+
+The offline inference surface (proteinbert_tpu/inference.py) is a
+blocking batch API: every request pads to the full `cfg.data.seq_len`,
+compiles one static shape, and concurrent callers serialize. This
+package is the TPU-native online answer — the shape-bucketed,
+continuously batched execution model of ragged-paged-attention-style
+serving (PAPERS.md), built from five cooperating pieces:
+
+- **queue** (`serve/queue.py`) — thread-safe bounded request queue with
+  admission control: bounded depth with OLDEST-FIRST eviction (the
+  evicted request's future fails with `QueueFullError` — rejected,
+  never silently dropped), per-request deadlines, and a closed state
+  that rejects new work during drain;
+- **dispatch** (`serve/dispatch.py`) — one pre-warmed jitted executable
+  per (bucket_len, batch_class) shape class, reusing the bucket-
+  boundary semantics of `data/dataset.make_bucketed_iterator` (buckets
+  ascending, last == seq_len) so a 40-residue query pays 64-length
+  FLOPs, not 512; served batches shard over the mesh batch dim
+  (`parallel/sharding.serve_batch_sharding`);
+- **scheduler** (`serve/scheduler.py`) — continuous micro-batching:
+  drains the queue under a max-batch/max-wait policy, groups requests
+  by (kind, bucket), dispatches the fullest/oldest group. The clock is
+  injected, so batch formation is deterministic under a fake clock
+  (tests/test_serve.py);
+- **cache** (`serve/cache.py`) — content-addressed (sequence-hash
+  keyed) LRU result cache with hit/miss/eviction counters,
+  short-circuiting repeat queries before they ever enqueue;
+- **server** (`serve/server.py`) — the `Server` facade: `embed` /
+  `predict_go` / `predict_residues` as sync calls or `submit()`
+  futures, graceful `drain()` (in-flight batches finish, queue rejects
+  new work) vs `abort()` (pending futures fail, flight-recorder note),
+  `serve_*` telemetry on the same obs stream as training runs;
+- **http** (`serve/http.py`) — a thin stdlib `http.server` JSON
+  endpoint over the same facade (`pbt serve`).
+
+Benchmarked by `bench.py --serve` (throughput + latency percentiles vs
+the one-request-at-a-time offline baseline); documented in
+docs/serving.md.
+"""
+
+from proteinbert_tpu.serve.cache import EmbeddingCache, content_key
+from proteinbert_tpu.serve.dispatch import BucketDispatcher
+from proteinbert_tpu.serve.errors import (
+    DeadlineExceededError,
+    QueueFullError,
+    SequenceTooLongError,
+    ServeError,
+    ServerClosedError,
+)
+from proteinbert_tpu.serve.queue import Request, RequestQueue
+from proteinbert_tpu.serve.scheduler import MicroBatchScheduler
+from proteinbert_tpu.serve.server import Server
+
+__all__ = [
+    "Server",
+    "BucketDispatcher",
+    "MicroBatchScheduler",
+    "RequestQueue",
+    "Request",
+    "EmbeddingCache",
+    "content_key",
+    "ServeError",
+    "QueueFullError",
+    "DeadlineExceededError",
+    "ServerClosedError",
+    "SequenceTooLongError",
+]

@@ -1,0 +1,263 @@
+"""Bucketed shape-class dispatch: one warm executable per shape.
+
+The offline inference path compiles ONE static shape — (batch_size,
+seq_len) — so a 40-residue query pays full-seq_len FLOPs. Online
+traffic is ragged; the TPU-native answer (the Operator-Fusion inference
+and Ragged Paged Attention papers, PAPERS.md) is a small, fixed family
+of compiled shapes kept warm, with every request routed to the
+cheapest one that fits:
+
+- **length buckets** reuse the semantics of
+  `data/dataset.make_bucketed_iterator` (ascending, last == seq_len;
+  a row goes to the smallest bucket that fits its tokenized length) —
+  the model is shape-parametric in L, so each bucket is just one more
+  executable of the same jitted function;
+- **batch classes** are a short ladder (powers of two up to
+  `max_batch` by default): a micro-batch of r rows is padded up to the
+  smallest class ≥ r, bounding both the executable count
+  (|buckets| x |classes| per request kind) and the pad waste (< 2x).
+
+`warmup()` compiles every (bucket_len, batch_class) pair up front so
+no request ever pays a compile. With a `mesh`, batches are placed
+batch-dim-sharded (`parallel/sharding.serve_batch_sharding`) before
+dispatch, so a multi-chip server data-parallelizes each micro-batch.
+
+`run_rows` is the OFFLINE entry (`inference.embed(..., bucketed=True)`):
+group a whole token matrix by bucket, run each group at its bucket
+length, reassemble in input order — with buckets=(seq_len,) the result
+is bit-identical to the unbucketed `_batched` path because both feed
+the same jitted kernels the same padded shapes.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from proteinbert_tpu.configs import PretrainConfig
+from proteinbert_tpu.data.vocab import EOS_ID, PAD_ID, SOS_ID
+from proteinbert_tpu import inference
+
+KINDS = ("embed", "predict_go", "predict_residues")
+
+
+def resolve_buckets(cfg: PretrainConfig, buckets=None) -> Tuple[int, ...]:
+    """Serving bucket boundaries: the explicit argument, else the
+    config's training buckets (cfg.data.buckets), else the single
+    full-length bucket. Same validity rules as the bucketed iterator:
+    ints, strictly ascending, last == seq_len."""
+    if buckets is None:
+        buckets = cfg.data.buckets or (cfg.data.seq_len,)
+    try:
+        buckets = tuple(int(b) for b in buckets)
+    except (TypeError, ValueError):
+        raise ValueError(f"buckets must be ints, got {buckets!r}") from None
+    if not buckets or sorted(set(buckets)) != list(buckets):
+        raise ValueError(f"buckets must be strictly ascending, got {buckets}")
+    if buckets[-1] != cfg.data.seq_len:
+        raise ValueError(f"last bucket {buckets[-1]} must equal "
+                         f"data.seq_len {cfg.data.seq_len}")
+    if buckets[0] < 3:
+        raise ValueError(f"smallest bucket {buckets[0]} cannot hold "
+                         "<sos> + one residue + <eos>")
+    return buckets
+
+
+def default_batch_classes(max_batch: int, multiple: int = 1) -> Tuple[int, ...]:
+    """Ascending power-of-two ladder capped by (and always containing)
+    max_batch: 8 → (1, 2, 4, 8); 12 → (1, 2, 4, 8, 12). With
+    `multiple` — a mesh's data*fsdp extent — every rung is a multiple
+    of it so a served batch splits evenly across the replicas:
+    (16, multiple=4) → (4, 8, 16)."""
+    if max_batch < 1:
+        raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+    if multiple < 1:
+        raise ValueError(f"multiple must be >= 1, got {multiple}")
+    if max_batch % multiple:
+        raise ValueError(
+            f"max_batch {max_batch} is not divisible by the mesh's "
+            f"data*fsdp extent {multiple} — pick a max_batch the mesh "
+            "can split evenly over the batch dim")
+    classes = []
+    c = multiple
+    while c < max_batch:
+        classes.append(c)
+        c *= 2
+    classes.append(max_batch)
+    return tuple(classes)
+
+
+class BucketDispatcher:
+    """Routes (kind, tokens, annotations) micro-batches to the warm
+    executable of their shape class and returns trimmed host outputs."""
+
+    def __init__(
+        self,
+        params,
+        cfg: PretrainConfig,
+        buckets: Optional[Sequence[int]] = None,
+        max_batch: int = 8,
+        batch_classes: Optional[Sequence[int]] = None,
+        mesh=None,
+        metrics=None,
+    ):
+        self.params = params
+        self.cfg = cfg
+        self.buckets = resolve_buckets(cfg, buckets)
+        self.max_batch = int(max_batch)
+        divisor = 1
+        if mesh is not None:
+            divisor = mesh.shape.get("data", 1) * mesh.shape.get("fsdp", 1)
+        if batch_classes is None:
+            # Mesh-aware default: every rung divisible by the replica
+            # count, so `pbt serve --mesh` works out of the box.
+            batch_classes = default_batch_classes(self.max_batch, divisor)
+        self.batch_classes = tuple(sorted(int(c) for c in set(batch_classes)))
+        if self.batch_classes[-1] < self.max_batch:
+            raise ValueError(
+                f"largest batch class {self.batch_classes[-1]} cannot hold "
+                f"a full micro-batch of {self.max_batch}")
+        self.mesh = mesh
+        self._shardings = None
+        if mesh is not None:
+            from proteinbert_tpu.parallel.sharding import serve_batch_sharding
+
+            bad = [c for c in self.batch_classes if c % divisor]
+            if bad:
+                raise ValueError(
+                    f"batch classes {bad} are not divisible by the mesh's "
+                    f"data*fsdp extent {divisor} — a served batch shards "
+                    "over the batch dim, so every compiled class must "
+                    "split evenly across the replicas")
+            self._shardings = serve_batch_sharding(mesh)
+        self._compile_hist = (metrics.histogram("serve_compile_seconds")
+                              if metrics is not None else None)
+        self._warm: set = set()
+
+    # ------------------------------------------------------------ routing
+
+    def bucket_len(self, seq_len_residues: int) -> int:
+        """Smallest bucket holding a sequence of this many residues
+        (tokenized length = residues + <sos> + <eos>, capped at the
+        model window like tokenization caps it)."""
+        tok_len = min(seq_len_residues + 2, self.cfg.data.seq_len)
+        i = int(np.searchsorted(self.buckets, tok_len))
+        return self.buckets[i]
+
+    def batch_class(self, rows: int) -> int:
+        """Smallest compiled batch class that fits `rows`."""
+        for c in self.batch_classes:
+            if c >= rows:
+                return c
+        raise ValueError(f"{rows} rows exceed the largest batch class "
+                         f"{self.batch_classes[-1]}")
+
+    # ----------------------------------------------------------- execution
+
+    def _fn(self, kind: str):
+        if kind == "embed":
+            return inference._encode_batch
+        if kind == "predict_go":
+            return inference._go_probs_batch
+        if kind == "predict_residues":
+            return inference._residue_probs_batch
+        raise ValueError(f"unknown request kind {kind!r}; have {KINDS}")
+
+    def _place(self, tokens: np.ndarray, annotations: np.ndarray):
+        if self._shardings is None:
+            return jnp.asarray(tokens), jnp.asarray(annotations)
+        return (jax.device_put(tokens, self._shardings["tokens"]),
+                jax.device_put(annotations, self._shardings["annotations"]))
+
+    def run(self, kind: str, tokens: np.ndarray,
+            annotations: Optional[np.ndarray] = None):
+        """Run one micro-batch: tokens (r, L) with L a bucket length,
+        annotations (r, A) or None. Rows are padded up to the batch
+        class, outputs come back trimmed to r on host.
+
+        Returns {"global", "local_mean"} for "embed", (r, A) probs for
+        "predict_go", (r, L, V) probs for "predict_residues".
+        """
+        rows, L = tokens.shape
+        if L not in self.buckets:
+            raise ValueError(f"tokens length {L} is not one of the "
+                             f"buckets {self.buckets}")
+        annotations = inference.check_annotations(annotations, rows, self.cfg)
+        cls = self.batch_class(rows)
+        if rows < cls:
+            tokens = np.pad(tokens, ((0, cls - rows), (0, 0)))
+            annotations = np.pad(annotations, ((0, cls - rows), (0, 0)))
+        fn = self._fn(kind)
+        tb, ab = self._place(tokens, annotations)
+        res = fn(self.params, tb, ab, self.cfg.model)
+        self._warm.add((kind, L, cls))
+        return jax.tree.map(lambda a: np.asarray(a)[:rows], res)
+
+    def warmup(self, kinds: Sequence[str] = ("embed",)) -> int:
+        """Pre-compile every (bucket_len, batch_class) executable for the
+        given kinds so no live request pays a compile; returns how many
+        shape classes were warmed. Cost is |kinds| x |buckets| x
+        |classes| compiles — keep `kinds` to what the deployment
+        serves (the others compile lazily on first use)."""
+        n = 0
+        for kind in kinds:
+            if kind not in KINDS:
+                raise ValueError(f"unknown request kind {kind!r}; "
+                                 f"have {KINDS}")
+            for L in self.buckets:
+                for cls in self.batch_classes:
+                    if (kind, L, cls) in self._warm:
+                        continue
+                    dummy = np.full((cls, L), PAD_ID, np.int32)
+                    dummy[:, 0] = SOS_ID
+                    dummy[:, 1] = EOS_ID
+                    if self._compile_hist is not None:
+                        import time
+
+                        t0 = time.perf_counter()
+                        self.run(kind, dummy)
+                        self._compile_hist.observe(time.perf_counter() - t0)
+                    else:
+                        self.run(kind, dummy)
+                    n += 1
+        return n
+
+    # ------------------------------------------------- offline batch path
+
+    def run_rows(self, kind: str, tokens: np.ndarray,
+                 annotations: Optional[np.ndarray], batch_size: int):
+        """Offline whole-matrix entry: group (N, seq_len) rows by
+        bucket, run each group at its bucket length in input-order
+        chunks of `batch_size`, reassemble results by original row
+        index. `predict_residues` probability tails beyond a row's
+        bucket are zero-filled back to seq_len (pad positions)."""
+        n = tokens.shape[0]
+        annotations = inference.check_annotations(annotations, n, self.cfg)
+        lengths = (tokens != PAD_ID).sum(axis=1)
+        bucket_of = np.searchsorted(self.buckets, lengths)
+        out: Dict[str, np.ndarray] = {}
+        flat: Optional[np.ndarray] = None
+        for b, L in enumerate(self.buckets):
+            idx = np.flatnonzero(bucket_of == b)
+            for lo in range(0, len(idx), batch_size):
+                sel = idx[lo : lo + batch_size]
+                res = self.run(kind, tokens[sel][:, :L], annotations[sel])
+                if kind == "embed":
+                    for k, v in res.items():
+                        if k not in out:
+                            out[k] = np.zeros((n,) + v.shape[1:], v.dtype)
+                        out[k][sel] = v
+                elif kind == "predict_go":
+                    if flat is None:
+                        flat = np.zeros((n, res.shape[1]), res.dtype)
+                    flat[sel] = res
+                else:  # predict_residues: zero-fill the pad tail
+                    if flat is None:
+                        flat = np.zeros(
+                            (n, self.cfg.data.seq_len, res.shape[2]),
+                            res.dtype)
+                    flat[sel, :L] = res
+        return out if kind == "embed" else flat

@@ -1,0 +1,36 @@
+"""Typed serving errors — the backpressure/deadline/drain contract.
+
+Every way the serving layer can refuse work has its own exception type,
+so callers (and the HTTP layer's status mapping) can distinguish "try
+again later" (QueueFullError, 429) from "you were too slow"
+(DeadlineExceededError, 504) from "the server is going away"
+(ServerClosedError, 503) from "this input can never be served"
+(SequenceTooLongError, 400). A rejected request always OBSERVES its
+rejection — the error lands on its future (or raises synchronously at
+submit) — never a silent drop.
+"""
+
+from __future__ import annotations
+
+# Re-exported here so serving callers import every typed error from one
+# place; it lives in inference.py because the OFFLINE surface raises it
+# too (the silent-truncation fix) and inference must not depend on serve.
+from proteinbert_tpu.inference import SequenceTooLongError  # noqa: F401
+
+
+class ServeError(Exception):
+    """Base class for all serving-layer rejections."""
+
+
+class QueueFullError(ServeError):
+    """Admission control fired: the bounded queue overflowed and this
+    (oldest) request was evicted to admit newer work."""
+
+
+class DeadlineExceededError(ServeError):
+    """The request's deadline passed before a batch could run it."""
+
+
+class ServerClosedError(ServeError):
+    """The server is draining or closed; no new work is accepted (and
+    on abort, pending work fails with this)."""

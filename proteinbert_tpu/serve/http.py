@@ -1,0 +1,147 @@
+"""Thin stdlib JSON/HTTP endpoint over the Server facade.
+
+Deliberately `http.server`, not a framework: the repo's no-new-deps
+rule, and the endpoint's job is only transport — every serving
+behavior (batching, backpressure, deadlines, cache) lives in
+serve/server.py and is identical for in-process callers.
+
+Routes (POST bodies and responses are JSON):
+
+  POST /v1/embed             {"seq", "annotations"?, "deadline_ms"?}
+       → {"global": [...], "local_mean": [...]}
+  POST /v1/predict_go        {"seq", "top_k"?, "deadline_ms"?}
+       → {"top": [[idx, prob], ...]} or {"probs": [...]}
+  POST /v1/predict_residues  {"seq", "deadline_ms"?}
+       → {"filled": "..."} (probs stay server-side: a (L, V) matrix
+         per request is transfer weight, not serving signal)
+  GET  /healthz              → {"ok": true, "stats": {...}}
+  GET  /metrics              → Prometheus textfile (the registry's
+                               exposition; empty when telemetry is off)
+
+Typed-error → status mapping (the backpressure contract, visible to
+clients): QueueFullError → 429, DeadlineExceededError → 504,
+ServerClosedError → 503, SequenceTooLongError/ValueError/bad JSON →
+400. `ThreadingHTTPServer` gives one thread per connection; they all
+funnel into the one scheduler through Server.submit, so HTTP
+concurrency IS the micro-batching concurrency.
+"""
+
+from __future__ import annotations
+
+import json
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from proteinbert_tpu.serve.errors import (
+    DeadlineExceededError, QueueFullError, SequenceTooLongError,
+    ServerClosedError,
+)
+from proteinbert_tpu.serve.server import Server
+
+_MAX_BODY = 32 * 1024 * 1024  # a seq + an 8943-float annotation vector fit
+
+
+def _result_payload(kind: str, value, top_k: Optional[int]):
+    if kind == "embed":
+        return {"global": [float(x) for x in value["global"]],
+                "local_mean": [float(x) for x in value["local_mean"]]}
+    if kind == "predict_go":
+        if top_k is not None:
+            return {"top": [[i, p] for i, p in value]}
+        return {"probs": [float(x) for x in value]}
+    filled, _probs = value
+    return {"filled": filled}
+
+
+def make_handler(server: Server):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # quiet: telemetry covers it
+            pass
+
+        def _reply(self, status: int, payload) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path in ("/healthz", "/stats"):
+                self._reply(200, {"ok": True, "stats": server.stats()})
+            elif self.path == "/metrics":
+                text = ""
+                if getattr(server.tele, "metrics", None) is not None:
+                    text = server.tele.metrics.prometheus_text()
+                body = text.encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            else:
+                self._reply(404, {"error": f"no such route {self.path}"})
+
+        def do_POST(self):
+            route = {"/v1/embed": "embed",
+                     "/v1/predict_go": "predict_go",
+                     "/v1/predict_residues": "predict_residues"}
+            kind = route.get(self.path)
+            if kind is None:
+                self._reply(404, {"error": f"no such route {self.path}"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                if not 0 < length <= _MAX_BODY:
+                    raise ValueError(f"bad Content-Length {length}")
+                body = json.loads(self.rfile.read(length))
+                seq = body["seq"]
+                if not isinstance(seq, str):
+                    raise ValueError("'seq' must be a string")
+                deadline_ms = body.get("deadline_ms")
+                if deadline_ms is not None and (
+                        isinstance(deadline_ms, bool)
+                        or not isinstance(deadline_ms, (int, float))):
+                    raise ValueError("'deadline_ms' must be a number")
+                top_k = body.get("top_k") if kind == "predict_go" else None
+                if top_k is not None and (isinstance(top_k, bool)
+                                          or not isinstance(top_k, int)):
+                    raise ValueError("'top_k' must be an integer")
+                future = server.submit(
+                    kind, seq, annotations=body.get("annotations"),
+                    deadline_s=(deadline_ms / 1000.0
+                                if deadline_ms is not None else None),
+                    top_k=top_k)
+                value = future.result()
+            except QueueFullError as e:
+                self._reply(429, {"error": str(e), "type": "queue_full"})
+            except DeadlineExceededError as e:
+                self._reply(504, {"error": str(e), "type": "deadline"})
+            except ServerClosedError as e:
+                self._reply(503, {"error": str(e), "type": "closed"})
+            except SequenceTooLongError as e:
+                self._reply(400, {"error": str(e), "type": "too_long"})
+            except (KeyError, ValueError, json.JSONDecodeError) as e:
+                self._reply(400, {"error": f"bad request: {e}",
+                                  "type": "bad_request"})
+            except Exception as e:  # noqa: BLE001 — a dispatch-side
+                # failure lands on the future; a dropped connection
+                # would hide it from the client, so map it to a 500.
+                self._reply(500, {"error": f"internal error: {e}",
+                                  "type": "internal"})
+            else:
+                self._reply(200, _result_payload(kind, value, top_k))
+
+    return Handler
+
+
+def make_http_server(server: Server, host: str = "127.0.0.1",
+                     port: int = 0) -> ThreadingHTTPServer:
+    """Bind (port 0 = ephemeral; read `.server_address[1]`) but do not
+    serve — callers run `.serve_forever()` themselves (the CLI does,
+    under GracefulShutdown) so shutdown stays in their hands."""
+    httpd = ThreadingHTTPServer((host, port), make_handler(server))
+    httpd.daemon_threads = True
+    return httpd

@@ -1,0 +1,271 @@
+"""Continuous micro-batching scheduler.
+
+One daemon thread drains the request queue under a two-knob policy —
+the standard continuous-batching contract:
+
+- **max_batch**: a (kind, bucket) group that reaches `max_batch`
+  queued rows dispatches immediately (throughput bound);
+- **max_wait_s**: otherwise, a group dispatches when its OLDEST member
+  has waited `max_wait_s` (latency bound — p99 queueing delay is
+  bounded by max_wait + one batch time, the property bench.py --serve
+  measures).
+
+Requests group by (kind, bucket_len): only same-kind, same-bucket rows
+can share a compiled executable. Within a group, FIFO order is
+preserved end-to-end — the batch a request rides in is a deterministic
+function of arrival order and the clock, which is why every formation
+test in tests/test_serve.py runs single-threaded against `poll(now=)`
+with a fake clock instead of sleeping.
+
+A dispatch failure (OOM, a bug in a jitted fn) fails THAT batch's
+futures and keeps the scheduler alive for later batches; the error is
+also recorded as a `note` on the telemetry stream.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from proteinbert_tpu.serve.errors import DeadlineExceededError
+from proteinbert_tpu.serve.queue import Request, RequestQueue
+
+logger = logging.getLogger(__name__)
+
+GroupKey = Tuple[str, int]  # (kind, bucket_len)
+
+
+class MicroBatchScheduler:
+    def __init__(
+        self,
+        queue: RequestQueue,
+        dispatcher,
+        finalize: Callable[[Request, object], None],
+        max_batch: int = 8,
+        max_wait_s: float = 0.01,
+        clock=time.monotonic,
+        telemetry=None,
+        latency_observer: Optional[Callable[[float], None]] = None,
+        expire_observer: Optional[Callable[[Request], None]] = None,
+    ):
+        from proteinbert_tpu.obs import as_telemetry
+
+        self.queue = queue
+        self.dispatcher = dispatcher
+        self.finalize = finalize
+        self.max_batch = int(max_batch)
+        self.max_wait_s = float(max_wait_s)
+        self.clock = clock
+        self.tele = as_telemetry(telemetry)
+        self._latency = latency_observer or (lambda s: None)
+        # Called per deadline-expired request (scheduler thread): the
+        # Server counts these under rejected{reason=deadline} so
+        # /metrics, stats(), and --max-requests accounting see them.
+        self._on_expire = expire_observer or (lambda req: None)
+        self._pending: "collections.OrderedDict[GroupKey, collections.deque]" \
+            = collections.OrderedDict()
+        # Guards _pending: normally scheduler-thread-private, but
+        # fail_pending (abort with a still-live thread stuck in a long
+        # jitted call) and pending_rows (bench quiesce poll) touch it
+        # from other threads.
+        self._pending_lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._stopped = threading.Event()
+        self.batches_total = 0
+        self.rows_total = 0
+        self.expired_total = 0
+        self._occupancy_g = self.tele.metrics.gauge("serve_batch_occupancy")
+        self._rows_h = self.tele.metrics.histogram("serve_batch_rows")
+        self._batch_h = self.tele.metrics.histogram("serve_batch_seconds")
+
+    # -------------------------------------------------------- formation
+
+    def pending_rows(self) -> int:
+        with self._pending_lock:
+            return sum(len(d) for d in self._pending.values())
+
+    def _ingest(self) -> None:
+        items = self.queue.pop_all()
+        if not items:
+            return
+        with self._pending_lock:
+            for req in items:
+                key = (req.kind, req.bucket_len)
+                group = self._pending.get(key)
+                if group is None:
+                    group = self._pending[key] = collections.deque()
+                group.append(req)
+
+    def _expire_pending(self, now: float) -> None:
+        expired: List[Request] = []
+        with self._pending_lock:
+            for key in list(self._pending):
+                group = self._pending[key]
+                keep = collections.deque()
+                for req in group:
+                    if req.deadline is not None and now >= req.deadline:
+                        expired.append(req)
+                    else:
+                        keep.append(req)
+                if keep:
+                    self._pending[key] = keep
+                else:
+                    del self._pending[key]
+        for req in expired:
+            self.expired_total += 1
+            req.future.set_exception(DeadlineExceededError(
+                f"deadline passed after "
+                f"{now - req.enqueued_at:.3f}s waiting for a batch"))
+            self.tele.emit("serve_reject", reason="deadline",
+                           kind=req.kind)
+            self._on_expire(req)
+
+    def _select_group(self, now: float) -> Optional[GroupKey]:
+        """Dispatch decision: a full group first (fullest wins, ties to
+        the oldest head), else the group whose head has waited past
+        max_wait_s (oldest head wins), else — when draining — the
+        oldest head outright."""
+        with self._pending_lock:
+            full = [(len(g), -g[0].enqueued_at, k)
+                    for k, g in self._pending.items()
+                    if len(g) >= self.max_batch]
+            if full:
+                return max(full)[2]
+            overdue = [(g[0].enqueued_at, k)
+                       for k, g in self._pending.items()
+                       if now - g[0].enqueued_at >= self.max_wait_s]
+            if overdue:
+                return min(overdue)[1]
+            if self.queue.closed and self._pending:
+                return min((g[0].enqueued_at, k)
+                           for k, g in self._pending.items())[1]
+            return None
+
+    # --------------------------------------------------------- dispatch
+
+    def _dispatch(self, key: GroupKey, now: float) -> int:
+        kind, bucket_len = key
+        with self._pending_lock:
+            group = self._pending.get(key)
+            if not group:  # raced an abort's fail_pending
+                return 0
+            batch: List[Request] = [group.popleft()
+                                    for _ in range(min(self.max_batch,
+                                                       len(group)))]
+            if not group:
+                del self._pending[key]
+        tokens = np.stack([r.tokens for r in batch])
+        num_ann = self.dispatcher.cfg.model.num_annotations
+        annotations = np.stack([
+            r.annotations if r.annotations is not None
+            else np.zeros(num_ann, np.float32)
+            for r in batch])
+        t0 = time.perf_counter()
+        try:
+            result = self.dispatcher.run(kind, tokens, annotations)
+        except Exception as e:  # fail THIS batch, keep serving
+            logger.exception("batch dispatch failed (%s, L=%d, rows=%d)",
+                             kind, bucket_len, len(batch))
+            self.tele.emit("note", source="serve", error=str(e),
+                           kind=kind, bucket_len=bucket_len)
+            for req in batch:
+                if not req.future.done():
+                    req.future.set_exception(e)
+            return len(batch)
+        dt = time.perf_counter() - t0
+        self._batch_h.observe(dt)
+        done_t = self.clock()
+        for i, req in enumerate(batch):
+            if isinstance(result, dict):
+                row = {k: v[i] for k, v in result.items()}
+            else:
+                row = result[i]
+            try:
+                self.finalize(req, row)
+            except Exception as e:
+                if not req.future.done():
+                    req.future.set_exception(e)
+            self._latency(done_t - req.enqueued_at)
+        self.batches_total += 1
+        self.rows_total += len(batch)
+        cls = self.dispatcher.batch_class(len(batch))
+        self._occupancy_g.set(len(batch) / cls)
+        self._rows_h.observe(len(batch))
+        self.tele.emit("serve_batch", kind=kind, bucket_len=bucket_len,
+                       rows=len(batch), batch_class=cls,
+                       batch_seconds=round(dt, 6))
+        return len(batch)
+
+    def poll(self, now: Optional[float] = None) -> int:
+        """One scheduling step: ingest, expire, dispatch AT MOST one
+        micro-batch. Returns rows dispatched (0 = idle). Deterministic
+        given queue contents and `now` — the fake-clock test entry."""
+        if now is None:
+            now = self.clock()
+        self._ingest()
+        self._expire_pending(now)
+        key = self._select_group(now)
+        if key is None:
+            return 0
+        return self._dispatch(key, now)
+
+    # ---------------------------------------------------------- threading
+
+    def run_forever(self) -> None:
+        # Idle parking: wake at least every max_wait/2 so an under-full
+        # group's max-wait trigger fires on time even with no new pushes.
+        park = max(min(self.max_wait_s / 2, 0.05), 0.001)
+        while not self._stopped.is_set():
+            if self.poll():
+                continue
+            # Drained only when the QUEUE is empty too: a push can land
+            # between poll()'s ingest and a close(), and exiting then
+            # would strand that request's future forever. After close()
+            # no new pushes are admitted, so empty-at-observation is
+            # final.
+            if (self.queue.closed and not self._pending
+                    and len(self.queue) == 0):
+                return
+            self.queue.wait(timeout=park)
+
+    def start(self) -> None:
+        if self._thread is not None:
+            raise RuntimeError("scheduler already started")
+        self._thread = threading.Thread(target=self.run_forever,
+                                        name="pbt-serve-scheduler",
+                                        daemon=True)
+        self._thread.start()
+
+    def join(self, timeout: Optional[float] = None) -> bool:
+        """Wait for the drain to finish; True when the thread is gone."""
+        if self._thread is None:
+            return True
+        self._thread.join(timeout)
+        return not self._thread.is_alive()
+
+    def stop(self) -> None:
+        """Hard stop (abort path): the loop exits at the next check;
+        pending futures are the Server's to fail."""
+        self._stopped.set()
+        self.queue.close()
+
+    def fail_pending(self, exc: Exception) -> int:
+        """Abort path: fail every not-yet-dispatched request. Safe
+        against a scheduler thread that outlived its join timeout (a
+        long jitted call): extraction holds the pending lock, so the
+        thread either sees an empty map or had already popped its batch."""
+        with self._pending_lock:
+            reqs = [req for group in self._pending.values()
+                    for req in group]
+            self._pending.clear()
+        n = 0
+        for req in reqs:
+            if not req.future.done():
+                req.future.set_exception(exc)
+                n += 1
+        return n

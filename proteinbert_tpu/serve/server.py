@@ -1,0 +1,413 @@
+"""`Server` — the online-inference facade.
+
+Ties the queue, scheduler, dispatcher, and cache together behind the
+same three capabilities the offline surface exposes (inference.py):
+`embed`, `predict_go`, `predict_residues` — each available as a
+blocking call or a `submit()` future for in-process callers (the HTTP
+layer in serve/http.py is a thin JSON shim over exactly this facade).
+
+Request life cycle:
+
+  submit() [client thread]                    scheduler thread
+  ├─ over-length policy (reject/truncate+count)
+  ├─ tokenize + bucket-route (serve/dispatch)
+  ├─ cache lookup — hit returns a resolved future, nothing enqueues
+  └─ queue.push (may evict the oldest    ──►  poll(): group by
+     request with QueueFullError)             (kind, bucket), dispatch
+                                              at max_batch/max_wait,
+                                              finalize per row: cache
+                                              put + future.set_result
+
+Shutdown is two-mode, per the resilience conventions of
+train/resilience.GracefulShutdown:
+
+- `drain()` — the queue closes (new submits raise ServerClosedError),
+  every queued and in-flight request completes, then the scheduler
+  thread exits; emits `serve_end{outcome=drained}`.
+- `abort()` — queued + pending futures fail with ServerClosedError,
+  the loop stops after the in-flight batch, a `note` lands on the
+  telemetry stream and the flight recorder dumps (forensics for the
+  requests that were killed); emits `serve_end{outcome=aborted}`.
+
+Telemetry (all optional, NULL-facade free when absent —
+docs/observability.md): `serve_start`/`serve_batch`/`serve_reject`/
+`serve_end` events; `serve_queue_depth`, `serve_batch_occupancy`,
+`serve_latency_p50_s`/`p99_s`, `serve_cache_hit_rate` gauges;
+`serve_requests_total{kind=}`, `serve_rejected_total{reason=}`,
+`serve_truncated_total`, `serve_cache_*_total` counters;
+`serve_latency_seconds`, `serve_batch_seconds`, `serve_batch_rows`
+histograms.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from proteinbert_tpu import inference
+from proteinbert_tpu.configs import PretrainConfig
+from proteinbert_tpu.serve.cache import EmbeddingCache, content_key
+from proteinbert_tpu.serve.dispatch import KINDS, BucketDispatcher
+from proteinbert_tpu.serve.errors import (
+    SequenceTooLongError, ServerClosedError,
+)
+from proteinbert_tpu.serve.queue import Request, RequestQueue
+from proteinbert_tpu.serve.scheduler import MicroBatchScheduler
+
+
+class _LatencyWindow:
+    """Bounded ring of recent request latencies with percentile reads —
+    the p50/p99 the metrics registry's streaming histograms cannot
+    provide (they keep count/sum/min/max only)."""
+
+    def __init__(self, capacity: int = 2048):
+        self._ring: "collections.deque[float]" = collections.deque(
+            maxlen=capacity)
+        self._lock = threading.Lock()
+
+    def observe(self, seconds: float) -> None:
+        with self._lock:
+            self._ring.append(float(seconds))
+
+    def percentile(self, q: float) -> Optional[float]:
+        with self._lock:
+            if not self._ring:
+                return None
+            data = sorted(self._ring)
+        idx = min(len(data) - 1, max(0, int(round(q / 100.0
+                                                  * (len(data) - 1)))))
+        return data[idx]
+
+    def summary(self) -> Dict[str, Optional[float]]:
+        with self._lock:
+            if not self._ring:
+                return {"n": 0, "p50_s": None, "p99_s": None, "mean_s": None}
+            data = sorted(self._ring)
+        pick = lambda q: data[min(len(data) - 1,                  # noqa: E731
+                                  int(round(q * (len(data) - 1))))]
+        return {"n": len(data), "p50_s": round(pick(0.50), 6),
+                "p99_s": round(pick(0.99), 6),
+                "mean_s": round(sum(data) / len(data), 6)}
+
+
+class Server:
+    """Online serving facade over a pretrained trunk (see module doc)."""
+
+    def __init__(
+        self,
+        params,
+        cfg: PretrainConfig,
+        *,
+        buckets=None,
+        max_batch: int = 8,
+        max_wait_s: float = 0.01,
+        queue_depth: int = 64,
+        cache_size: int = 1024,
+        default_deadline_s: Optional[float] = None,
+        on_long: str = "truncate",
+        mesh=None,
+        telemetry=None,
+        clock=time.monotonic,
+        warm_kinds=("embed",),
+        batch_classes=None,
+    ):
+        from proteinbert_tpu.obs import as_telemetry
+
+        if on_long not in ("truncate", "reject"):
+            raise ValueError(f"on_long must be 'truncate' or 'reject', "
+                             f"got {on_long!r}")
+        self.cfg = cfg
+        self.on_long = on_long
+        self.default_deadline_s = default_deadline_s
+        self.clock = clock
+        self.tele = as_telemetry(telemetry)
+        metrics = self.tele.metrics
+        self.dispatcher = BucketDispatcher(
+            params, cfg, buckets=buckets, max_batch=max_batch,
+            batch_classes=batch_classes, mesh=mesh, metrics=metrics)
+        self.cache = EmbeddingCache(cache_size, metrics=metrics)
+        self.queue = RequestQueue(queue_depth)
+        self.scheduler = MicroBatchScheduler(
+            self.queue, self.dispatcher, self._finalize,
+            max_batch=max_batch, max_wait_s=max_wait_s, clock=clock,
+            telemetry=telemetry, latency_observer=self._observe_latency,
+            expire_observer=self._count_expiry)
+        self.latencies = _LatencyWindow()
+        self._latency_n = 0
+        self._warm_kinds = tuple(warm_kinds)
+        self._started = False
+        self._ended = False
+        self._depth_g = metrics.gauge("serve_queue_depth")
+        self._p50_g = metrics.gauge("serve_latency_p50_s")
+        self._p99_g = metrics.gauge("serve_latency_p99_s")
+        self._latency_h = metrics.histogram("serve_latency_seconds")
+        self._truncated_c = metrics.counter("serve_truncated_total")
+        self._req_c = {k: metrics.counter("serve_requests_total", kind=k)
+                       for k in KINDS}
+        from proteinbert_tpu.obs.events import SERVE_REJECT_REASONS
+
+        self._rej_c = {r: metrics.counter("serve_rejected_total", reason=r)
+                       for r in SERVE_REJECT_REASONS}
+        self.completed_total = 0
+        self.cache_hit_returns = 0
+        # Local mirrors of the labeled counters: stats() must report
+        # real numbers even under the NULL telemetry facade (whose
+        # metric instruments are shared no-ops). Bumped from concurrent
+        # client/HTTP threads, so the read-modify-write needs a lock
+        # (completed_total is scheduler-thread-only and needs none).
+        self._mirror_lock = threading.Lock()
+        self.truncated_total = 0
+        self.rejected_total = {r: 0 for r in self._rej_c}
+
+    def _bump(self, mirror: str, reason: Optional[str] = None) -> None:
+        with self._mirror_lock:
+            if reason is None:
+                setattr(self, mirror, getattr(self, mirror) + 1)
+            else:
+                self.rejected_total[reason] += 1
+
+    # ---------------------------------------------------------- lifecycle
+
+    def start(self) -> "Server":
+        """Warm the compiled shape classes and start the scheduler."""
+        if self._started:
+            raise RuntimeError("server already started")
+        warmed = self.dispatcher.warmup(self._warm_kinds)
+        self.tele.emit("serve_start", pid=os.getpid(), config={
+            "buckets": list(self.dispatcher.buckets),
+            "batch_classes": list(self.dispatcher.batch_classes),
+            "max_batch": self.scheduler.max_batch,
+            "max_wait_s": self.scheduler.max_wait_s,
+            "queue_depth": self.queue.max_depth,
+            "cache_size": self.cache.capacity,
+            "on_long": self.on_long,
+            "warmed_executables": warmed,
+            "mesh": (dict(self.dispatcher.mesh.shape)
+                     if self.dispatcher.mesh is not None else None),
+        })
+        self.scheduler.start()
+        self._started = True
+        return self
+
+    def __enter__(self) -> "Server":
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self.close(drain=exc_type is None)
+        return False
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Graceful shutdown: stop admitting, finish everything queued
+        and in flight, then emit `serve_end{drained}`. Returns False if
+        the scheduler did not exit within `timeout`."""
+        self.queue.close()
+        done = self.scheduler.join(timeout)
+        if not self._ended:
+            self._ended = True
+            self.tele.emit("serve_end", outcome="drained",
+                           stats=self.stats())
+        return done
+
+    def abort(self) -> None:
+        """Hard shutdown: fail all queued + pending work with
+        ServerClosedError, leave a flight-recorder trail, emit
+        `serve_end{aborted}`. In-flight batches still finish (a jitted
+        call cannot be interrupted); their futures resolve normally."""
+        self.scheduler.stop()
+        exc = ServerClosedError("server aborted before this request ran")
+        n = len(self.queue.fail_all(exc))
+        self.scheduler.join(timeout=30.0)
+        n += self.scheduler.fail_pending(exc)
+        if not self._ended:
+            self._ended = True
+            self.tele.emit("note", source="serve", kind="abort",
+                           failed_requests=n)
+            self.tele.emit("serve_end", outcome="aborted",
+                           stats=self.stats())
+            self.tele.dump_flight("serve_abort")
+
+    def close(self, drain: bool = True,
+              timeout: Optional[float] = None) -> None:
+        if drain:
+            self.drain(timeout)
+        else:
+            self.abort()
+
+    # ------------------------------------------------------------- submit
+
+    def submit(self, kind: str, seq: str, annotations=None,
+               deadline_s: Optional[float] = None,
+               top_k: Optional[int] = None) -> Future:
+        """Enqueue one request; returns its future. Raises
+        SequenceTooLongError (on_long="reject", or a '?' beyond the
+        window for predict_residues) and ServerClosedError
+        synchronously; QueueFullError / DeadlineExceededError land on
+        futures (the evicted/expired request's, which may be an earlier
+        caller's — never silently dropped)."""
+        if kind not in KINDS:
+            raise ValueError(f"unknown request kind {kind!r}; have {KINDS}")
+        if not seq:
+            raise ValueError("empty sequence")
+        window = self.cfg.data.seq_len - 2
+        if len(seq) > window:
+            if (self.on_long == "reject"
+                    or (kind == "predict_residues"
+                        and inference.MASK_CHAR in seq[window:])):
+                self._rej_c["too_long"].inc()
+                self._bump("rejected_total", "too_long")
+                self.tele.emit("serve_reject", reason="too_long", kind=kind)
+                raise SequenceTooLongError(
+                    f"sequence of {len(seq)} residues exceeds the model "
+                    f"window of {window}"
+                    + (" (and masks a position the model would never "
+                       "see)" if kind == "predict_residues" else
+                       "; the server is configured to reject rather "
+                       "than truncate"))
+            # The process-wide inference.TRUNCATED_TOTAL is bumped by
+            # _tokenize_masked below (cache hits skip tokenization and
+            # so don't count there); these are the serving-side counts.
+            self._truncated_c.inc()
+            self._bump("truncated_total")
+        if annotations is not None:
+            annotations = inference.check_annotations(
+                np.asarray(annotations, np.float32)[None], 1, self.cfg)[0]
+        self._req_c[kind].inc()
+        future: Future = Future()
+        key = None
+        if self.cache.capacity:
+            key = content_key(kind, seq, annotations)
+            hit = self.cache.get(key)
+            if hit is not None:
+                self._bump("cache_hit_returns")
+                future.set_result(self._present(kind, hit, top_k))
+                return future
+        bucket_len = self.dispatcher.bucket_len(len(seq))
+        tokens = inference._tokenize_masked(
+            [seq], self.cfg.data.seq_len, on_overflow="count")[0, :bucket_len]
+        now = self.clock()
+        if deadline_s is None:
+            deadline_s = self.default_deadline_s
+        req = Request(
+            kind=kind, seq=seq, tokens=tokens, bucket_len=bucket_len,
+            future=future, enqueued_at=now, annotations=annotations,
+            deadline=(now + deadline_s if deadline_s is not None else None),
+            top_k=top_k, cache_key=key)
+        try:
+            evicted = self.queue.push(req)
+        except ServerClosedError:
+            self._rej_c["closed"].inc()
+            self._bump("rejected_total", "closed")
+            self.tele.emit("serve_reject", reason="closed", kind=kind)
+            raise
+        for _ in evicted:
+            self._rej_c["queue_full"].inc()
+            self._bump("rejected_total", "queue_full")
+            self.tele.emit("serve_reject", reason="queue_full")
+        self._depth_g.set(len(self.queue))
+        return future
+
+    # -------------------------------------------------------- sync facade
+
+    def embed(self, seq: str, annotations=None,
+              timeout: Optional[float] = None,
+              deadline_s: Optional[float] = None) -> Dict[str, np.ndarray]:
+        """{"global": (G,), "local_mean": (C,)} float32 for one
+        sequence — the serving form of inference.embed."""
+        return self.submit("embed", seq, annotations,
+                           deadline_s=deadline_s).result(timeout)
+
+    def predict_go(self, seq: str, top_k: Optional[int] = None,
+                   timeout: Optional[float] = None,
+                   deadline_s: Optional[float] = None):
+        """(A,) sigmoid probabilities, or the top-k
+        [(annotation_index, prob), ...] list."""
+        return self.submit("predict_go", seq, top_k=top_k,
+                           deadline_s=deadline_s).result(timeout)
+
+    def predict_residues(self, seq: str, timeout: Optional[float] = None,
+                         deadline_s: Optional[float] = None):
+        """(filled_seq, probs (bucket_len, V)) — '?' positions filled
+        with the argmax amino acid, like inference.predict_residues."""
+        return self.submit("predict_residues", seq,
+                           deadline_s=deadline_s).result(timeout)
+
+    # ------------------------------------------------------- finalization
+
+    def _present(self, kind: str, value, top_k: Optional[int]):
+        """Shape a cached/computed value for one caller (top_k is a
+        per-request view over the cached full probability row)."""
+        if kind == "predict_go" and top_k is not None:
+            probs = value
+            k = min(top_k, probs.shape[0])
+            idx = np.argsort(-probs)[:k]
+            return [(int(j), float(probs[j])) for j in idx]
+        return value
+
+    def _finalize(self, req: Request, row) -> None:
+        """Scheduler callback: one request's raw model row → its result
+        (+ cache insert). Runs on the scheduler thread."""
+        if req.kind == "embed":
+            value = {"global": np.asarray(row["global"]),
+                     "local_mean": np.asarray(row["local_mean"])}
+        elif req.kind == "predict_go":
+            value = np.asarray(row)
+        else:  # predict_residues: fill '?' via the argmax amino acid
+            probs = np.asarray(row)
+            value = (inference.fill_masked_residues(
+                req.seq, probs, self.cfg.data.seq_len - 2), probs)
+        if req.cache_key is not None:
+            self.cache.put(req.cache_key, value)
+        self.completed_total += 1
+        if not req.future.done():
+            req.future.set_result(self._present(req.kind, value, req.top_k))
+        self._depth_g.set(len(self.queue))
+
+    def _count_expiry(self, req: Request) -> None:
+        """Scheduler callback per deadline-expired request: the expiry
+        IS a rejection, so it must show in serve_rejected_total, stats,
+        and the CLI's --max-requests accounting (the serve_reject event
+        is emitted scheduler-side already)."""
+        self._rej_c["deadline"].inc()
+        self._bump("rejected_total", "deadline")
+
+    def _observe_latency(self, seconds: float) -> None:
+        self.latencies.observe(seconds)
+        self._latency_h.observe(seconds)
+        # Percentiles sort the whole ring; doing that per request would
+        # serialize O(n log n) work onto the scheduler thread between
+        # batches. Refresh the gauges once per max_batch completions —
+        # stats()/healthz always recompute fresh.
+        self._latency_n += 1
+        if self._latency_n % self.scheduler.max_batch and self._latency_n != 1:
+            return
+        s = self.latencies.summary()
+        if s["p50_s"] is not None:
+            self._p50_g.set(s["p50_s"])
+            self._p99_g.set(s["p99_s"])
+
+    # ------------------------------------------------------------- stats
+
+    def stats(self) -> Dict[str, Any]:
+        with self._mirror_lock:
+            mirrors = {
+                "cache_hit_returns": self.cache_hit_returns,
+                "truncated": self.truncated_total,
+                "rejected": dict(self.rejected_total),
+            }
+        return {
+            "completed": self.completed_total,
+            **mirrors,
+            "batches": self.scheduler.batches_total,
+            "batched_rows": self.scheduler.rows_total,
+            "queue_depth": len(self.queue),
+            "evicted": self.queue.evicted_total,
+            "expired": self.scheduler.expired_total,
+            "cache": self.cache.stats(),
+            "latency": self.latencies.summary(),
+        }

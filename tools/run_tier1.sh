@@ -40,6 +40,20 @@ python "$(dirname "$0")/validate_events.py" --self-test
 rcv=$?
 [ "$rc" -eq 0 ] && rc=$rcv
 
+# Serving smoke (ISSUE 5 satellite): in-process server on CPU under
+# concurrent clients — continuous micro-batching vs the sequential
+# baseline, per-bucket bit-parity, bounded-queue rejection. Small knobs
+# keep it ~1 min; contract failures (parity / lost / un-rejected
+# overflow) exit nonzero and fail the gate, wall-clock ratios are
+# reported, not gated (bench.py --serve docstring).
+echo "=== serve smoke (in-process server, CPU, concurrent clients) ==="
+timeout -k 10 420 env JAX_PLATFORMS=cpu \
+  PBT_SERVE_BENCH_SEQ_LEN=256 PBT_SERVE_BENCH_DIM=32 \
+  PBT_SERVE_BENCH_REQUESTS=64 PBT_SERVE_BENCH_CLIENTS=24 \
+  python "$(dirname "$0")/../bench.py" --serve
+rcs=$?
+[ "$rc" -eq 0 ] && rc=$rcs
+
 if [ "$PACKED_MD" = "1" ]; then
   echo "=== packed multi-device parity tier (8 virtual devices, opt-in) ==="
   timeout -k 10 900 env JAX_PLATFORMS=cpu PBT_RUN_PACKED_MD=1 \

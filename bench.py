@@ -797,7 +797,8 @@ def run_serve():
 
     Knobs: PBT_SERVE_BENCH_SEQ_LEN (512), PBT_SERVE_BENCH_DIM (64),
     PBT_SERVE_BENCH_REQUESTS (96), PBT_SERVE_BENCH_CLIENTS (16),
-    PBT_SERVE_BENCH_MAX_BATCH (8), PBT_SERVE_BENCH_MEDIAN_LEN
+    PBT_SERVE_BENCH_MAX_BATCH (8), PBT_SERVE_BENCH_TRACE_ROUNDS (5),
+    PBT_SERVE_BENCH_MEDIAN_LEN
     (seq_len // 8).
     """
     import threading
@@ -863,19 +864,22 @@ def run_serve():
     failures = []
     # Metrics-only telemetry (no events file): the registry's
     # serve_batch_seconds histogram supplies the p99-bound batch time.
+    # trace_sample_rate=None: the headline server is UNTRACED — the
+    # tracing cost is measured separately in phase 2c.
     server = Server(params, cfg, max_batch=max_batch, max_wait_s=max_wait_s,
                     queue_depth=4 * n_requests, cache_size=0,
-                    warm_kinds=("embed",), telemetry=Telemetry())
+                    warm_kinds=("embed",), telemetry=Telemetry(),
+                    trace_sample_rate=None)
     t0 = time.perf_counter()
     server.start()
     warm_s = time.perf_counter() - t0
-    def run_load(indices, clients) -> tuple:
+    def run_load(srv, indices, clients) -> tuple:
         results = {}
 
         def client(worker: int) -> None:
             for i in indices[worker::clients]:
                 try:
-                    results[i] = server.embed(seqs[i], timeout=120)
+                    results[i] = srv.embed(seqs[i], timeout=120)
                 except Exception as e:  # noqa: BLE001 — report, don't hang
                     failures.append(f"request {i}: {type(e).__name__}: {e}")
 
@@ -897,9 +901,9 @@ def run_serve():
         deadline = time.monotonic() + 5.0
         prev = -1
         while time.monotonic() < deadline:
-            cur = server.scheduler.rows_total
-            pending = server.scheduler.pending_rows()
-            if cur == prev and len(server.queue) == 0 and pending == 0:
+            cur = srv.scheduler.rows_total
+            pending = srv.scheduler.pending_rows()
+            if cur == prev and len(srv.queue) == 0 and pending == 0:
                 break
             prev = cur
             time.sleep(0.02)
@@ -907,7 +911,8 @@ def run_serve():
 
     # Saturated closed loop: enough concurrent clients that every
     # bucket's group keeps filling — the throughput measurement.
-    sat_results, sat_dt = run_load(list(range(n_requests)), n_clients)
+    sat_results, sat_dt = run_load(server, list(range(n_requests)),
+                                   n_clients)
     sat_stats = server.stats()
     if len(sat_results) != n_requests:
         failures.append(
@@ -920,7 +925,7 @@ def run_serve():
     light_n = max(max_batch, n_requests // 4)
     light_window = type(server.latencies)()
     server.latencies = light_window  # fresh percentile ring
-    light_results, _ = run_load(list(range(light_n)),
+    light_results, _ = run_load(server, list(range(light_n)),
                                 max(2, max_batch // 2))
     batch_h = server.tele.metrics.histogram("serve_batch_seconds")
     max_batch_s = batch_h.max if batch_h.count else 0.0
@@ -957,6 +962,154 @@ def run_serve():
             sat_stats["batched_rows"] / max(sat_stats["batches"], 1), 2),
         "warmup_s": round(warm_s, 2),
     }
+
+    # ---- phase 2c: request tracing — overhead + correctness -----------
+    # Three matched conditions over the same saturated population:
+    #   null        — telemetry NULL (the must-stay-a-no-op path);
+    #   sampled_out — telemetry on, trace_sample_rate=0: every request
+    #                 carries the cheap clock marks but nothing emits
+    #                 (the "<1% of served-request latency" claim);
+    #   full        — sample rate 1.0 + events file + span collector.
+    # All three servers warm first, then measured passes INTERLEAVE
+    # round-robin (matched pairs): CPU-frequency/contention drift on a
+    # shared box hits every condition equally instead of whichever ran
+    # last, and the per-condition MEDIAN over rounds is compared.
+    # CORRECTNESS is GATED on the full condition (invariants, not
+    # wall-clock): every request yields a schema-valid serve_request
+    # event whose contiguous stages sum to its e2e latency, and spans
+    # land in the collector. The overhead percentages are REPORTED —
+    # wall-clock ratios on a shared CI box are evidence, not a gate.
+    import tempfile
+
+    from proteinbert_tpu.obs import read_events
+
+    trace_dir = tempfile.mkdtemp(prefix="pbt_serve_trace_")
+    trace_events = os.path.join(trace_dir, "events.jsonl")
+    # Measured A/B passes per condition (report-only medians; the <1%
+    # gate below is the deterministic timeit measurement) — tunable so
+    # budgeted runs (tier-1 smoke) can trim the load matrix.
+    rounds = int(os.environ.get("PBT_SERVE_BENCH_TRACE_ROUNDS", 5))
+
+    sampled_tele = Telemetry(events_path=os.path.join(trace_dir,
+                                                      "sampled.jsonl"))
+    ttele = Telemetry(events_path=trace_events, spans=True)
+    conditions = (("null", None, None),
+                  ("sampled_out", sampled_tele, 0.0),
+                  ("full", ttele, 1.0))
+    ab_servers = []
+    rps = {}
+    for name, tele_c, rate in conditions:
+        srv = Server(params, cfg, max_batch=max_batch,
+                     max_wait_s=max_wait_s, queue_depth=4 * n_requests,
+                     cache_size=0, warm_kinds=("embed",),
+                     telemetry=tele_c, trace_sample_rate=rate)
+        srv.start()  # reuses the process-wide jit cache — cheap
+        run_load(srv, list(range(n_requests)), n_clients)  # warm pass
+        ab_servers.append((name, srv))
+        rps[name] = []
+    for _ in range(rounds):
+        for name, srv in ab_servers:
+            results, dt = run_load(srv, list(range(n_requests)),
+                                   n_clients)
+            rps[name].append(len(results) / dt)
+    for _, srv in ab_servers:
+        srv.drain(timeout=60)
+    sampled_tele.close()
+    ttele.close()
+
+    from statistics import median as _median
+
+    null_rps = _median(rps["null"])
+    sampled_rps = _median(rps["sampled_out"])
+    full_rps = _median(rps["full"])
+    sampled_overhead = (1.0 - sampled_rps / max(null_rps, 1e-9)) * 100.0
+    full_overhead = (1.0 - full_rps / max(null_rps, 1e-9)) * 100.0
+    trace_recs = [r for r in read_events(trace_events, strict=True)
+                  if r["event"] == "serve_request"]
+    expected = (rounds + 1) * n_requests  # warm + measured passes
+    if len(trace_recs) != expected:
+        failures.append(
+            f"tracing: expected {expected} serve_request events "
+            f"at sample rate 1.0, got {len(trace_recs)}")
+    bad_sums = 0
+    for r in trace_recs:
+        if abs(sum(r["stages"].values()) - r["e2e_s"]) > 1e-5:
+            bad_sums += 1
+    if bad_sums:
+        failures.append(
+            f"tracing: {bad_sums}/{len(trace_recs)} serve_request "
+            "events whose stages do not sum to e2e_s")
+    if len(ttele.spans or ()) == 0:
+        failures.append("tracing: span collector stayed empty")
+    # Sampled-out emissions would break the sampling contract: at rate
+    # 0 no SUCCESSFUL request may emit (errors/rejections always do,
+    # by design — only ok/cache_hit outcomes are violations here).
+    sampled_recs = [r for r in read_events(
+        os.path.join(trace_dir, "sampled.jsonl"), strict=True)
+        if r["event"] == "serve_request"
+        and r["outcome"] in ("ok", "cache_hit")]
+    if sampled_recs:
+        failures.append(
+            f"tracing: {len(sampled_recs)} successful serve_request "
+            "events emitted at sample rate 0")
+    # The "<1% of served-request latency" contract, measured the way
+    # the claim is stated: the EXACT per-request hot path a sampled-out
+    # request pays (trace create + every clock mark + batch stamp +
+    # seal, no stage dict — Server._seal skips it with no consumer),
+    # timed deterministically, against the FASTEST latency any request
+    # sees (the sequential baseline — saturated/light served latencies
+    # are strictly larger, so <1% here is <1% everywhere). The A/B
+    # throughput medians above are kept for honesty, but on a 2-core
+    # box their round-to-round swing is far wider than 1%: the ratio
+    # measures scheduler-thread contention, not the trace cost.
+    import timeit as _timeit
+
+    from proteinbert_tpu.serve.trace import RequestTrace
+
+    def _trace_hot_path():
+        tr = RequestTrace("bench-1f", "embed", time.monotonic(),
+                          sampled=False)
+        tr.mark_enqueued(time.monotonic())
+        tr.mark_ingested(time.monotonic())
+        tr.mark_popped(time.monotonic())
+        t0 = time.monotonic()
+        tr.mark_run(t0, time.monotonic())
+        tr.mark_batch(seq_len, max_batch, max_batch, 0.3, 0.001, 0.002)
+        tr.finish("ok", time.monotonic())
+        return tr.e2e_s()
+
+    reps = 20000
+    trace_cost_us = min(
+        _timeit.timeit(_trace_hot_path, number=reps) / reps * 1e6
+        for _ in range(3))
+    baseline_latency_us = baseline["ms_per_request"] * 1e3
+    trace_cost_pct = 100.0 * trace_cost_us / baseline_latency_us
+
+    tracing = {
+        "rounds": rounds,
+        "rps_per_round": {name: [round(v, 2) for v in vals]
+                          for name, vals in rps.items()},
+        "null_requests_per_sec": round(null_rps, 2),
+        "sampled_out_requests_per_sec": round(sampled_rps, 2),
+        "full_requests_per_sec": round(full_rps, 2),
+        "sampled_out_overhead_pct": round(sampled_overhead, 2),
+        "full_overhead_pct": round(full_overhead, 2),
+        "trace_cost_us_per_request": round(trace_cost_us, 2),
+        "trace_cost_pct_of_fastest_latency": round(trace_cost_pct, 3),
+        "sampled_out_within_1pct": bool(trace_cost_pct < 1.0),
+        "serve_request_events": len(trace_recs),
+        "stage_sum_mismatches": bad_sums,
+        "spans": len(ttele.spans or ()),
+    }
+    if trace_cost_pct >= 1.0:
+        failures.append(
+            f"tracing: sampled-out per-request cost {trace_cost_us:.1f}us "
+            f"is {trace_cost_pct:.2f}% of the fastest served-request "
+            f"latency ({baseline_latency_us:.0f}us) — breaks the <1% "
+            "contract")
+    import shutil
+
+    shutil.rmtree(trace_dir, ignore_errors=True)
 
     # ---- phase 3a: served-vs-offline bit-parity per bucket ------------
     parity = {}
@@ -1010,6 +1163,7 @@ def run_serve():
         "served": served,
         "speedup_x": round(served["requests_per_sec"]
                            / max(baseline["requests_per_sec"], 1e-9), 2),
+        "tracing": tracing,
         "parity_per_bucket": parity,
         "overflow": overflow,
         "failures": failures,
@@ -1024,6 +1178,8 @@ def run_serve():
                 n_requests=n_requests, speedup_x=record["speedup_x"],
                 served_requests_per_sec=served["requests_per_sec"],
                 light_p99_ms=served["light_p99_ms"],
+                trace_overhead_pct=tracing["sampled_out_overhead_pct"],
+                trace_full_overhead_pct=tracing["full_overhead_pct"],
                 rejected_queue_full=overflow["rejected_queue_full"],
                 failures=len(failures))
         ev.close()

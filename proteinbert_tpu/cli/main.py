@@ -720,7 +720,9 @@ def cmd_diagnose(args) -> int:
     obs subsystem exists for. No jax import: runs anywhere the
     artifacts can be copied."""
     from proteinbert_tpu.obs import read_events, validate_flight_dump
-    from proteinbert_tpu.obs.diagnose import render, summarize
+    from proteinbert_tpu.obs.diagnose import (
+        render, render_serve, summarize, summarize_serve,
+    )
 
     records = read_events(args.events)
     if not records:
@@ -733,12 +735,25 @@ def cmd_diagnose(args) -> int:
             validate_flight_dump(flight)
         except ValueError as e:
             raise SystemExit(f"{args.flight} is not a valid flight dump: {e}")
+    # The serve section renders when asked for (--serve) or when the
+    # stream carries serving records (a mixed stream shows both).
+    has_serve = any(r["event"].startswith("serve_") for r in records)
+    if args.serve and not has_serve:
+        raise SystemExit(f"--serve: no serve_* records in {args.events}")
+    serve_summary = (summarize_serve(records, slow_top=args.slow_top)
+                     if has_serve else None)
     summary = summarize(records, flight=flight,
                         slow_top=args.slow_top, last=args.last)
+    if serve_summary is not None:
+        summary["serve"] = serve_summary
     if args.json:
         print(json.dumps(summary))
+    elif args.serve:
+        print(render_serve(serve_summary))
     else:
         print(render(summary))
+        if serve_summary is not None:
+            print(render_serve(serve_summary))
     return 0
 
 
@@ -996,11 +1011,24 @@ def cmd_serve(args) -> int:
             f"({mesh.size} devices)")
 
     tele = None
-    if args.events_jsonl:
+    if args.events_jsonl or args.trace_perfetto or args.slo:
         from proteinbert_tpu.obs import Telemetry
 
-        tele = Telemetry(events_path=args.events_jsonl)
+        # spans=True arms the host SpanCollector the request traces
+        # replay into; --events-jsonl may be absent (spans/SLO-only
+        # runs still get the flight ring + metrics registry).
+        tele = Telemetry(events_path=args.events_jsonl,
+                         spans=bool(args.trace_perfetto))
         tele.flight.install_excepthook()
+
+    slos = []
+    if args.slo:
+        from proteinbert_tpu.obs.slo import parse_slos
+
+        slos = parse_slos(args.slo)
+        log("slo objectives: " + ", ".join(
+            f"{o.name} ({o.kind}, target {o.target:g}, "
+            f"window {o.window_s:g}s)" for o in slos))
 
     server = Server(
         params, cfg,
@@ -1013,6 +1041,9 @@ def cmd_serve(args) -> int:
         on_long=args.on_long,
         mesh=mesh,
         telemetry=tele,
+        trace_sample_rate=args.trace_sample_rate,
+        slos=slos,
+        slo_profile_dir=args.slo_profile_dir,
     )
     log(f"warming {len(server.dispatcher.buckets)} bucket(s) x "
         f"{len(server.dispatcher.batch_classes)} batch class(es): "
@@ -1045,6 +1076,14 @@ def cmd_serve(args) -> int:
         httpd.server_close()
         server.drain(timeout=60)
         if tele is not None:
+            if args.trace_perfetto and tele.spans is not None:
+                try:
+                    tele.spans.dump(args.trace_perfetto)
+                    log(f"wrote {len(tele.spans)} request-trace spans "
+                        f"to {args.trace_perfetto} (load in "
+                        "ui.perfetto.dev)")
+                except OSError as e:
+                    log(f"could not write trace dump: {e}")
             _export_metrics(tele)
             tele.close()
     stats = server.stats()
@@ -1052,6 +1091,11 @@ def cmd_serve(args) -> int:
         f"({stats['cache_hit_returns']} cache hits, "
         f"{sum(stats['rejected'].values())} rejected); "
         f"p50 {stats['latency']['p50_s']}s p99 {stats['latency']['p99_s']}s")
+    for name, st in (stats.get("slo") or {}).items():
+        log(f"slo {name}: burn {st['burn_rate']:g} "
+            f"({st['bad']}/{st['total']} bad in window"
+            + (f", {st['breaches_total']} breach(es)"
+               if st["breaches_total"] else "") + ")")
     return 0
 
 
@@ -1235,6 +1279,11 @@ def build_parser() -> argparse.ArgumentParser:
                     help="size of the slowest-windows list")
     dg.add_argument("--json", action="store_true",
                     help="machine-readable summary instead of the report")
+    dg.add_argument("--serve", action="store_true",
+                    help="render only the serving section (request "
+                         "outcomes, stage attribution, SLO breaches); "
+                         "a stream with serve_* records shows it "
+                         "automatically after the training report")
     dg.set_defaults(fn=cmd_diagnose)
 
     dbench = sub.add_parser("data-bench",
@@ -1333,6 +1382,23 @@ def build_parser() -> argparse.ArgumentParser:
                     help="exit after this many requests (smoke tests)")
     sv.add_argument("--events-jsonl", type=creatable_path,
                     help="append serve_* run events to this JSONL stream")
+    sv.add_argument("--trace-sample-rate", type=float, default=1.0,
+                    help="fraction of requests whose serve_request "
+                         "event + spans are emitted (errors/rejections "
+                         "always emit; every request is traced "
+                         "cheaply regardless)")
+    sv.add_argument("--trace-perfetto", type=creatable_path,
+                    help="dump request-trace spans here at drain "
+                         "(Perfetto traceEvents JSON, .gz ok)")
+    sv.add_argument("--slo", action="append", metavar="SPEC",
+                    help="declarative objective, repeatable: e.g. "
+                         "'kind=latency,threshold_ms=250,target=0.99,"
+                         "window_s=300' or 'kind=error_rate,"
+                         "target=0.999' (docs/observability.md)")
+    sv.add_argument("--slo-profile-dir", type=creatable_path,
+                    help="on an SLO breach, capture an on-demand "
+                         "jax.profiler device trace here (cooldown-"
+                         "limited)")
     sv.set_defaults(fn=cmd_serve)
 
     return p

@@ -39,14 +39,19 @@ from typing import Any, Dict, Optional
 
 from proteinbert_tpu.obs.events import (
     CKPT_PHASES, EVENT_FIELDS, OUTCOMES, SCHEMA_VERSION,
-    SERVE_OUTCOMES, SERVE_REJECT_REASONS, EventLog,
+    SERVE_OUTCOMES, SERVE_REJECT_REASONS, SERVE_REQUEST_OUTCOMES,
+    EventLog,
     build_record, make_example, make_record, read_events, sanitize,
     validate_record,
 )
 from proteinbert_tpu.obs.flight import (
     FlightRecorder, flight_path, validate_flight_dump,
 )
-from proteinbert_tpu.obs.metrics import MetricsRegistry
+from proteinbert_tpu.obs.metrics import MetricsRegistry, QuantileWindow
+from proteinbert_tpu.obs.slo import (
+    ExemplarHistogram, ProfileTrigger, SLObjective, SLOEvaluator,
+    parse_slo, parse_slos,
+)
 from proteinbert_tpu.obs.tracing import SpanCollector, span, step_span
 
 _NULL_CTX = contextlib.nullcontext()
@@ -150,8 +155,10 @@ __all__ = [
     "EventLog", "read_events", "validate_record", "make_record",
     "make_example", "sanitize",
     "SCHEMA_VERSION", "EVENT_FIELDS", "CKPT_PHASES", "OUTCOMES",
-    "SERVE_OUTCOMES", "SERVE_REJECT_REASONS",
-    "MetricsRegistry",
+    "SERVE_OUTCOMES", "SERVE_REJECT_REASONS", "SERVE_REQUEST_OUTCOMES",
+    "MetricsRegistry", "QuantileWindow",
+    "SLObjective", "SLOEvaluator", "ExemplarHistogram", "ProfileTrigger",
+    "parse_slo", "parse_slos",
     "SpanCollector", "span", "step_span",
     "FlightRecorder", "flight_path", "validate_flight_dump",
 ]

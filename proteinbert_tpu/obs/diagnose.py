@@ -16,6 +16,11 @@ import collections
 from typing import Any, Dict, List, Optional
 
 
+# One rank convention for the whole obs package: a p99 here must equal
+# the registry window's p99 for the same data.
+from proteinbert_tpu.obs.metrics import nearest_rank as _percentile
+
+
 def summarize(records: List[Dict[str, Any]],
               flight: Optional[Dict[str, Any]] = None,
               slow_top: int = 5, last: int = 10) -> Dict[str, Any]:
@@ -132,6 +137,165 @@ def summarize(records: List[Dict[str, Any]],
         **({"outcome": r["outcome"]} if r["event"] == "run_end" else {}),
     } for r in tail_src[-last:]]
     return out
+
+
+def summarize_serve(records: List[Dict[str, Any]],
+                    slow_top: int = 5) -> Dict[str, Any]:
+    """The `pbt diagnose --serve` section: request outcomes, latency
+    percentiles, per-stage time attribution, and SLO breaches from the
+    serve_* records of a stream (ISSUE 6). Optional-input-safe like
+    summarize(): a stream with only a manifest still summarizes."""
+    start = next((r for r in records if r["event"] == "serve_start"), None)
+    end = next((r for r in reversed(records)
+                if r["event"] == "serve_end"), None)
+    reqs = [r for r in records if r["event"] == "serve_request"]
+    rejects = [r for r in records if r["event"] == "serve_reject"]
+    batches = [r for r in records if r["event"] == "serve_batch"]
+    breaches = [r for r in records if r["event"] == "slo_breach"]
+
+    out: Dict[str, Any] = {
+        "manifest": (start.get("config") if start else None),
+        "outcome": (end["outcome"] if end
+                    else "unknown (no serve_end record)"),
+        "requests_traced": len(reqs),
+        "outcomes": dict(collections.Counter(r["outcome"] for r in reqs)),
+    }
+
+    # ---- end-to-end latency + per-stage attribution (traced reqs) ----
+    e2e = sorted(r["e2e_s"] for r in reqs
+                 if isinstance(r.get("e2e_s"), (int, float)))
+    out["e2e"] = {
+        "n": len(e2e),
+        "p50_s": _percentile(e2e, 0.50),
+        "p99_s": _percentile(e2e, 0.99),
+        "max_s": e2e[-1] if e2e else None,
+    }
+    stage_sums: Dict[str, float] = collections.defaultdict(float)
+    for r in reqs:
+        for stage, dur in (r.get("stages") or {}).items():
+            if isinstance(dur, (int, float)):
+                stage_sums[stage] += dur
+        # Padding waste is attribution, not a wall-clock stage: it
+        # overlaps `execute`, so it is reported beside the stages.
+        pf, ex = r.get("pad_fraction"), (r.get("stages") or {}).get(
+            "execute")
+        if isinstance(pf, (int, float)) and isinstance(ex, (int, float)):
+            stage_sums["pad_wasted(of execute)"] += pf * ex
+    total = sum(v for k, v in stage_sums.items() if "(" not in k)
+    out["stage_attribution"] = {
+        k: {"total_s": round(v, 6),
+            "share": round(v / total, 4) if total else None}
+        for k, v in sorted(stage_sums.items(), key=lambda kv: -kv[1])
+    }
+
+    # ---- slowest traced requests, with the stage to blame ----
+    slow = sorted((r for r in reqs
+                   if isinstance(r.get("e2e_s"), (int, float))),
+                  key=lambda r: -r["e2e_s"])[:slow_top]
+    out["slowest"] = [{
+        "request_id": r.get("request_id"),
+        "kind": r["kind"],
+        "outcome": r["outcome"],
+        "e2e_s": round(r["e2e_s"], 6),
+        "dominant_stage": (max(r["stages"], key=r["stages"].get)
+                           if r.get("stages") else None),
+        "bucket_len": r.get("bucket_len"),
+        "batch_class": r.get("batch_class"),
+    } for r in slow]
+
+    # ---- rejections (with queue depth where the emitter knew it) ----
+    depths = [r["queue_depth"] for r in rejects
+              if isinstance(r.get("queue_depth"), int)]
+    out["rejects"] = {
+        "total": len(rejects),
+        "by_reason": dict(collections.Counter(r["reason"]
+                                              for r in rejects)),
+        "queue_depth_max": max(depths) if depths else None,
+        "queue_depth_mean": (round(sum(depths) / len(depths), 2)
+                             if depths else None),
+    }
+
+    # ---- batches ----
+    rows = [b["rows"] for b in batches]
+    occ = [b["rows"] / b["batch_class"] for b in batches
+           if isinstance(b.get("batch_class"), int) and b["batch_class"]]
+    pads = [b["pad_fraction"] for b in batches
+            if isinstance(b.get("pad_fraction"), (int, float))]
+    out["batches"] = {
+        "n": len(batches),
+        "rows": sum(rows),
+        "mean_rows": round(sum(rows) / len(rows), 2) if rows else None,
+        "mean_occupancy": (round(sum(occ) / len(occ), 4)
+                           if occ else None),
+        "mean_pad_fraction": (round(sum(pads) / len(pads), 4)
+                              if pads else None),
+    }
+
+    # ---- SLO breaches ----
+    out["slo_breaches"] = [{
+        "objective": b["objective"], "burn_rate": b["burn_rate"],
+        "bad": b.get("bad"), "total": b.get("total"), "t": b["t"],
+    } for b in breaches]
+    if end is not None and isinstance(end.get("stats"), dict):
+        out["final_slo"] = end["stats"].get("slo")
+    return out
+
+
+def render_serve(summary: Dict[str, Any]) -> str:
+    """Human-readable serve section (`pbt diagnose --serve`)."""
+    lines = ["-- serve --"]
+    lines.append(f"outcome: {summary['outcome']}")
+    man = summary.get("manifest")
+    if man:
+        lines.append(
+            f"manifest: buckets {man.get('buckets')} classes "
+            f"{man.get('batch_classes')} queue {man.get('queue_depth')} "
+            f"cache {man.get('cache_size')} trace_rate "
+            f"{man.get('trace_sample_rate')}")
+    if summary["outcomes"]:
+        lines.append("traced requests: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(summary["outcomes"].items())))
+    e2e = summary["e2e"]
+    if e2e["n"]:
+        lines.append(f"e2e latency (n={e2e['n']}): "
+                     f"p50 {e2e['p50_s'] * 1e3:.2f}ms "
+                     f"p99 {e2e['p99_s'] * 1e3:.2f}ms "
+                     f"max {e2e['max_s'] * 1e3:.2f}ms")
+    attr = summary["stage_attribution"]
+    if attr:
+        lines.append("where the time went (all traced requests):")
+        for stage, a in attr.items():
+            share = (f"{100 * a['share']:5.1f}%" if a["share"] is not None
+                     else "     ")
+            lines.append(f"  {stage:<24} {a['total_s']:10.4f}s {share}")
+    for s in summary["slowest"]:
+        lines.append(
+            f"  slow: {s['request_id']} {s['kind']} {s['outcome']} "
+            f"{s['e2e_s'] * 1e3:.2f}ms (mostly {s['dominant_stage']}, "
+            f"L={s['bucket_len']} cls={s['batch_class']})")
+    rej = summary["rejects"]
+    if rej["total"]:
+        lines.append(
+            f"rejects: {rej['total']} " + ", ".join(
+                f"{k}={v}" for k, v in sorted(rej["by_reason"].items()))
+            + (f" (queue depth mean {rej['queue_depth_mean']}"
+               f" max {rej['queue_depth_max']})"
+               if rej["queue_depth_max"] is not None else ""))
+    b = summary["batches"]
+    if b["n"]:
+        lines.append(f"batches: {b['n']} ({b['rows']} rows, mean "
+                     f"{b['mean_rows']}/batch, occupancy "
+                     f"{b['mean_occupancy']}, pad fraction "
+                     f"{b['mean_pad_fraction']})")
+    for br in summary["slo_breaches"]:
+        lines.append(f"SLO BREACH: {br['objective']} burn "
+                     f"{br['burn_rate']:.2f} ({br['bad']}/{br['total']} "
+                     f"bad) at t={br['t']:.2f}")
+    if not summary["slo_breaches"] and summary.get("final_slo"):
+        lines.append("slo: no breach events; final burn rates: " + ", ".join(
+            f"{k}={v.get('burn_rate')}"
+            for k, v in summary["final_slo"].items()))
+    return "\n".join(lines)
 
 
 def render(summary: Dict[str, Any]) -> str:

@@ -61,17 +61,38 @@ EVENT_FIELDS: Dict[str, Dict[str, Any]] = {
     # One per dispatched micro-batch: which compiled shape class ran and
     # how full it was (rows ≤ the padded batch class size).
     "serve_batch": {"kind": str, "bucket_len": int, "rows": int},
-    # One per rejected request: reason in SERVE_REJECT_REASONS.
+    # One per rejected request: reason in SERVE_REJECT_REASONS
+    # (+ queue_depth at rejection time, when the emitter knows it).
     "serve_reject": {"reason": str},
     # Terminal serving record; outcome in SERVE_OUTCOMES, stats is
     # Server.stats() (requests/rejections/cache hit rate/latency).
     "serve_end": {"outcome": str, "stats": dict},
+    # ---- per-request serve tracing + SLOs (ISSUE 6) ----
+    # One per SAMPLED (or failed/rejected — always sampled) request:
+    # the request's stage-duration breakdown. `stages` maps stage name
+    # (submit/queue/batch_form/dispatch/execute/finalize) → seconds;
+    # stages are contiguous clock intervals, so their sum equals e2e_s
+    # up to float rounding. outcome in SERVE_REQUEST_OUTCOMES. Extra
+    # fields: e2e_s, bucket_len, batch_class, rows, pad_fraction,
+    # cache, sampled, error.
+    "serve_request": {"kind": str, "outcome": str, "request_id": str,
+                      "stages": dict},
+    # An SLO objective's burn rate crossed 1.0 (error budget burning
+    # faster than it accrues). Extra fields: window_s, bad, total,
+    # bad_fraction, attribution, profile_path.
+    "slo_breach": {"objective": str, "burn_rate": (int, float)},
 }
 
 CKPT_PHASES = ("dispatch", "landed", "save")
 OUTCOMES = ("completed", "preempted", "early_stopped", "nan_halt", "error")
 SERVE_OUTCOMES = ("drained", "aborted")
 SERVE_REJECT_REASONS = ("queue_full", "deadline", "closed", "too_long")
+# Terminal per-request outcomes: ok/cache_hit resolve a result; error is
+# a dispatch/finalize failure; expired missed its deadline; evicted lost
+# its queue slot to newer work; rejected never got past admission;
+# aborted was killed by a hard shutdown.
+SERVE_REQUEST_OUTCOMES = ("ok", "cache_hit", "error", "expired",
+                          "evicted", "rejected", "aborted")
 
 
 def sanitize(value: Any) -> Any:
@@ -158,9 +179,16 @@ def validate_record(rec: Any) -> None:
     if event == "serve_end" and rec["outcome"] not in SERVE_OUTCOMES:
         raise ValueError(f"serve_end.outcome {rec['outcome']!r} not in "
                          f"{SERVE_OUTCOMES}")
-    if event == "serve_reject" and rec["reason"] not in SERVE_REJECT_REASONS:
-        raise ValueError(f"serve_reject.reason {rec['reason']!r} not in "
-                         f"{SERVE_REJECT_REASONS}")
+    if event == "serve_reject":
+        if rec["reason"] not in SERVE_REJECT_REASONS:
+            raise ValueError(f"serve_reject.reason {rec['reason']!r} not in "
+                             f"{SERVE_REJECT_REASONS}")
+        # queue_depth is optional (older streams predate it) but typed.
+        qd = rec.get("queue_depth")
+        if qd is not None and (not isinstance(qd, int)
+                               or isinstance(qd, bool) or qd < 0):
+            raise ValueError(f"serve_reject.queue_depth must be a "
+                             f"non-negative int, got {qd!r}")
     if event == "serve_batch":
         for field in ("bucket_len", "rows"):
             v = rec[field]
@@ -168,6 +196,21 @@ def validate_record(rec: Any) -> None:
                 raise ValueError(
                     f"serve_batch.{field} must be a non-negative int, "
                     f"got {v!r}")
+    if event == "serve_request":
+        if rec["outcome"] not in SERVE_REQUEST_OUTCOMES:
+            raise ValueError(f"serve_request.outcome {rec['outcome']!r} "
+                             f"not in {SERVE_REQUEST_OUTCOMES}")
+        for name, v in rec["stages"].items():
+            if isinstance(v, bool) or not isinstance(v, (int, float)) \
+                    or not math.isfinite(v) or v < 0:
+                raise ValueError(
+                    f"serve_request.stages[{name!r}] must be a "
+                    f"non-negative finite number, got {v!r}")
+    if event == "slo_breach":
+        br = rec["burn_rate"]
+        if isinstance(br, bool) or not math.isfinite(br) or br < 0:
+            raise ValueError(f"slo_breach.burn_rate must be a "
+                             f"non-negative finite number, got {br!r}")
 
 
 def make_example(event: str) -> Dict[str, Any]:
@@ -186,8 +229,12 @@ def make_example(event: str) -> Dict[str, Any]:
         "note": {"source": "self_test"},
         "serve_start": {"config": {"max_batch": 8}, "pid": 1},
         "serve_batch": {"kind": "embed", "bucket_len": 128, "rows": 4},
-        "serve_reject": {"reason": "queue_full"},
+        "serve_reject": {"reason": "queue_full", "queue_depth": 4},
         "serve_end": {"outcome": "drained", "stats": {"requests": 0}},
+        "serve_request": {"kind": "embed", "outcome": "ok",
+                          "request_id": "r000001",
+                          "stages": {"queue": 0.001, "execute": 0.004}},
+        "slo_breach": {"objective": "latency_e2e", "burn_rate": 2.5},
     }
     return make_record(event, seq=0, t=0.0, **payloads[event])
 

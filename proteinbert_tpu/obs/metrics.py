@@ -18,11 +18,13 @@ Stdlib-only; no jax import (tools must run anywhere).
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import os
 import tempfile
+import threading
 import time
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 
 class Counter:
@@ -70,6 +72,62 @@ class Histogram:
         self.last = v
 
 
+def nearest_rank(sorted_values, fraction: float) -> Optional[float]:
+    """Nearest-rank pick from an ASCENDING list; `fraction` in [0, 1].
+    The one percentile convention for the obs package (QuantileWindow,
+    diagnose): a rank-rule change happens here or nowhere."""
+    if not sorted_values:
+        return None
+    idx = min(len(sorted_values) - 1,
+              max(0, int(round(fraction * (len(sorted_values) - 1)))))
+    return sorted_values[idx]
+
+
+class QuantileWindow:
+    """Bounded ring of recent observations with percentile reads — the
+    p50/p99 a streaming Histogram cannot provide (count/sum/min/max
+    only). Previously `serve/server._LatencyWindow`; it lives in the
+    registry now so `/metrics`, `Server.stats()`, and `serve_request`
+    events all read the SAME ring and cannot drift (percentiles are
+    computed at read time, never cached).
+
+    Thread-safe: serving observes from the scheduler thread while
+    stats()/scrapes read from client/HTTP threads."""
+
+    __slots__ = ("_ring", "_lock")
+
+    def __init__(self, capacity: int = 2048):
+        self._ring: "collections.deque[float]" = collections.deque(
+            maxlen=capacity)
+        self._lock = threading.Lock()
+
+    def observe(self, seconds: float) -> None:
+        with self._lock:
+            self._ring.append(float(seconds))
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    def percentile(self, q: float) -> Optional[float]:
+        """Nearest-rank percentile; `q` is in PERCENT (0–100), e.g.
+        `percentile(99)` — not the 0–1 fraction `summary()` uses
+        internally. None while the ring is empty."""
+        with self._lock:
+            data = sorted(self._ring)
+        return nearest_rank(data, q / 100.0)
+
+    def summary(self) -> Dict[str, Optional[float]]:
+        with self._lock:
+            if not self._ring:
+                return {"n": 0, "p50_s": None, "p99_s": None, "mean_s": None}
+            data = sorted(self._ring)
+        return {"n": len(data),
+                "p50_s": round(nearest_rank(data, 0.50), 6),
+                "p99_s": round(nearest_rank(data, 0.99), 6),
+                "mean_s": round(sum(data) / len(data), 6)}
+
+
 class _NullInstrument:
     """Shared do-nothing counter/gauge/histogram for a disabled registry."""
 
@@ -105,6 +163,7 @@ class MetricsRegistry:
         self._counters: Dict[str, Counter] = {}
         self._gauges: Dict[str, Gauge] = {}
         self._histograms: Dict[str, Histogram] = {}
+        self._windows: Dict[str, QuantileWindow] = {}
 
     # ----------------------------------------------------- instruments
 
@@ -122,6 +181,24 @@ class MetricsRegistry:
         if not self.enabled:
             return _NULL
         return self._get(self._histograms, Histogram, name, labels)
+
+    def quantile_window(self, name: str, capacity: int = 2048,
+                        **labels) -> QuantileWindow:
+        """A registered percentile ring (exported as `<name>_p50_s` /
+        `_p99_s` / `_mean_s` gauge families plus `<name>_window_n`).
+
+        Unlike the other instruments, a DISABLED registry returns a
+        live but UNREGISTERED window rather than a shared no-op: the
+        callers that need percentiles (Server.stats) must report real
+        numbers even under the NULL telemetry facade, and a deque
+        append is cheap enough to keep the ~zero-overhead contract."""
+        if not self.enabled:
+            return QuantileWindow(capacity)
+        k = _key(name, labels)
+        win = self._windows.get(k)
+        if win is None:
+            win = self._windows[k] = QuantileWindow(capacity)
+        return win
 
     def _get(self, table, cls, name, labels):
         k = _key(name, labels)
@@ -158,7 +235,7 @@ class MetricsRegistry:
     # ----------------------------------------------------- export
 
     def snapshot(self) -> Dict[str, Any]:
-        return {
+        out = {
             "counters": {k: c.value for k, c in self._counters.items()},
             "gauges": {k: g.value for k, g in self._gauges.items()},
             "histograms": {
@@ -170,6 +247,10 @@ class MetricsRegistry:
                 for k, h in self._histograms.items()
             },
         }
+        if self._windows:
+            out["windows"] = {k: w.summary()
+                              for k, w in self._windows.items()}
+        return out
 
     def write_snapshot(self, path: str) -> None:
         """Append one timestamped JSONL snapshot line."""
@@ -209,6 +290,15 @@ class MetricsRegistry:
             if h.count:
                 metric(k, "_min", "gauge", h.min)
                 metric(k, "_max", "gauge", h.max)
+        for k, w in sorted(self._windows.items()):
+            # Percentiles computed at scrape time from the live ring —
+            # the exposition can never lag what stats() reports.
+            s = w.summary()
+            metric(k, "_window_n", "gauge", s["n"])
+            if s["n"]:
+                metric(k, "_p50_s", "gauge", s["p50_s"])
+                metric(k, "_p99_s", "gauge", s["p99_s"])
+                metric(k, "_mean_s", "gauge", s["mean_s"])
         return "\n".join(lines) + ("\n" if lines else "")
 
     def write_prometheus(self, path: str, prefix: str = "pbt_") -> None:

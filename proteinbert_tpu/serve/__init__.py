@@ -51,6 +51,7 @@ from proteinbert_tpu.serve.errors import (
 from proteinbert_tpu.serve.queue import Request, RequestQueue
 from proteinbert_tpu.serve.scheduler import MicroBatchScheduler
 from proteinbert_tpu.serve.server import Server
+from proteinbert_tpu.serve.trace import RequestTrace
 
 __all__ = [
     "Server",
@@ -58,6 +59,7 @@ __all__ = [
     "MicroBatchScheduler",
     "RequestQueue",
     "Request",
+    "RequestTrace",
     "EmbeddingCache",
     "content_key",
     "ServeError",

@@ -31,6 +31,7 @@ the same jitted kernels the same padded shapes.
 
 from __future__ import annotations
 
+import time
 from typing import Dict, Optional, Sequence, Tuple
 
 import jax
@@ -181,20 +182,44 @@ class BucketDispatcher:
         Returns {"global", "local_mean"} for "embed", (r, A) probs for
         "predict_go", (r, L, V) probs for "predict_residues".
         """
+        result, _ = self.run_timed(kind, tokens, annotations,
+                                   timed=False)
+        return result
+
+    def run_timed(self, kind: str, tokens: np.ndarray,
+                  annotations: Optional[np.ndarray] = None,
+                  timed: bool = True):
+        """`run()` that also returns stage attribution for request
+        traces: {"prep_s": pad + device placement, "device_s": model
+        call through host fetch (the compile lands here on a cold
+        shape), "pad_fraction": padding share of the (batch_class, L)
+        grid the executable actually ran — row padding up to the class
+        plus token padding within rows}."""
         rows, L = tokens.shape
         if L not in self.buckets:
             raise ValueError(f"tokens length {L} is not one of the "
                              f"buckets {self.buckets}")
+        timings: Dict[str, float] = {}
+        t0 = time.perf_counter() if timed else 0.0
         annotations = inference.check_annotations(annotations, rows, self.cfg)
         cls = self.batch_class(rows)
+        if timed:
+            real = int((tokens != PAD_ID).sum())
+            timings["pad_fraction"] = round(1.0 - real / (cls * L), 6)
         if rows < cls:
             tokens = np.pad(tokens, ((0, cls - rows), (0, 0)))
             annotations = np.pad(annotations, ((0, cls - rows), (0, 0)))
         fn = self._fn(kind)
         tb, ab = self._place(tokens, annotations)
+        if timed:
+            t1 = time.perf_counter()
+            timings["prep_s"] = round(t1 - t0, 9)
         res = fn(self.params, tb, ab, self.cfg.model)
         self._warm.add((kind, L, cls))
-        return jax.tree.map(lambda a: np.asarray(a)[:rows], res)
+        out = jax.tree.map(lambda a: np.asarray(a)[:rows], res)
+        if timed:
+            timings["device_s"] = round(time.perf_counter() - t1, 9)
+        return out, timings
 
     def warmup(self, kinds: Sequence[str] = ("embed",)) -> int:
         """Pre-compile every (bucket_len, batch_class) executable for the
@@ -215,8 +240,6 @@ class BucketDispatcher:
                     dummy[:, 0] = SOS_ID
                     dummy[:, 1] = EOS_ID
                     if self._compile_hist is not None:
-                        import time
-
                         t0 = time.perf_counter()
                         self.run(kind, dummy)
                         self._compile_hist.observe(time.perf_counter() - t0)

@@ -60,11 +60,17 @@ def make_handler(server: Server):
         def log_message(self, fmt, *args):  # quiet: telemetry covers it
             pass
 
-        def _reply(self, status: int, payload) -> None:
+        def _reply(self, status: int, payload,
+                   request_id: Optional[str] = None) -> None:
             body = json.dumps(payload).encode()
             self.send_response(status)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            if request_id is not None:
+                # The trace id (serve_request events, Perfetto lanes):
+                # a client report quoting this header pins the exact
+                # trace to pull up (docs/serving.md).
+                self.send_header("X-PBT-Request-Id", request_id)
             self.end_headers()
             self.wfile.write(body)
 
@@ -74,6 +80,10 @@ def make_handler(server: Server):
             elif self.path == "/metrics":
                 text = ""
                 if getattr(server.tele, "metrics", None) is not None:
+                    if server.slo:
+                        # Prune-at-scrape: an idle stream's burn rate
+                        # decays with its window instead of freezing.
+                        server.slo.refresh_gauges()
                     text = server.tele.metrics.prometheus_text()
                 body = text.encode()
                 self.send_response(200)
@@ -92,6 +102,7 @@ def make_handler(server: Server):
             if kind is None:
                 self._reply(404, {"error": f"no such route {self.path}"})
                 return
+            request_id = None
             try:
                 length = int(self.headers.get("Content-Length", 0))
                 if not 0 < length <= _MAX_BODY:
@@ -114,25 +125,33 @@ def make_handler(server: Server):
                     deadline_s=(deadline_ms / 1000.0
                                 if deadline_ms is not None else None),
                     top_k=top_k)
+                request_id = getattr(future, "pbt_request_id", None)
                 value = future.result()
             except QueueFullError as e:
-                self._reply(429, {"error": str(e), "type": "queue_full"})
+                self._reply(429, {"error": str(e), "type": "queue_full"},
+                            request_id)
             except DeadlineExceededError as e:
-                self._reply(504, {"error": str(e), "type": "deadline"})
+                self._reply(504, {"error": str(e), "type": "deadline"},
+                            request_id)
             except ServerClosedError as e:
-                self._reply(503, {"error": str(e), "type": "closed"})
+                # Rejected before a future existed: submit() stamps
+                # the trace id on the exception instead.
+                self._reply(503, {"error": str(e), "type": "closed"},
+                            getattr(e, "pbt_request_id", request_id))
             except SequenceTooLongError as e:
-                self._reply(400, {"error": str(e), "type": "too_long"})
+                self._reply(400, {"error": str(e), "type": "too_long"},
+                            getattr(e, "pbt_request_id", request_id))
             except (KeyError, ValueError, json.JSONDecodeError) as e:
                 self._reply(400, {"error": f"bad request: {e}",
-                                  "type": "bad_request"})
+                                  "type": "bad_request"}, request_id)
             except Exception as e:  # noqa: BLE001 — a dispatch-side
                 # failure lands on the future; a dropped connection
                 # would hide it from the client, so map it to a 500.
                 self._reply(500, {"error": f"internal error: {e}",
-                                  "type": "internal"})
+                                  "type": "internal"}, request_id)
             else:
-                self._reply(200, _result_payload(kind, value, top_k))
+                self._reply(200, _result_payload(kind, value, top_k),
+                            request_id)
 
     return Handler
 

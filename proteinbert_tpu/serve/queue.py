@@ -54,6 +54,8 @@ class Request:
     deadline: Optional[float] = None          # absolute clock value
     top_k: Optional[int] = None               # predict_go only
     cache_key: Optional[str] = None           # None = uncacheable/disabled
+    trace: Optional[object] = None            # serve/trace.RequestTrace
+                                              # (None = telemetry off)
 
 
 class RequestQueue:

@@ -20,6 +20,15 @@ with a fake clock instead of sleeping.
 A dispatch failure (OOM, a bug in a jitted fn) fails THAT batch's
 futures and keeps the scheduler alive for later batches; the error is
 also recorded as a `note` on the telemetry stream.
+
+Observability (ISSUE 6): every request's QUEUE WAIT (push → popped for
+dispatch) lands in the `serve_queue_wait_seconds` histogram plus a
+local mirror for `Server.stats()` — cheap, and recorded even when
+request tracing is sampled out. Requests that carry a `RequestTrace`
+additionally get per-stage clock marks (ingest / pop / execute) and a
+terminal `complete_observer` callback (outcome ∈ ok/error/expired) the
+Server uses to seal the trace, emit the `serve_request` event, and
+feed the SLO evaluator. All marks use the injected clock.
 """
 
 from __future__ import annotations
@@ -32,6 +41,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from proteinbert_tpu.obs.metrics import Histogram
 from proteinbert_tpu.serve.errors import DeadlineExceededError
 from proteinbert_tpu.serve.queue import Request, RequestQueue
 
@@ -52,6 +62,9 @@ class MicroBatchScheduler:
         telemetry=None,
         latency_observer: Optional[Callable[[float], None]] = None,
         expire_observer: Optional[Callable[[Request], None]] = None,
+        complete_observer: Optional[
+            Callable[[Request, str, float, Optional[BaseException],
+                      Optional[dict]], None]] = None,
     ):
         from proteinbert_tpu.obs import as_telemetry
 
@@ -67,6 +80,11 @@ class MicroBatchScheduler:
         # Server counts these under rejected{reason=deadline} so
         # /metrics, stats(), and --max-requests accounting see them.
         self._on_expire = expire_observer or (lambda req: None)
+        # Called once per terminal request the scheduler decides
+        # (outcome "ok" | "error" | "expired", with the clock's now, the
+        # error if any, and batch context) — the trace/SLO hook.
+        self._on_complete = complete_observer or (
+            lambda req, outcome, now, err, ctx: None)
         self._pending: "collections.OrderedDict[GroupKey, collections.deque]" \
             = collections.OrderedDict()
         # Guards _pending: normally scheduler-thread-private, but
@@ -82,6 +100,19 @@ class MicroBatchScheduler:
         self._occupancy_g = self.tele.metrics.gauge("serve_batch_occupancy")
         self._rows_h = self.tele.metrics.histogram("serve_batch_rows")
         self._batch_h = self.tele.metrics.histogram("serve_batch_seconds")
+        self._qwait_h = self.tele.metrics.histogram(
+            "serve_queue_wait_seconds")
+        # Live mirror for Server.stats(): the registry instrument is a
+        # shared no-op under NULL telemetry, but stats() must report
+        # real queue-wait numbers regardless (same rule as the
+        # Server's rejection-count mirrors).
+        self.queue_wait = Histogram()
+        # Timed dispatch (run_timed: prep/device split + pad scan) costs
+        # an O(rows*L) token scan per batch, so it runs only when
+        # something consumes the result: a sampled rider in the batch,
+        # or this flag (the Server sets it when SLO attribution needs
+        # pad_fraction for every request).
+        self.time_batches = False
 
     # -------------------------------------------------------- formation
 
@@ -89,17 +120,24 @@ class MicroBatchScheduler:
         with self._pending_lock:
             return sum(len(d) for d in self._pending.values())
 
-    def _ingest(self) -> None:
+    def _ingest(self, now: float) -> None:
         items = self.queue.pop_all()
         if not items:
             return
         with self._pending_lock:
             for req in items:
+                if req.trace is not None:
+                    req.trace.mark_ingested(now)
                 key = (req.kind, req.bucket_len)
                 group = self._pending.get(key)
                 if group is None:
                     group = self._pending[key] = collections.deque()
                 group.append(req)
+
+    def _observe_wait(self, req: Request, now: float) -> None:
+        wait = max(0.0, now - req.enqueued_at)
+        self._qwait_h.observe(wait)
+        self.queue_wait.observe(wait)
 
     def _expire_pending(self, now: float) -> None:
         expired: List[Request] = []
@@ -116,14 +154,22 @@ class MicroBatchScheduler:
                     self._pending[key] = keep
                 else:
                     del self._pending[key]
+        if not expired:
+            return
+        # Depth at rejection time: what is still ahead of a new arrival
+        # (queued + formed-but-undispatched), AFTER dropping the
+        # expired rows themselves.
+        depth = self.pending_rows() + len(self.queue)
         for req in expired:
             self.expired_total += 1
+            self._observe_wait(req, now)
             req.future.set_exception(DeadlineExceededError(
                 f"deadline passed after "
                 f"{now - req.enqueued_at:.3f}s waiting for a batch"))
             self.tele.emit("serve_reject", reason="deadline",
-                           kind=req.kind)
+                           kind=req.kind, queue_depth=depth)
             self._on_expire(req)
+            self._on_complete(req, "expired", now, None, None)
 
     def _select_group(self, now: float) -> Optional[GroupKey]:
         """Dispatch decision: a full group first (fullest wins, ties to
@@ -159,25 +205,55 @@ class MicroBatchScheduler:
                                                        len(group)))]
             if not group:
                 del self._pending[key]
+        cls = self.dispatcher.batch_class(len(batch))
+        tracing = False
+        timed = self.time_batches
+        for req in batch:
+            self._observe_wait(req, now)
+            if req.trace is not None:
+                tracing = True
+                if req.trace.sampled:
+                    timed = True
+                req.trace.mark_popped(now)
         tokens = np.stack([r.tokens for r in batch])
         num_ann = self.dispatcher.cfg.model.num_annotations
         annotations = np.stack([
             r.annotations if r.annotations is not None
             else np.zeros(num_ann, np.float32)
             for r in batch])
+        ctx = {"rows": len(batch), "batch_class": cls,
+               "bucket_len": bucket_len}
         t0 = time.perf_counter()
+        run0 = self.clock()
         try:
-            result = self.dispatcher.run(kind, tokens, annotations)
+            # run_timed (BucketDispatcher) splits prep (pad/place) from
+            # device execute and reports the padded grid's pad
+            # fraction; plain run() keeps stub dispatchers working.
+            run_timed = (getattr(self.dispatcher, "run_timed", None)
+                         if tracing and timed else None)
+            if run_timed is not None:
+                result, timings = run_timed(kind, tokens, annotations)
+                ctx.update(timings)
+            else:
+                result = self.dispatcher.run(kind, tokens, annotations)
         except Exception as e:  # fail THIS batch, keep serving
             logger.exception("batch dispatch failed (%s, L=%d, rows=%d)",
                              kind, bucket_len, len(batch))
             self.tele.emit("note", source="serve", error=str(e),
                            kind=kind, bucket_len=bucket_len)
+            fail_t = self.clock()
             for req in batch:
+                if req.trace is not None:
+                    req.trace.mark_run(run0, fail_t)
+                    req.trace.mark_batch(
+                        bucket_len, cls, len(batch),
+                        pad_fraction=ctx.get("pad_fraction"))
                 if not req.future.done():
                     req.future.set_exception(e)
+                self._on_complete(req, "error", fail_t, e, ctx)
             return len(batch)
         dt = time.perf_counter() - t0
+        run1 = self.clock()
         self._batch_h.observe(dt)
         done_t = self.clock()
         for i, req in enumerate(batch):
@@ -185,20 +261,30 @@ class MicroBatchScheduler:
                 row = {k: v[i] for k, v in result.items()}
             else:
                 row = result[i]
+            outcome, err = "ok", None
             try:
                 self.finalize(req, row)
             except Exception as e:
+                outcome, err = "error", e
                 if not req.future.done():
                     req.future.set_exception(e)
             self._latency(done_t - req.enqueued_at)
+            if req.trace is not None:
+                req.trace.mark_run(run0, run1)
+                req.trace.mark_batch(
+                    bucket_len, cls, len(batch),
+                    pad_fraction=ctx.get("pad_fraction"),
+                    prep_s=ctx.get("prep_s"),
+                    device_s=ctx.get("device_s"))
+            self._on_complete(req, outcome, self.clock(), err, ctx)
         self.batches_total += 1
         self.rows_total += len(batch)
-        cls = self.dispatcher.batch_class(len(batch))
         self._occupancy_g.set(len(batch) / cls)
         self._rows_h.observe(len(batch))
         self.tele.emit("serve_batch", kind=kind, bucket_len=bucket_len,
                        rows=len(batch), batch_class=cls,
-                       batch_seconds=round(dt, 6))
+                       batch_seconds=round(dt, 6),
+                       pad_fraction=ctx.get("pad_fraction"))
         return len(batch)
 
     def poll(self, now: Optional[float] = None) -> int:
@@ -207,7 +293,7 @@ class MicroBatchScheduler:
         given queue contents and `now` — the fake-clock test entry."""
         if now is None:
             now = self.clock()
-        self._ingest()
+        self._ingest(now)
         self._expire_pending(now)
         key = self._select_group(now)
         if key is None:
@@ -254,18 +340,20 @@ class MicroBatchScheduler:
         self._stopped.set()
         self.queue.close()
 
-    def fail_pending(self, exc: Exception) -> int:
-        """Abort path: fail every not-yet-dispatched request. Safe
-        against a scheduler thread that outlived its join timeout (a
-        long jitted call): extraction holds the pending lock, so the
-        thread either sees an empty map or had already popped its batch."""
+    def fail_pending(self, exc: Exception) -> List[Request]:
+        """Abort path: fail every not-yet-dispatched request; returns
+        the requests that were failed (the Server seals their traces).
+        Safe against a scheduler thread that outlived its join timeout
+        (a long jitted call): extraction holds the pending lock, so the
+        thread either sees an empty map or had already popped its
+        batch."""
         with self._pending_lock:
             reqs = [req for group in self._pending.values()
                     for req in group]
             self._pending.clear()
-        n = 0
+        failed = []
         for req in reqs:
             if not req.future.done():
                 req.future.set_exception(exc)
-                n += 1
-        return n
+                failed.append(req)
+        return failed

@@ -29,19 +29,35 @@ train/resilience.GracefulShutdown:
   telemetry stream and the flight recorder dumps (forensics for the
   requests that were killed); emits `serve_end{outcome=aborted}`.
 
+Request tracing + SLOs (ISSUE 6): with telemetry enabled, every
+request carries a `serve/trace.RequestTrace` that collects one clock
+mark per stage boundary (submit → queue → batch_form → dispatch →
+execute → finalize). Traces SAMPLED at `trace_sample_rate` — plus ALL
+requests that end in an error or rejection, regardless of sampling —
+emit a `serve_request` event and, when the telemetry carries a span
+collector, Perfetto spans on a per-request lane. Every request's
+outcome also feeds the optional `obs/slo.SLOEvaluator` (declarative
+latency/error-rate objectives; burn rates on `/metrics`,
+`stats()["slo"]`, and `pbt diagnose --serve`; breach → optional
+on-demand device profile). With the NULL facade no trace objects are
+created and every touchpoint is a None check — the served path costs
+what it did before tracing existed.
+
 Telemetry (all optional, NULL-facade free when absent —
 docs/observability.md): `serve_start`/`serve_batch`/`serve_reject`/
-`serve_end` events; `serve_queue_depth`, `serve_batch_occupancy`,
-`serve_latency_p50_s`/`p99_s`, `serve_cache_hit_rate` gauges;
+`serve_request`/`slo_breach`/`serve_end` events; `serve_queue_depth`,
+`serve_batch_occupancy`, `serve_cache_hit_rate`,
+`slo_burn_rate{objective=}` gauges; the `serve_latency` quantile
+window (`serve_latency_p50_s`/`p99_s` at scrape time);
 `serve_requests_total{kind=}`, `serve_rejected_total{reason=}`,
 `serve_truncated_total`, `serve_cache_*_total` counters;
-`serve_latency_seconds`, `serve_batch_seconds`, `serve_batch_rows`
-histograms.
+`serve_latency_seconds`, `serve_queue_wait_seconds`,
+`serve_batch_seconds`, `serve_batch_rows` histograms.
 """
 
 from __future__ import annotations
 
-import collections
+import itertools
 import os
 import threading
 import time
@@ -59,41 +75,7 @@ from proteinbert_tpu.serve.errors import (
 )
 from proteinbert_tpu.serve.queue import Request, RequestQueue
 from proteinbert_tpu.serve.scheduler import MicroBatchScheduler
-
-
-class _LatencyWindow:
-    """Bounded ring of recent request latencies with percentile reads —
-    the p50/p99 the metrics registry's streaming histograms cannot
-    provide (they keep count/sum/min/max only)."""
-
-    def __init__(self, capacity: int = 2048):
-        self._ring: "collections.deque[float]" = collections.deque(
-            maxlen=capacity)
-        self._lock = threading.Lock()
-
-    def observe(self, seconds: float) -> None:
-        with self._lock:
-            self._ring.append(float(seconds))
-
-    def percentile(self, q: float) -> Optional[float]:
-        with self._lock:
-            if not self._ring:
-                return None
-            data = sorted(self._ring)
-        idx = min(len(data) - 1, max(0, int(round(q / 100.0
-                                                  * (len(data) - 1)))))
-        return data[idx]
-
-    def summary(self) -> Dict[str, Optional[float]]:
-        with self._lock:
-            if not self._ring:
-                return {"n": 0, "p50_s": None, "p99_s": None, "mean_s": None}
-            data = sorted(self._ring)
-        pick = lambda q: data[min(len(data) - 1,                  # noqa: E731
-                                  int(round(q * (len(data) - 1))))]
-        return {"n": len(data), "p50_s": round(pick(0.50), 6),
-                "p99_s": round(pick(0.99), 6),
-                "mean_s": round(sum(data) / len(data), 6)}
+from proteinbert_tpu.serve.trace import RequestTrace, stride_sampled
 
 
 class Server:
@@ -116,6 +98,10 @@ class Server:
         clock=time.monotonic,
         warm_kinds=("embed",),
         batch_classes=None,
+        trace_sample_rate: Optional[float] = 1.0,
+        slos=None,
+        slo_profile_dir: Optional[str] = None,
+        slo_breach_cooldown_s: float = 60.0,
     ):
         from proteinbert_tpu.obs import as_telemetry
 
@@ -137,15 +123,53 @@ class Server:
             self.queue, self.dispatcher, self._finalize,
             max_batch=max_batch, max_wait_s=max_wait_s, clock=clock,
             telemetry=telemetry, latency_observer=self._observe_latency,
-            expire_observer=self._count_expiry)
-        self.latencies = _LatencyWindow()
-        self._latency_n = 0
+            expire_observer=self._count_expiry,
+            complete_observer=self._on_complete)
+        # The p50/p99 ring lives in the obs registry (QuantileWindow):
+        # /metrics scrapes, stats(), and serve_request events all read
+        # the same ring. A disabled registry (NULL telemetry) returns a
+        # live unregistered window so stats() still reports real numbers.
+        self.latencies = metrics.quantile_window("serve_latency")
+        # Request tracing: None disables trace objects entirely; a rate
+        # in [0, 1] traces every request cheaply and EMITS the sampled
+        # fraction (errors/rejections always emit). NULL telemetry also
+        # disables: there is nowhere to emit to.
+        if trace_sample_rate is not None and not self.tele.enabled:
+            trace_sample_rate = None
+        self.trace_sample_rate = trace_sample_rate
+        self._req_ids = itertools.count(1)
+        self._id_prefix = f"{os.getpid():x}-"
+        self.slo = None
+        self.profile_trigger = None
+        if slos:
+            from proteinbert_tpu.obs.slo import ProfileTrigger, SLOEvaluator
+
+            on_breach = None
+            if slo_profile_dir:
+                self.profile_trigger = ProfileTrigger(slo_profile_dir,
+                                                      clock=clock)
+                on_breach = self.profile_trigger
+            self.slo = SLOEvaluator(
+                slos, metrics=metrics, telemetry=self.tele, clock=clock,
+                on_breach=on_breach,
+                breach_cooldown_s=slo_breach_cooldown_s)
+            stage_objs = [o.name for o in self.slo.objectives
+                          if o.kind == "latency" and o.stage != "e2e"]
+            if stage_objs and self.trace_sample_rate is None:
+                raise ValueError(
+                    f"stage-scoped slo objective(s) {stage_objs} need "
+                    "request tracing for per-stage durations, but "
+                    "tracing is off (telemetry disabled or "
+                    "trace_sample_rate=None) — they would never "
+                    "observe anything")
+            # SLO violation attribution consumes pad/prep/device per
+            # request, so every batch must be timed, not just sampled
+            # riders' batches.
+            self.scheduler.time_batches = True
         self._warm_kinds = tuple(warm_kinds)
         self._started = False
         self._ended = False
         self._depth_g = metrics.gauge("serve_queue_depth")
-        self._p50_g = metrics.gauge("serve_latency_p50_s")
-        self._p99_g = metrics.gauge("serve_latency_p99_s")
         self._latency_h = metrics.histogram("serve_latency_seconds")
         self._truncated_c = metrics.counter("serve_truncated_total")
         self._req_c = {k: metrics.counter("serve_requests_total", kind=k)
@@ -188,6 +212,9 @@ class Server:
             "cache_size": self.cache.capacity,
             "on_long": self.on_long,
             "warmed_executables": warmed,
+            "trace_sample_rate": self.trace_sample_rate,
+            "slos": ([o.name for o in self.slo.objectives]
+                     if self.slo else []),
             "mesh": (dict(self.dispatcher.mesh.shape)
                      if self.dispatcher.mesh is not None else None),
         })
@@ -221,9 +248,16 @@ class Server:
         call cannot be interrupted); their futures resolve normally."""
         self.scheduler.stop()
         exc = ServerClosedError("server aborted before this request ran")
-        n = len(self.queue.fail_all(exc))
+        failed = self.queue.fail_all(exc)
         self.scheduler.join(timeout=30.0)
-        n += self.scheduler.fail_pending(exc)
+        failed += self.scheduler.fail_pending(exc)
+        now = self.clock()
+        for req in failed:
+            # Killed requests close their traces too — an abort must
+            # not orphan spans (tests/test_serve_trace.py).
+            self._seal(req.trace, "aborted", now, error=exc,
+                       e2e_fallback=max(0.0, now - req.enqueued_at))
+        n = len(failed)
         if not self._ended:
             self._ended = True
             self.tele.emit("note", source="serve", kind="abort",
@@ -244,7 +278,8 @@ class Server:
     def submit(self, kind: str, seq: str, annotations=None,
                deadline_s: Optional[float] = None,
                top_k: Optional[int] = None) -> Future:
-        """Enqueue one request; returns its future. Raises
+        """Enqueue one request; returns its future (which carries the
+        trace id as `.pbt_request_id` when tracing is on). Raises
         SequenceTooLongError (on_long="reject", or a '?' beyond the
         window for predict_residues) and ServerClosedError
         synchronously; QueueFullError / DeadlineExceededError land on
@@ -254,6 +289,13 @@ class Server:
             raise ValueError(f"unknown request kind {kind!r}; have {KINDS}")
         if not seq:
             raise ValueError("empty sequence")
+        now0 = self.clock()
+        trace = None
+        if self.trace_sample_rate is not None:
+            n = next(self._req_ids)
+            trace = RequestTrace(
+                f"{self._id_prefix}{n:x}", kind, now0,
+                sampled=stride_sampled(n, self.trace_sample_rate))
         window = self.cfg.data.seq_len - 2
         if len(seq) > window:
             if (self.on_long == "reject"
@@ -261,14 +303,22 @@ class Server:
                         and inference.MASK_CHAR in seq[window:])):
                 self._rej_c["too_long"].inc()
                 self._bump("rejected_total", "too_long")
-                self.tele.emit("serve_reject", reason="too_long", kind=kind)
-                raise SequenceTooLongError(
+                self.tele.emit("serve_reject", reason="too_long",
+                               kind=kind, queue_depth=len(self.queue))
+                self._seal(trace, "rejected", self.clock())
+                exc = SequenceTooLongError(
                     f"sequence of {len(seq)} residues exceeds the model "
                     f"window of {window}"
                     + (" (and masks a position the model would never "
                        "see)" if kind == "predict_residues" else
                        "; the server is configured to reject rather "
                        "than truncate"))
+                if trace is not None:
+                    # Synchronous rejections carry the trace id on the
+                    # exception: the HTTP layer still answers with an
+                    # X-PBT-Request-Id pinning the rejection's trace.
+                    exc.pbt_request_id = trace.request_id
+                raise exc
             # The process-wide inference.TRUNCATED_TOTAL is bumped by
             # _tokenize_masked below (cache hits skip tokenization and
             # so don't count there); these are the serving-side counts.
@@ -279,13 +329,20 @@ class Server:
                 np.asarray(annotations, np.float32)[None], 1, self.cfg)[0]
         self._req_c[kind].inc()
         future: Future = Future()
+        if trace is not None:
+            future.pbt_request_id = trace.request_id
         key = None
         if self.cache.capacity:
+            if trace is not None:
+                trace.cache = "miss"
             key = content_key(kind, seq, annotations)
             hit = self.cache.get(key)
             if hit is not None:
                 self._bump("cache_hit_returns")
+                if trace is not None:
+                    trace.cache = "hit"
                 future.set_result(self._present(kind, hit, top_k))
+                self._seal(trace, "cache_hit", self.clock())
                 return future
         bucket_len = self.dispatcher.bucket_len(len(seq))
         tokens = inference._tokenize_masked(
@@ -293,22 +350,34 @@ class Server:
         now = self.clock()
         if deadline_s is None:
             deadline_s = self.default_deadline_s
+        if trace is not None:
+            trace.mark_enqueued(now)
         req = Request(
             kind=kind, seq=seq, tokens=tokens, bucket_len=bucket_len,
             future=future, enqueued_at=now, annotations=annotations,
             deadline=(now + deadline_s if deadline_s is not None else None),
-            top_k=top_k, cache_key=key)
+            top_k=top_k, cache_key=key, trace=trace)
         try:
             evicted = self.queue.push(req)
-        except ServerClosedError:
+        except ServerClosedError as exc:
             self._rej_c["closed"].inc()
             self._bump("rejected_total", "closed")
-            self.tele.emit("serve_reject", reason="closed", kind=kind)
+            self.tele.emit("serve_reject", reason="closed", kind=kind,
+                           queue_depth=len(self.queue))
+            self._seal(trace, "rejected", self.clock())
+            if trace is not None:
+                exc.pbt_request_id = trace.request_id
             raise
-        for _ in evicted:
-            self._rej_c["queue_full"].inc()
-            self._bump("rejected_total", "queue_full")
-            self.tele.emit("serve_reject", reason="queue_full")
+        if evicted:
+            now2 = self.clock()
+            for old in evicted:
+                self._rej_c["queue_full"].inc()
+                self._bump("rejected_total", "queue_full")
+                self.tele.emit("serve_reject", reason="queue_full",
+                               kind=old.kind,
+                               queue_depth=self.queue.max_depth)
+                self._seal(old.trace, "evicted", now2,
+                           e2e_fallback=max(0.0, now2 - old.enqueued_at))
         self._depth_g.set(len(self.queue))
         return future
 
@@ -377,19 +446,57 @@ class Server:
         self._bump("rejected_total", "deadline")
 
     def _observe_latency(self, seconds: float) -> None:
+        """Scheduler callback per successfully batched row: one ring
+        (the registry QuantileWindow) serves stats(), /metrics, and the
+        percentile gauges — computed at read time, no refresh cadence
+        to drift."""
         self.latencies.observe(seconds)
         self._latency_h.observe(seconds)
-        # Percentiles sort the whole ring; doing that per request would
-        # serialize O(n log n) work onto the scheduler thread between
-        # batches. Refresh the gauges once per max_batch completions —
-        # stats()/healthz always recompute fresh.
-        self._latency_n += 1
-        if self._latency_n % self.scheduler.max_batch and self._latency_n != 1:
-            return
-        s = self.latencies.summary()
-        if s["p50_s"] is not None:
-            self._p50_g.set(s["p50_s"])
-            self._p99_g.set(s["p99_s"])
+
+    def _on_complete(self, req: Request, outcome: str, now: float,
+                     error: Optional[BaseException],
+                     ctx: Optional[dict]) -> None:
+        """Scheduler callback per terminal request (ok/error/expired):
+        seal the trace, emit, feed the SLO evaluator."""
+        self._seal(req.trace, outcome, now, error=error,
+                   e2e_fallback=max(0.0, now - req.enqueued_at))
+
+    def _seal(self, trace: Optional[RequestTrace], outcome: str,
+              now: float, error: Optional[BaseException] = None,
+              e2e_fallback: float = 0.0) -> None:
+        """The single terminal funnel: every request reaches this
+        exactly once per outcome path. Emits the serve_request event +
+        spans for sampled or failed requests; feeds every completion
+        (traced or not) to the SLO evaluator."""
+        stages = None
+        e2e = e2e_fallback
+        rid = None
+        if trace is not None:
+            if not trace.finish(outcome, now, error):
+                return  # already sealed by an earlier outcome path
+            e2e = trace.e2e_s()
+            rid = trace.request_id
+            emit = trace.sampled or outcome not in ("ok", "cache_hit")
+            if emit or self.slo:
+                # Stage decomposition only when something consumes it:
+                # a sampled-out request with no SLOs pays marks, not
+                # dict-building (the <1%-of-latency contract).
+                stages = trace.stages()
+            if emit:
+                self.tele.emit("serve_request",
+                               **trace.event_fields(stages=stages))
+                if self.tele.spans is not None:
+                    trace.export_spans(self.tele.spans)
+        if self.slo:
+            if stages is not None and trace.pad_fraction \
+                    and "execute" in stages:
+                # Synthetic attribution stage: the share of device time
+                # spent computing padding — the ragged-serving lever.
+                stages = dict(stages)
+                stages["pad_wasted"] = round(
+                    stages["execute"] * trace.pad_fraction, 9)
+            self.slo.observe(outcome, e2e, stages=stages,
+                             request_id=rid, now=now)
 
     # ------------------------------------------------------------- stats
 
@@ -400,7 +507,8 @@ class Server:
                 "truncated": self.truncated_total,
                 "rejected": dict(self.rejected_total),
             }
-        return {
+        qw = self.scheduler.queue_wait
+        out = {
             "completed": self.completed_total,
             **mirrors,
             "batches": self.scheduler.batches_total,
@@ -410,4 +518,13 @@ class Server:
             "expired": self.scheduler.expired_total,
             "cache": self.cache.stats(),
             "latency": self.latencies.summary(),
+            "queue_wait": {
+                "count": qw.count,
+                "mean_s": (round(qw.total / qw.count, 6)
+                           if qw.count else None),
+                "max_s": (round(qw.max, 6) if qw.count else None),
+            },
         }
+        if self.slo:
+            out["slo"] = self.slo.status()
+        return out

@@ -1,0 +1,337 @@
+"""SLO layer (proteinbert_tpu/obs/slo.py, ISSUE 6): declarative
+objective parsing, fake-clock burn-rate math, exemplar histograms,
+breach actions, and the on-demand profile trigger.
+
+Everything here runs against an injected fake clock — burn rates are
+exact arithmetic over a deterministic window, never wall-clock."""
+
+import threading
+import time
+
+import pytest
+
+from proteinbert_tpu.obs import MetricsRegistry
+from proteinbert_tpu.obs.slo import (
+    ExemplarHistogram, ProfileTrigger, SLObjective, SLOEvaluator,
+    parse_slo, parse_slos,
+)
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> float:
+        self.t += dt
+        return self.t
+
+
+# ------------------------------------------------------------- parsing
+
+class TestParseSLO:
+    def test_cli_string_full(self):
+        o = parse_slo("kind=latency,threshold_ms=250,target=0.99,"
+                      "window_s=300")
+        assert o.kind == "latency"
+        assert o.threshold_s == pytest.approx(0.25)
+        assert o.target == 0.99
+        assert o.window_s == 300.0
+        assert o.stage == "e2e"
+        assert o.name == "latency_e2e"
+        assert o.budget == pytest.approx(0.01)
+
+    def test_percent_target_and_stage(self):
+        o = parse_slo("kind=latency,stage=execute,threshold_ms=50,"
+                      "target=99.9%")
+        assert o.target == pytest.approx(0.999)
+        assert o.stage == "execute"
+        assert o.name == "latency_execute"
+
+    def test_error_rate_from_dict(self):
+        o = parse_slo({"kind": "error_rate", "target": 0.999,
+                       "bad_outcomes": "error|expired|evicted"})
+        assert o.kind == "error_rate"
+        assert o.bad_outcomes == ("error", "expired", "evicted")
+        assert o.name == "error_rate"
+
+    def test_stage_names_match_request_trace(self):
+        """VALID_STAGES must track serve/trace.STAGES: a drift would
+        let parse_slo accept a stage the tracer never produces."""
+        from proteinbert_tpu.obs.slo import VALID_STAGES
+        from proteinbert_tpu.serve.trace import STAGES
+
+        assert set(STAGES) < set(VALID_STAGES)
+        assert set(VALID_STAGES) - set(STAGES) == {"e2e", "pad_wasted"}
+
+    def test_unknown_stage_rejected_at_parse(self):
+        with pytest.raises(ValueError, match="unknown stage"):
+            parse_slo("kind=latency,stage=exeucte,threshold_ms=50")
+
+    def test_rejects_unknown_key_bad_kind_and_double_threshold(self):
+        with pytest.raises(ValueError, match="unknown slo spec key"):
+            parse_slo("kind=latency,threshold_ms=1,bogus=1")
+        with pytest.raises(ValueError, match="kind must be one of"):
+            parse_slo("kind=throughput")
+        with pytest.raises(ValueError, match="not both"):
+            parse_slo("kind=latency,threshold_s=1,threshold_ms=1000")
+        with pytest.raises(ValueError, match="needs threshold_s"):
+            parse_slo("kind=latency")
+        with pytest.raises(ValueError, match="no error budget"):
+            SLObjective(name="x", kind="latency", target=1.0,
+                        threshold_s=1.0)
+
+    def test_duplicate_names_rejected(self):
+        with pytest.raises(ValueError, match="duplicate slo objective"):
+            parse_slos(["kind=latency,threshold_ms=1",
+                        "kind=latency,threshold_ms=2"])
+
+
+# ----------------------------------------------------- burn-rate math
+
+class TestBurnRate:
+    def _eval(self, spec="kind=latency,threshold_s=0.1,target=0.9,"
+                         "window_s=10", **kw):
+        clock = FakeClock()
+        return SLOEvaluator([spec], clock=clock, **kw), clock
+
+    def test_burn_is_bad_fraction_over_budget(self):
+        """target 0.9 → budget 0.1. 1 bad in 10 → bad_fraction 0.1 →
+        burn exactly 1.0; 2 bad in 10 → 2.0."""
+        ev, clock = self._eval()
+        for i in range(9):
+            ev.observe("ok", 0.01, now=clock.advance(0.1))
+        ev.observe("ok", 0.5, now=clock.advance(0.1))  # 1 violation
+        assert ev.burn_rate("latency_e2e", now=clock.t) \
+            == pytest.approx(1.0)
+        ev.observe("ok", 0.5, now=clock.advance(0.1))  # 2 of 11
+        assert ev.burn_rate("latency_e2e", now=clock.t) \
+            == pytest.approx((2 / 11) / 0.1)
+
+    def test_window_prunes_old_observations(self):
+        ev, clock = self._eval()
+        ev.observe("ok", 0.5, now=clock.t)       # violation at t=1000
+        assert ev.burn_rate("latency_e2e", now=clock.t) \
+            == pytest.approx(10.0)               # 1/1 bad / 0.1 budget
+        clock.advance(9.0)
+        ev.observe("ok", 0.01, now=clock.t)      # good at t=1009
+        assert ev.burn_rate("latency_e2e", now=clock.t) \
+            == pytest.approx(5.0)                # 1/2 / 0.1
+        clock.advance(1.5)                       # violation now >10s old
+        assert ev.burn_rate("latency_e2e", now=clock.t) \
+            == pytest.approx(0.0)
+
+    def test_empty_window_burns_zero(self):
+        ev, clock = self._eval()
+        assert ev.burn_rate("latency_e2e", now=clock.t) == 0.0
+        assert not ev._states["latency_e2e"].window
+
+    def test_stage_objective_reads_stages_dict(self):
+        ev, clock = self._eval(spec="kind=latency,stage=execute,"
+                                    "threshold_s=0.05,target=0.9,"
+                                    "window_s=10")
+        # e2e is slow but execute is fast: not a violation for the
+        # stage-scoped objective…
+        ev.observe("ok", 0.5, stages={"queue": 0.46, "execute": 0.04},
+                   now=clock.advance(0.1))
+        assert ev.burn_rate("latency_execute", now=clock.t) == 0.0
+        # …and vice versa.
+        ev.observe("ok", 0.5, stages={"queue": 0.01, "execute": 0.49},
+                   now=clock.advance(0.1))
+        assert ev.burn_rate("latency_execute", now=clock.t) \
+            == pytest.approx(5.0)
+        # No stage measurement (tracing off / never reached the stage):
+        # the observation is SKIPPED, never judged against e2e.
+        ev.observe("ok", 9.9, stages=None, now=clock.advance(0.1))
+        ev.observe("ok", 9.9, stages={"queue": 9.9},
+                   now=clock.advance(0.1))
+        assert ev.status(now=clock.t)["latency_execute"]["total"] == 2
+
+    def test_error_rate_objective_and_admission_exclusion(self):
+        ev, clock = self._eval(spec="kind=error_rate,target=0.9,"
+                                    "window_s=10")
+        for outcome in ("ok", "ok", "cache_hit", "error"):
+            ev.observe(outcome, 0.01, now=clock.advance(0.1))
+        # Latency objectives ignore admission control, error_rate
+        # counts what its bad_outcomes say: error in 4 observed.
+        assert ev.burn_rate("error_rate", now=clock.t) \
+            == pytest.approx((1 / 4) / 0.1)
+        # Rejections/evictions are load shedding: they enter the window
+        # as good unless configured bad.
+        ev.observe("rejected", 0.0, now=clock.advance(0.1))
+        assert ev._states["error_rate"].bad == 1
+
+    def test_burn_gauge_surfaces_on_registry(self):
+        reg = MetricsRegistry()
+        clock = FakeClock()
+        ev = SLOEvaluator(["kind=latency,threshold_s=0.1,target=0.9,"
+                           "window_s=10"], metrics=reg, clock=clock)
+        ev.observe("ok", 0.5, now=clock.t)
+        snap = reg.snapshot()
+        assert snap["gauges"]['slo_burn_rate{objective="latency_e2e"}'] \
+            == pytest.approx(10.0)
+        assert 'slo_burn_rate{objective="latency_e2e"}' \
+            in reg.prometheus_text(prefix="")
+        # Idle decay: once the window empties, a scrape-time refresh
+        # (refresh_gauges / status) pulls the gauge back to 0 — it
+        # must not freeze at the last observed burn.
+        clock.advance(11.0)
+        ev.refresh_gauges(now=clock.t)
+        snap = reg.snapshot()
+        assert snap["gauges"]['slo_burn_rate{objective="latency_e2e"}'] \
+            == 0.0
+
+    def test_attribution_accumulates_violators_only(self):
+        ev, clock = self._eval()
+        ev.observe("ok", 0.01, stages={"queue": 0.005, "execute": 0.005},
+                   now=clock.advance(0.1))
+        ev.observe("ok", 0.5, stages={"queue": 0.4, "execute": 0.1},
+                   now=clock.advance(0.1))
+        ev.observe("ok", 0.6, stages={"queue": 0.55, "execute": 0.05},
+                   now=clock.advance(0.1))
+        st = ev.status(now=clock.t)["latency_e2e"]
+        # Only the two violating requests contribute: the good
+        # request's 5ms never shows.
+        assert st["attribution"]["queue"] == pytest.approx(0.95)
+        assert st["attribution"]["execute"] == pytest.approx(0.15)
+
+
+# ------------------------------------------------- breaches + actions
+
+class TestBreach:
+    def test_breach_fires_once_per_cooldown_and_emits(self):
+        hits = []
+
+        class Tele:
+            spans = None
+            emitted = []
+
+            def emit(self, event, **fields):
+                self.emitted.append((event, fields))
+
+        clock = FakeClock()
+        ev = SLOEvaluator(
+            ["kind=latency,threshold_s=0.1,target=0.9,window_s=100"],
+            clock=clock, telemetry=Tele(),
+            on_breach=lambda name, st: hits.append((name, st)),
+            breach_cooldown_s=60.0)
+        for _ in range(5):          # burn 10x: breach on first observe
+            ev.observe("ok", 0.5, now=clock.advance(1.0))
+        assert len(hits) == 1       # cooldown holds the rest back
+        name, status = hits[0]
+        assert name == "latency_e2e"
+        assert status["breached"] and status["burn_rate"] > 1.0
+        clock.advance(61.0)
+        ev.observe("ok", 0.5, now=clock.t)
+        assert len(hits) == 2
+        events = [e for e, _ in Tele.emitted]
+        assert events.count("slo_breach") == 2
+        # The breach event round-trips the schema validator.
+        from proteinbert_tpu.obs.events import (
+            make_record, validate_record,
+        )
+        _, fields = Tele.emitted[0]
+        validate_record(make_record("slo_breach", seq=0, t=0.0, **fields))
+
+    def test_on_breach_exception_never_escapes(self):
+        clock = FakeClock()
+        ev = SLOEvaluator(
+            ["kind=latency,threshold_s=0.1,target=0.9,window_s=100"],
+            clock=clock, on_breach=lambda *a: 1 / 0)
+        ev.observe("ok", 0.5, now=clock.t)  # must not raise
+
+    def test_status_shape(self):
+        ev = SLOEvaluator(["kind=latency,threshold_ms=100"],
+                          clock=FakeClock())
+        st = ev.status()["latency_e2e"]
+        assert st["kind"] == "latency"
+        assert st["total"] == 0 and st["bad"] == 0
+        assert st["burn_rate"] == 0.0 and not st["breached"]
+        assert isinstance(st["histogram"], list)
+
+
+# -------------------------------------------------- exemplar histogram
+
+class TestExemplarHistogram:
+    def test_buckets_and_exemplars(self):
+        h = ExemplarHistogram(buckets=(0.01, 0.1, 1.0))
+        h.observe(0.005, "req-a", t=1.0)
+        h.observe(0.05, "req-b", t=2.0)
+        h.observe(0.06, "req-c", t=3.0)   # replaces req-b's slot
+        h.observe(50.0, "req-d", t=4.0)   # overflow bucket
+        snap = h.snapshot()
+        assert [b["le"] for b in snap] == [0.01, 0.1, 1.0, None]
+        assert [b["count"] for b in snap] == [1, 2, 0, 1]
+        assert snap[1]["exemplar"]["request_id"] == "req-c"
+        assert snap[3]["exemplar"]["request_id"] == "req-d"
+        assert snap[2]["exemplar"] is None
+
+    def test_needs_bounds(self):
+        with pytest.raises(ValueError):
+            ExemplarHistogram(buckets=())
+
+
+# --------------------------------------------------- profile trigger
+
+class TestProfileTrigger:
+    def test_capture_cooldown_and_single_flight(self):
+        calls = []
+        done = threading.Event()
+        trig = ProfileTrigger(
+            "/tmp/prof", duration_s=0.01, cooldown_s=300.0,
+            clock=FakeClock(),
+            start=lambda d: calls.append(("start", d)),
+            stop=lambda: (calls.append(("stop",)), done.set()))
+        trig("latency_e2e", {"burn_rate": 2.0})
+        trig("latency_e2e", {"burn_rate": 3.0})  # in flight: skipped
+        assert calls == [("start", "/tmp/prof")]
+        assert done.wait(5.0)
+        assert calls[-1] == ("stop",)
+        assert not trig._active
+        trig("latency_e2e", {"burn_rate": 2.0})  # cooldown: skipped
+        assert len(trig.captures) == 1
+        trig.clock.advance(301.0)
+        done.clear()
+        trig("latency_e2e", {"burn_rate": 2.0})
+        assert len(trig.captures) == 2
+        assert done.wait(5.0)
+
+    def test_start_failure_degrades(self):
+        def boom(d):
+            raise OSError("disk full")
+
+        trig = ProfileTrigger("/tmp/prof", clock=FakeClock(),
+                              start=boom, stop=lambda: None)
+        trig("latency_e2e", {"burn_rate": 2.0})  # must not raise
+        assert not trig._active and not trig.captures
+
+    def test_no_jax_no_capture(self, monkeypatch):
+        import sys
+
+        monkeypatch.delitem(sys.modules, "jax", raising=False)
+        trig = ProfileTrigger("/tmp/prof", clock=FakeClock())
+        trig("latency_e2e", {"burn_rate": 2.0})  # degrades to a no-op
+        assert not trig.captures
+
+
+# --------------------------------------------- fake-clock end-to-end
+
+def test_evaluator_threadsafe_under_concurrent_observe():
+    """Smoke: concurrent observers never corrupt the window counters
+    (the burn denominator must equal the number of observations)."""
+    ev = SLOEvaluator(["kind=latency,threshold_s=10,target=0.9,"
+                       "window_s=1e6"], clock=time.monotonic)
+
+    def feed():
+        for _ in range(200):
+            ev.observe("ok", 0.01)
+
+    threads = [threading.Thread(target=feed) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    st = ev.status()["latency_e2e"]
+    assert st["total"] == 800 and st["bad"] == 0

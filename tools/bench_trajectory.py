@@ -1,0 +1,269 @@
+#!/usr/bin/env python
+"""Perf-regression sentinel over the bench history (ISSUE 6 tentpole).
+
+The repo accumulates a performance trajectory nobody gates on: one
+`BENCH_rNN.json` per review round (headline residues/s/chip capture)
+and `bench_events.jsonl` (serve/pack sweep captures mirrored as `note`
+events). This tool parses that history, fits a robust per-metric
+baseline (median + MAD of the PRIOR points), and flags the newest
+point when it falls outside the noise band — so "PR N made serving 20%
+slower" is a machine-readable verdict, not an archaeology project.
+
+Noise policy (the zero-false-positive contract over the real history):
+
+- a series is judged only with >= MIN_HISTORY prior points — two
+  captures are an anecdote, not a baseline;
+- the band is max(K_SIGMA * 1.4826*MAD, REL_FLOOR * |median|): wide
+  when the history is genuinely noisy (CPU captures on shared CI boxes
+  swing 2-4x), floored at REL_FLOOR so a tight series still needs a
+  real move (>10%) to flag;
+- CPU and TPU captures are SEPARATE series (a platform change is not a
+  regression), as are `live_fallback` probes vs primary captures.
+
+Report-only by default: exit 0 with verdicts in the artifact; exit 2
+only on parse/schema errors in the inputs (the tier-1 stage's gate);
+`--fail-on-regression` opts into exit 1 on a flagged metric.
+`bench_events.jsonl` is read through `obs.events.read_events` — the
+same torn-tail-tolerant reader every other consumer of the stream
+uses; schema-invalid records are errors (strict), a torn final line is
+not.
+
+Usage:
+  python tools/bench_trajectory.py [--repo DIR] [--output verdict.json]
+      [--events-jsonl PATH]        # mirror the verdict as a note event
+      [--fail-on-regression]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from statistics import median
+from typing import Any, Dict, List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from proteinbert_tpu.obs.events import read_events  # noqa: E402
+
+MIN_HISTORY = 3        # prior points required before judging
+K_SIGMA = 3.0          # band half-width in robust sigmas
+REL_FLOOR = 0.10       # …never narrower than 10% of the baseline
+VERDICT_SCHEMA = 1
+
+
+def fit_baseline(prior: List[float]) -> Tuple[float, float]:
+    """(center, band) from the prior points: robust location (median)
+    and a noise band from the scaled MAD, floored at REL_FLOOR of the
+    center so near-constant series still tolerate small wobble."""
+    center = median(prior)
+    mad = median([abs(x - center) for x in prior])
+    scale = 1.4826 * mad  # MAD → sigma under normality
+    band = max(K_SIGMA * scale, REL_FLOOR * abs(center))
+    return center, band
+
+
+def judge_series(values: List[float],
+                 higher_is_better: bool = True) -> Dict[str, Any]:
+    """Verdict for one metric series (oldest → newest). The newest
+    point is judged against a baseline fit on everything before it."""
+    out: Dict[str, Any] = {
+        "values": [round(v, 6) for v in values],
+        "n": len(values),
+        "higher_is_better": higher_is_better,
+    }
+    prior = values[:-1]
+    if len(prior) < MIN_HISTORY:
+        out["verdict"] = "insufficient_data"
+        out["reason"] = (f"{len(prior)} prior point(s) < {MIN_HISTORY} "
+                         "required for a baseline")
+        return out
+    newest = values[-1]
+    center, band = fit_baseline(prior)
+    out.update(baseline=round(center, 6), noise_band=round(band, 6),
+               newest=round(newest, 6))
+    delta = newest - center
+    regressed = (delta < -band) if higher_is_better else (delta > band)
+    improved = (delta > band) if higher_is_better else (delta < -band)
+    if regressed:
+        out["verdict"] = "regression"
+        out["reason"] = (f"newest {newest:.6g} is "
+                         f"{abs(delta) / abs(center) * 100:.1f}% "
+                         f"{'below' if higher_is_better else 'above'} "
+                         f"baseline {center:.6g} (band "
+                         f"{band / abs(center) * 100:.1f}%)")
+    elif improved:
+        out["verdict"] = "improved"
+        out["reason"] = (f"newest {newest:.6g} beats baseline "
+                         f"{center:.6g} beyond the noise band")
+    else:
+        out["verdict"] = "ok"
+        out["reason"] = (f"newest {newest:.6g} within ±{band:.6g} of "
+                         f"baseline {center:.6g}")
+    return out
+
+
+# ------------------------------------------------------------ extraction
+
+def series_from_bench_files(paths: List[str],
+                            errors: List[str]) -> Dict[str, List[float]]:
+    """BENCH_rNN.json → {series key: values} in round order. Primary
+    captures and live_fallback probes are separate series, split by
+    platform (cross-platform deltas are not regressions)."""
+    series: Dict[str, List[float]] = {}
+    for path in paths:
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, ValueError) as e:
+            errors.append(f"{path}: unreadable: {e}")
+            continue
+        if not isinstance(rec, dict):
+            errors.append(f"{path}: expected a JSON object, got "
+                          f"{type(rec).__name__}")
+            continue
+        parsed = rec.get("parsed")
+        if not isinstance(parsed, dict):
+            continue  # a round with no parsed capture (recorded as null)
+        metric = parsed.get("metric", "unknown")
+        platform = parsed.get("platform", "unknown")
+        value = parsed.get("value")
+        if isinstance(value, (int, float)) and not isinstance(value, bool):
+            series.setdefault(f"{metric}/{platform}",
+                              []).append(float(value))
+        fb = parsed.get("live_fallback")
+        if isinstance(fb, dict):
+            fbv = fb.get("value")
+            if isinstance(fbv, (int, float)) and not isinstance(fbv, bool):
+                series.setdefault(
+                    f"{metric}/{fb.get('platform', 'unknown')}"
+                    "/live_fallback", []).append(float(fbv))
+    return series
+
+
+# (event kind, payload field) → series name; all higher-is-better.
+_EVENT_METRICS = (
+    ("serve_capture", "served_requests_per_sec", "serve_requests_per_sec"),
+    ("serve_capture", "speedup_x", "serve_speedup_x"),
+    ("pack_capture", "effective_speedup_x", "pack_effective_speedup_x"),
+)
+
+
+def series_from_events(path: str,
+                       errors: List[str]) -> Dict[str, List[float]]:
+    """bench_events.jsonl note events → {series key: values} in stream
+    order, via the shared torn-tail-tolerant reader (strict: a
+    schema-invalid record is an input error, a torn tail is not)."""
+    series: Dict[str, List[float]] = {}
+    try:
+        records = read_events(path, strict=True)
+    except (OSError, ValueError) as e:
+        errors.append(f"{path}: {e}")
+        return series
+    for rec in records:
+        if rec.get("event") != "note":
+            continue
+        kind = rec.get("kind")
+        platform = rec.get("platform", "unknown")
+        for ev_kind, field, name in _EVENT_METRICS:
+            if kind != ev_kind:
+                continue
+            v = rec.get(field)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                series.setdefault(f"{name}/{platform}",
+                                  []).append(float(v))
+    return series
+
+
+# -------------------------------------------------------------- verdict
+
+def build_verdict(bench_paths: List[str],
+                  events_path: Optional[str]) -> Dict[str, Any]:
+    errors: List[str] = []
+    series = series_from_bench_files(bench_paths, errors)
+    if events_path and os.path.exists(events_path):
+        series.update(series_from_events(events_path, errors))
+    judged = {name: judge_series(values)
+              for name, values in sorted(series.items())}
+    verdicts = [s["verdict"] for s in judged.values()]
+    if errors:
+        overall = "error"
+    elif "regression" in verdicts:
+        overall = "regression"
+    elif any(v in ("ok", "improved") for v in verdicts):
+        overall = "ok"
+    else:
+        overall = "insufficient_data"
+    return {
+        "v": VERDICT_SCHEMA,
+        "kind": "bench_trajectory_verdict",
+        "overall": overall,
+        "inputs": {"bench_files": [os.path.basename(p)
+                                   for p in bench_paths],
+                   "events": events_path},
+        "policy": {"min_history": MIN_HISTORY, "k_sigma": K_SIGMA,
+                   "rel_floor": REL_FLOOR},
+        "series": judged,
+        "errors": errors,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--repo", default=REPO,
+                    help="directory holding BENCH_r*.json + "
+                         "bench_events.jsonl (default: repo root)")
+    ap.add_argument("--bench-glob", default="BENCH_r*.json")
+    ap.add_argument("--events", default=None,
+                    help="bench events stream (default: "
+                         "<repo>/bench_events.jsonl)")
+    ap.add_argument("--output", default=None,
+                    help="write the verdict artifact here (JSON)")
+    ap.add_argument("--events-jsonl", default=None,
+                    help="ALSO mirror the overall verdict as a `note` "
+                         "event on this stream (obs integration)")
+    ap.add_argument("--fail-on-regression", action="store_true",
+                    help="exit 1 on a flagged regression (default: "
+                         "report-only — only input errors fail)")
+    args = ap.parse_args(argv)
+
+    bench_paths = sorted(glob.glob(os.path.join(args.repo,
+                                                args.bench_glob)))
+    events_path = args.events or os.path.join(args.repo,
+                                              "bench_events.jsonl")
+    verdict = build_verdict(bench_paths, events_path)
+
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(verdict, f, indent=1)
+
+    if args.events_jsonl:
+        from proteinbert_tpu.obs.events import EventLog
+
+        ev = EventLog(args.events_jsonl)
+        ev.emit("note", source="bench_trajectory", kind="verdict",
+                overall=verdict["overall"],
+                regressions=[k for k, s in verdict["series"].items()
+                             if s["verdict"] == "regression"],
+                errors=len(verdict["errors"]))
+        ev.close()
+
+    for name, s in verdict["series"].items():
+        print(f"{s['verdict']:>18}  {name}: {s.get('reason', '')}")
+    for err in verdict["errors"]:
+        print(f"INPUT ERROR: {err}", file=sys.stderr)
+    print(f"overall: {verdict['overall']} "
+          f"({len(verdict['series'])} series, "
+          f"{len(verdict['errors'])} input error(s))")
+    if verdict["errors"]:
+        return 2
+    if args.fail_on_regression and verdict["overall"] == "regression":
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

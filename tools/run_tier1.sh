@@ -40,6 +40,18 @@ python "$(dirname "$0")/validate_events.py" --self-test
 rcv=$?
 [ "$rc" -eq 0 ] && rc=$rcv
 
+# Perf-regression sentinel (ISSUE 6 satellite): fit per-metric
+# baselines over the checked-in bench trajectory (BENCH_r*.json +
+# bench_events.jsonl) and report any point outside the noise band.
+# REPORT-ONLY: verdicts never fail the gate — only parse/schema errors
+# in the inputs do (exit 2). Stdlib+obs only, <2 s, no jax.
+echo "=== bench trajectory sentinel (report-only) ==="
+verdict_json=$(mktemp /tmp/_bench_verdict.XXXXXX.json)
+python "$(dirname "$0")/bench_trajectory.py" --output "$verdict_json"
+rct=$?
+echo "verdict artifact: $verdict_json"
+[ "$rc" -eq 0 ] && rc=$rct
+
 # Serving smoke (ISSUE 5 satellite): in-process server on CPU under
 # concurrent clients — continuous micro-batching vs the sequential
 # baseline, per-bucket bit-parity, bounded-queue rejection. Small knobs
@@ -50,6 +62,7 @@ echo "=== serve smoke (in-process server, CPU, concurrent clients) ==="
 timeout -k 10 420 env JAX_PLATFORMS=cpu \
   PBT_SERVE_BENCH_SEQ_LEN=256 PBT_SERVE_BENCH_DIM=32 \
   PBT_SERVE_BENCH_REQUESTS=64 PBT_SERVE_BENCH_CLIENTS=24 \
+  PBT_SERVE_BENCH_TRACE_ROUNDS=3 \
   python "$(dirname "$0")/../bench.py" --serve
 rcs=$?
 [ "$rc" -eq 0 ] && rc=$rcs

@@ -52,6 +52,19 @@ def self_test() -> int:
          "step": 1, "phase": "bogus"},
         {"v": 1, "event": "run_end", "seq": -1, "t": 0.0,
          "outcome": "completed", "perf": {}},
+        # serve tracing / SLO types (ISSUE 6):
+        {"v": 1, "event": "serve_request", "seq": 0, "t": 0.0,
+         "kind": "embed", "outcome": "vanished", "request_id": "r1",
+         "stages": {}},
+        {"v": 1, "event": "serve_request", "seq": 0, "t": 0.0,
+         "kind": "embed", "outcome": "ok", "request_id": "r1",
+         "stages": {"queue": -0.5}},
+        {"v": 1, "event": "serve_reject", "seq": 0, "t": 0.0,
+         "reason": "queue_full", "queue_depth": -3},
+        {"v": 1, "event": "slo_breach", "seq": 0, "t": 0.0,
+         "objective": "latency_e2e"},  # missing burn_rate
+        {"v": 1, "event": "slo_breach", "seq": 0, "t": 0.0,
+         "objective": "latency_e2e", "burn_rate": float("nan")},
     ]
     for rec in bad:
         try:

@@ -1192,6 +1192,383 @@ def run_serve():
         sys.exit(1)
 
 
+def run_heads():
+    """`bench.py --heads`: the multi-tenant platform loop end to end —
+    finetune → register → serve mixed-head traffic → eval — one JSON
+    line, CPU-measurable (ISSUE 8 acceptance; the run_tier1.sh heads
+    smoke stage).
+
+    Phases over one tiny trunk:
+
+    1. **finetune + register** — K tiny heads (one per task kind, 1
+       epoch, synthetic labeled data, freeze_trunk so the registered
+       trunk fingerprint IS the resident trunk's) land in a registry
+       via the `train/finetune.finetune(registry=)` path, emitting
+       `head_registered` events.
+    2. **eval harness** — every head scored by heads/eval.py
+       (per-residue accuracy / accuracy+AUC proxy / Spearman),
+       `head_eval` events schema-validated; `eval_score_min` is the
+       worst normalized score across heads — the finetune-quality
+       series the bench-trajectory sentinel fits.
+    3. **serving A/B** — the same mixed request population through two
+       servers: MIXED (requests group by bucket only, every micro-batch
+       runs ONE shared trunk pass and per-head tails) vs PARTITIONED
+       (`partition_heads=True`: per-head groups — what serving degrades
+       to without the shared-trunk insight). Median requests/s over
+       PBT_HEADS_BENCH_ROUNDS interleaved rounds; the speedup is
+       REPORTED (wall-clock on a shared box is evidence, not a gate).
+    4. **contracts, GATED** — one deterministic micro-batch mixing ≥3
+       distinct heads is bit-identical per row to sequential
+       split-apply offline inference; the shared-trunk executable count
+       stays FLAT across all serving traffic including a hot
+       `add_head` on the live server; no request is ever lost; all
+       emitted events validate against the schema.
+
+    Knobs: PBT_HEADS_BENCH_SEQ_LEN (128), PBT_HEADS_BENCH_DIM (32),
+    PBT_HEADS_BENCH_REQUESTS (60), PBT_HEADS_BENCH_CLIENTS (12),
+    PBT_HEADS_BENCH_MAX_BATCH (8), PBT_HEADS_BENCH_ROUNDS (3),
+    PBT_HEADS_BENCH_EPOCHS (1).
+    """
+    import tempfile
+    import threading
+    from statistics import median as _median
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS", "") != "tpu":
+        force_cpu_backend()
+    enable_compile_cache()
+
+    from proteinbert_tpu.configs import (
+        DataConfig, FinetuneConfig, ModelConfig, OptimizerConfig,
+        PretrainConfig, TaskConfig, TrainConfig,
+    )
+    from proteinbert_tpu.data.synthetic import make_task_batches
+    from proteinbert_tpu.data.vocab import ALPHABET
+    from proteinbert_tpu.heads import HeadRegistry, trunk_fingerprint
+    from proteinbert_tpu.heads import apply as heads_apply
+    from proteinbert_tpu.heads.eval import evaluate_heads
+    from proteinbert_tpu.obs import Telemetry, read_events
+    from proteinbert_tpu.serve import TASK_KIND, Server
+    from proteinbert_tpu.train import create_train_state
+    from proteinbert_tpu.train.finetune import finetune
+
+    seq_len = int(os.environ.get("PBT_HEADS_BENCH_SEQ_LEN", 128))
+    dim = int(os.environ.get("PBT_HEADS_BENCH_DIM", 32))
+    n_requests = int(os.environ.get("PBT_HEADS_BENCH_REQUESTS", 60))
+    n_clients = int(os.environ.get("PBT_HEADS_BENCH_CLIENTS", 12))
+    max_batch = int(os.environ.get("PBT_HEADS_BENCH_MAX_BATCH", 8))
+    rounds = int(os.environ.get("PBT_HEADS_BENCH_ROUNDS", 3))
+    epochs = int(os.environ.get("PBT_HEADS_BENCH_EPOCHS", 1))
+
+    model = ModelConfig(local_dim=dim, global_dim=2 * dim, key_dim=16,
+                        num_heads=4, num_blocks=2, num_annotations=128,
+                        dtype="float32")
+    buckets = (seq_len // 2, seq_len)
+    cfg = PretrainConfig(
+        model=model,
+        data=DataConfig(seq_len=seq_len, batch_size=max_batch,
+                        buckets=buckets),
+        optimizer=OptimizerConfig(warmup_steps=10),
+        train=TrainConfig(max_steps=1))
+    params = create_train_state(jax.random.PRNGKey(0), cfg).params
+    # finetune_step donates its state — and the finetune state's trunk
+    # ALIASES pretrained_trunk's arrays — so hand finetune a host copy
+    # and keep `params` (the resident serving trunk) untouched.
+    trunk_host = jax.tree.map(np.asarray, params)
+
+    failures = []
+    work = tempfile.mkdtemp(prefix="pbt_heads_bench_")
+    events_path = os.path.join(work, "events.jsonl")
+    tele = Telemetry(events_path=events_path)
+    registry = HeadRegistry(os.path.join(work, "registry"))
+
+    # ---- phase 1: finetune K heads and register them ------------------
+    tasks = [("token_classification", 4), ("sequence_classification", 3),
+             ("sequence_regression", 1)]
+    rng = np.random.default_rng(0)
+    head_ids = []
+    ft_s = {}
+    for i, (kind, n_out) in enumerate(tasks):
+        fcfg = FinetuneConfig(
+            model=model,
+            task=TaskConfig(kind=kind, num_outputs=n_out, epochs=epochs,
+                            freeze_trunk=True),
+            data=DataConfig(seq_len=seq_len, batch_size=8),
+            optimizer=OptimizerConfig(learning_rate=3e-3, warmup_steps=5,
+                                      schedule="warmup_cosine",
+                                      total_steps=200),
+            train=TrainConfig(seed=i))
+        batches = make_task_batches(32, np.random.default_rng(i), kind,
+                                    n_out, seq_len, 8)
+        t0 = time.perf_counter()
+        out = finetune(fcfg, lambda epoch: iter(batches),
+                       eval_batches=lambda: iter(batches),
+                       pretrained_trunk=trunk_host, telemetry=tele,
+                       registry=registry, register_name=f"bench-{kind}")
+        ft_s[kind] = round(time.perf_counter() - t0, 2)
+        head_ids.append(out["head_id"])
+    if len(set(head_ids)) != len(tasks):
+        failures.append(f"expected {len(tasks)} distinct registered "
+                        f"heads, got {head_ids}")
+
+    # ---- phase 2: downstream eval harness -----------------------------
+    fp = trunk_fingerprint(params)
+    heads = [registry.load(h, trunk_fp=fp) for h in head_ids]
+    eval_results = evaluate_heads(
+        params, model, heads,
+        lambda head: make_task_batches(
+            32, np.random.default_rng(99), head.task.kind,
+            head.task.num_outputs, seq_len, 8),
+        telemetry=tele)
+    eval_score_min = min(m["score"] for m in eval_results.values())
+
+    # ---- phase 3: mixed vs head-partitioned serving -------------------
+    lengths = np.clip(rng.lognormal(mean=np.log(seq_len // 6), sigma=0.4,
+                                    size=n_requests),
+                      8, seq_len - 2).astype(np.int64)
+    alphabet = np.array(list(ALPHABET))
+    seqs = ["".join(rng.choice(alphabet, size=int(L))) for L in lengths]
+    assign = [head_ids[i % len(head_ids)] for i in range(n_requests)]
+
+    def run_load(srv, clients):
+        results = {}
+
+        def client(worker):
+            for i in range(worker, n_requests, clients):
+                try:
+                    results[i] = srv.predict_task(assign[i], seqs[i],
+                                                  timeout=120)
+                except Exception as e:  # noqa: BLE001
+                    failures.append(
+                        f"request {i}: {type(e).__name__}: {e}")
+        threads = [threading.Thread(target=client, args=(w,))
+                   for w in range(clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(300)
+        dt = time.perf_counter() - t0
+        deadline = time.monotonic() + 5.0
+        prev = -1
+        while time.monotonic() < deadline:
+            cur = srv.scheduler.rows_total
+            if cur == prev and len(srv.queue) == 0 \
+                    and srv.scheduler.pending_rows() == 0:
+                break
+            prev = cur
+            time.sleep(0.02)
+        return results, dt
+
+    rps = {"mixed": [], "partitioned": []}
+    # One batch class keeps the warmup to one trunk compile per bucket
+    # (the A/B measures scheduling, not the compile matrix).
+    servers = {}
+    for name, part in (("mixed", False), ("partitioned", True)):
+        srv = Server(params, cfg, max_batch=max_batch, max_wait_s=0.005,
+                     queue_depth=4 * n_requests, cache_size=0,
+                     warm_kinds=(), batch_classes=(max_batch,),
+                     telemetry=Telemetry(), trace_sample_rate=None,
+                     registry=registry, heads=head_ids,
+                     partition_heads=part)
+        srv.start()
+        run_load(srv, n_clients)  # warm pass
+        servers[name] = srv
+    for _ in range(rounds):  # interleaved matched rounds
+        for name, srv in servers.items():
+            results, dt = run_load(srv, n_clients)
+            rps[name].append(len(results) / dt)
+            if len(results) != n_requests:
+                failures.append(
+                    f"{name}: lost {n_requests - len(results)} of "
+                    f"{n_requests} requests")
+    mixed_stats = servers["mixed"].stats()
+    part_stats = servers["partitioned"].stats()
+    trunk_execs_before = servers["mixed"].dispatcher.trunk_executable_count
+
+    # Hot add on the LIVE mixed server: a fresh head (same structure as
+    # the sequence head → its tail executable is already warm) must
+    # not add a trunk compile.
+    from proteinbert_tpu.models import finetune as ft_model
+
+    extra_task = TaskConfig(kind="sequence_classification", num_outputs=3)
+    extra_params = ft_model.head_init(jax.random.PRNGKey(42), model,
+                                      extra_task)
+    extra_id = registry.save(
+        jax.tree.map(np.asarray, extra_params), extra_task, fp,
+        name="bench-hot-add")
+    servers["mixed"].add_head(extra_id)
+    got = servers["mixed"].predict_task(extra_id, seqs[0], timeout=60)
+    trunk_execs_after = servers["mixed"].dispatcher.trunk_executable_count
+    if trunk_execs_after != trunk_execs_before:
+        failures.append(
+            f"hot add_head recompiled the trunk: executable count "
+            f"{trunk_execs_before} -> {trunk_execs_after}")
+    if got.shape != (3,):
+        failures.append(f"hot-added head returned shape {got.shape}")
+    for srv in servers.values():
+        srv.drain(timeout=60)
+
+    mixed_rps = _median(rps["mixed"])
+    part_rps = _median(rps["partitioned"])
+    serving = {
+        "requests": n_requests, "clients": n_clients,
+        "n_heads": len(head_ids),
+        "rps_per_round": {k: [round(v, 2) for v in vs]
+                          for k, vs in rps.items()},
+        "mixed_requests_per_sec": round(mixed_rps, 2),
+        "partitioned_requests_per_sec": round(part_rps, 2),
+        "mixed_speedup_x": round(mixed_rps / max(part_rps, 1e-9), 2),
+        "mixed_batches": mixed_stats["batches"],
+        "partitioned_batches": part_stats["batches"],
+        "mixed_mean_rows_per_batch": round(
+            mixed_stats["batched_rows"] / max(mixed_stats["batches"], 1),
+            2),
+        "partitioned_mean_rows_per_batch": round(
+            part_stats["batched_rows"] / max(part_stats["batches"], 1),
+            2),
+        "trunk_executables": trunk_execs_after,
+    }
+
+    # ---- phase 4: deterministic mixed-batch bit-parity ----------------
+    # Fixed short lengths: every row lands in the SAME bucket, so one
+    # poll() forms exactly one micro-batch mixing all the heads.
+    from proteinbert_tpu import inference
+
+    group = ["".join(rng.choice(alphabet, size=10 + 3 * i))
+             for i in range(2 * len(head_ids))]
+    gassign = [head_ids[i % len(head_ids)] for i in range(len(group))]
+    psrv = Server(params, cfg, max_batch=len(group), max_wait_s=60.0,
+                  cache_size=0, warm_kinds=(),
+                  batch_classes=(len(group),), registry=registry,
+                  heads=head_ids)
+    n_trunk0 = psrv.dispatcher.trunk_executable_count
+    futures = [psrv.submit(TASK_KIND, s, head_id=h)
+               for s, h in zip(group, gassign)]
+    psrv.scheduler.poll()  # deterministic single-batch formation
+    mixed_out = [f.result(timeout=30) for f in futures]
+    # Read AFTER the dispatch: the whole mixed-head batch must have
+    # compiled exactly ONE shared trunk executable (n_trunk0 was 0 on
+    # the cold, unwarmed server).
+    n_trunk_parity = psrv.dispatcher.trunk_executable_count
+    if n_trunk0 != 0 or n_trunk_parity != 1:
+        failures.append(
+            f"parity batch expected exactly one shared trunk executable "
+            f"(cold {n_trunk0} -> warm {n_trunk_parity})")
+    if psrv.scheduler.batches_total != 1:
+        failures.append(
+            f"parity phase expected ONE mixed micro-batch, got "
+            f"{psrv.scheduler.batches_total}")
+    heads_in_batch = len(set(gassign))
+    if heads_in_batch < 3:
+        failures.append(f"parity batch mixed only {heads_in_batch} heads")
+    psrv.abort()
+
+    # BIT-identity gate: mixed-head batch vs PER-HEAD SEQUENTIAL
+    # serving at the same (batch_class, bucket) shape — the same
+    # executables run, so mixing tenants into one batch must change
+    # NOTHING (per-row independence of the trunk forward).
+    # max_batch = rows-per-head so each per-head group dispatches full;
+    # batch_classes pins the SAME padded class shape the mixed batch
+    # ran, so both paths hit the identical executable.
+    ssrv = Server(params, cfg,
+                  max_batch=len(group) // heads_in_batch,
+                  max_wait_s=60.0, cache_size=0, warm_kinds=(),
+                  batch_classes=(len(group),), registry=registry,
+                  heads=head_ids, partition_heads=True)
+    sfutures = [ssrv.submit(TASK_KIND, s, head_id=h)
+                for s, h in zip(group, gassign)]
+    for _ in range(heads_in_batch):  # one per-head batch per poll
+        ssrv.scheduler.poll()
+    seq_out = [f.result(timeout=30) for f in sfutures]
+    parity_ok = all(np.array_equal(m, s)
+                    for m, s in zip(mixed_out, seq_out))
+    if not parity_ok:
+        failures.append("mixed-head micro-batch is not bit-identical "
+                        "to per-head sequential serving")
+    if ssrv.scheduler.batches_total != heads_in_batch:
+        failures.append(
+            f"partitioned parity server formed "
+            f"{ssrv.scheduler.batches_total} batches, expected "
+            f"{heads_in_batch}")
+    ssrv.abort()
+
+    # Sanity vs OFFLINE single-row split-apply inference: same math,
+    # different batch shape → documented fp32 tolerance (XLA reassoc-
+    # iates reductions per shape; measured ~1e-6 — docs/serving.md).
+    by_head = {h.head_id: h for h in heads}
+    L = psrv.dispatcher.bucket_len(max(len(s) for s in group))
+    offline_tol_ok = True
+    for i, (s, h) in enumerate(zip(group, gassign)):
+        want = heads_apply.predict_task_rows(
+            params, model, by_head[h],
+            inference._tokenize_masked([s], seq_len)[:, :L])[0]
+        if not np.allclose(mixed_out[i], want, rtol=0, atol=1e-5):
+            offline_tol_ok = False
+    if not offline_tol_ok:
+        failures.append("mixed-head serving drifted past the 1e-5 fp32 "
+                        "tolerance vs offline split-apply inference")
+
+    # ---- events validate ----------------------------------------------
+    tele.close()
+    recs = read_events(events_path, strict=True)
+    n_reg = sum(1 for r in recs if r["event"] == "head_registered")
+    n_ev = sum(1 for r in recs if r["event"] == "head_eval")
+    # (the hot-add head was saved via registry.save directly — only the
+    # finetune(registry=) path emits head_registered)
+    if n_reg != len(tasks):
+        failures.append(f"expected {len(tasks)} head_registered "
+                        f"events, got {n_reg}")
+    if n_ev != len(tasks):
+        failures.append(f"expected {len(tasks)} head_eval events, "
+                        f"got {n_ev}")
+
+    record = {
+        "metric": "heads_load",
+        "platform": jax.devices()[0].platform,
+        "seq_len": seq_len, "model_dim": dim,
+        "buckets": list(buckets), "max_batch": max_batch,
+        "finetune_s": ft_s,
+        "head_ids": head_ids,
+        "eval": {h.head_id: eval_results[h.head_id] for h in heads},
+        "eval_score_min": round(eval_score_min, 6),
+        "serving": serving,
+        "parity": {"rows": len(group), "heads_mixed": heads_in_batch,
+                   "bit_identical_vs_sequential": parity_ok,
+                   "offline_within_1e-5": offline_tol_ok,
+                   "trunk_executables": n_trunk_parity},
+        "events": {"head_registered": n_reg, "head_eval": n_ev,
+                   "total": len(recs)},
+        "failures": failures,
+    }
+    try:  # mirror onto the shared bench event stream (best-effort)
+        from proteinbert_tpu.obs.events import EventLog
+
+        ev = EventLog(os.path.join(os.path.dirname(LAST_GOOD_PATH),
+                                   "bench_events.jsonl"))
+        ev.emit("note", source="bench", kind="heads_capture",
+                platform=record["platform"], seq_len=seq_len,
+                n_heads=len(head_ids), n_requests=n_requests,
+                mixed_requests_per_sec=serving["mixed_requests_per_sec"],
+                partitioned_requests_per_sec=serving[
+                    "partitioned_requests_per_sec"],
+                mixed_speedup_x=serving["mixed_speedup_x"],
+                eval_score_min=record["eval_score_min"],
+                failures=len(failures))
+        ev.close()
+    except Exception as e:
+        print(f"bench events stream unavailable: {e}", file=sys.stderr)
+    import shutil
+
+    shutil.rmtree(work, ignore_errors=True)
+    print(json.dumps(record))
+    if failures:
+        for f in failures:
+            print(f"HEADS CONTRACT FAILURE: {f}", file=sys.stderr)
+        sys.exit(1)
+
+
 def run_comm():
     """`bench.py --comm`: per-step collective bytes + per-chip state
     bytes, replicated vs ZeRO-1 zero-update, on a CPU-virtual mesh —
@@ -1355,6 +1732,13 @@ def main():
                          "throughput, p50/p99 latency, per-bucket "
                          "bit-parity, queue-overflow rejection — one "
                          "JSON line, CI-measurable without a TPU")
+    ap.add_argument("--heads", action="store_true",
+                    help="the multi-tenant head platform end to end: "
+                         "finetune → register → serve mixed-head "
+                         "traffic vs head-partitioned batching → "
+                         "downstream eval; mixed-batch bit-parity and "
+                         "flat-trunk-executable contracts gated — one "
+                         "JSON line, CI-measurable without a TPU")
     ap.add_argument("--comm", action="store_true",
                     help="compile the train step replicated vs ZeRO-1 "
                          "zero-update on a CPU-virtual mesh and emit one "
@@ -1373,6 +1757,10 @@ def main():
 
     if cli.serve:
         run_serve()
+        return
+
+    if cli.heads:
+        run_heads()
         return
 
     if cli.comm:

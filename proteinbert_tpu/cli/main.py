@@ -12,6 +12,9 @@ is one console with subcommands:
   pretrain           denoising pretrain from an HDF5 file or synthetic data
   smoke              the dummy_tests-equivalent end-to-end sanity run
   finetune           supervised task head on a (pretrained) trunk
+                     (--register-head saves it into a head registry)
+  eval-heads         score registered heads on labeled/synthetic data
+                     (downstream eval harness; head_eval events)
   convert-torch      reference torch checkpoint → orbax run dir (migration)
   export-weights     orbax run dir → flat NPZ of named arrays (portability)
   import-weights     flat NPZ → orbax run dir (the export round trip)
@@ -465,10 +468,17 @@ def cmd_finetune(args) -> int:
 
         tele = Telemetry(events_path=args.events_jsonl)
         tele.flight.install_excepthook()  # unhandled exception → dump
+    registry = None
+    if args.register_head:
+        from proteinbert_tpu.heads import HeadRegistry
+
+        registry = HeadRegistry(args.register_head)
+        log(f"will register the trained head into {registry.directory}")
     try:
         out = finetune(cfg, train_batches, eval_batches=eval_batches,
                        pretrained_trunk=trunk, checkpointer=ck,
-                       telemetry=tele)
+                       telemetry=tele, registry=registry,
+                       register_name=args.head_name)
     finally:
         ck.close()
         if tele is not None:
@@ -476,6 +486,10 @@ def cmd_finetune(args) -> int:
             tele.close()
     best = out["best"]
     log(f"best epoch {best['epoch']}: score {best['score']:.4f}")
+    if out.get("head_id"):
+        log(f"registered head {out['head_id']} "
+            f"({cfg.task.kind}) — serve it with: pbt serve --registry "
+            f"{args.register_head} --heads {out['head_id']}")
     if args.history_json:
         with open(args.history_json, "w") as f:
             json.dump(out["history"], f, indent=2)
@@ -710,6 +724,89 @@ def cmd_evaluate(args) -> int:
     if args.output:
         with open(args.output, "w") as f:
             json.dump(result, f, indent=2)
+    return 0
+
+
+def cmd_eval_heads(args) -> int:
+    """Downstream eval harness (ISSUE 8): score registered task heads
+    against the resident trunk — per-residue accuracy / accuracy +
+    AUC proxy / Spearman by task kind (heads/eval.py) — emitting one
+    schema-versioned `head_eval` event per head so finetune-quality
+    regressions gate through the bench-trajectory sentinel like perf
+    does. One JSON line per head on stdout."""
+    import numpy as np
+
+    from proteinbert_tpu.heads import HeadRegistry, trunk_fingerprint
+    from proteinbert_tpu.heads.eval import evaluate_heads
+
+    params, cfg = _load_inference_trunk(args)
+    registry = HeadRegistry(args.registry)
+    fp = None if args.no_trunk_check else trunk_fingerprint(params)
+    if args.heads and args.heads != "all":
+        # Explicit ids are strict (clean exit on mismatch/corruption);
+        # implicit "all" below skips unservable artifacts with a
+        # warning — a registry normally accumulates heads across
+        # re-pretrains and one stale entry must not block the rest.
+        from proteinbert_tpu.heads import HeadRegistryError
+
+        try:
+            heads = [registry.load(h, trunk_fp=fp)
+                     for h in args.heads.split(",") if h]
+        except HeadRegistryError as e:
+            raise SystemExit(f"--heads: {e}")
+    else:
+        from proteinbert_tpu.heads import HeadRegistryError
+
+        heads = []
+        for m in registry.list_heads():
+            try:
+                heads.append(registry.load(m["head_id"], trunk_fp=fp))
+            except HeadRegistryError as e:
+                log(f"skipping head {m['head_id']} ({m.get('name')}): {e}")
+    if not heads:
+        raise SystemExit(
+            f"no evaluable heads in {registry.directory}")
+
+    if args.data:
+        from proteinbert_tpu.data.finetune_data import (
+            batch_task_data, load_task_tsv,
+        )
+
+        kinds = sorted({h.task.kind for h in heads})
+        if len(kinds) > 1:
+            raise SystemExit(
+                f"--data is a single-task TSV but the selected heads "
+                f"span {kinds}; select heads of one kind")
+        tokens, labels = load_task_tsv(args.data, kinds[0],
+                                       cfg.data.seq_len)
+        bs = min(args.batch_size, len(tokens))
+        batches_for = lambda head: batch_task_data(  # noqa: E731
+            tokens, labels, bs)
+    else:
+        log("no --data given: evaluating on synthetic labeled batches")
+        from proteinbert_tpu.data.synthetic import make_task_batches
+
+        batches_for = lambda head: make_task_batches(  # noqa: E731
+            max(4 * args.batch_size, 32),
+            np.random.default_rng(args.seed), head.task.kind,
+            head.task.num_outputs, cfg.data.seq_len, args.batch_size)
+
+    tele = None
+    if args.events_jsonl:
+        from proteinbert_tpu.obs import Telemetry
+
+        tele = Telemetry(events_path=args.events_jsonl)
+    try:
+        results = evaluate_heads(params, cfg.model, heads, batches_for,
+                                 telemetry=tele)
+    finally:
+        if tele is not None:
+            tele.close()
+    for hid, m in results.items():
+        print(json.dumps({"head_id": hid, **m}))
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(results, f, indent=2)
     return 0
 
 
@@ -1030,6 +1127,47 @@ def cmd_serve(args) -> int:
             f"{o.name} ({o.kind}, target {o.target:g}, "
             f"window {o.window_s:g}s)" for o in slos))
 
+    registry = None
+    head_ids = []
+    if args.registry:
+        from proteinbert_tpu.heads import (
+            HeadRegistry, HeadRegistryError, TrunkMismatchError,
+            trunk_fingerprint,
+        )
+
+        registry = HeadRegistry(args.registry)
+        if args.heads and args.heads != "all":
+            # Explicitly named heads are STRICT: a mismatch/corruption
+            # is a config error the operator must see (clean exit, not
+            # a traceback).
+            try:
+                head_ids = [h for h in args.heads.split(",") if h]
+                fp = trunk_fingerprint(params)
+                for h in head_ids:
+                    registry.load(h, trunk_fp=fp)
+            except HeadRegistryError as e:
+                raise SystemExit(f"--heads: {e}")
+        else:
+            # Implicit "all" tolerates an imperfect store (a registry
+            # normally accumulates heads across re-pretrains): serve
+            # every trunk-compatible head, skip the rest with a
+            # warning — one stale artifact must not take the whole
+            # multi-tenant server down.
+            fp = trunk_fingerprint(params)
+            for m in registry.list_heads():
+                try:
+                    registry.load(m["head_id"], trunk_fp=fp)
+                except (TrunkMismatchError, HeadRegistryError) as e:
+                    log(f"skipping head {m['head_id']} "
+                        f"({m.get('name')}): {e}")
+                    continue
+                head_ids.append(m["head_id"])
+        if not head_ids:
+            log(f"registry {registry.directory} holds no servable heads "
+                "yet; add them live via POST /v1/heads/add")
+    elif args.heads:
+        raise SystemExit("--heads requires --registry")
+
     server = Server(
         params, cfg,
         max_batch=args.max_batch,
@@ -1044,7 +1182,15 @@ def cmd_serve(args) -> int:
         trace_sample_rate=args.trace_sample_rate,
         slos=slos,
         slo_profile_dir=args.slo_profile_dir,
+        registry=registry,
+        heads=head_ids,
     )
+    if head_ids:
+        # Trunk-compat was enforced per head at load (TrunkMismatchError
+        # would have exited above); one micro-batch now mixes requests
+        # for any of these heads through the shared trunk executable.
+        log(f"serving {len(head_ids)} registered head(s) over the "
+            f"shared trunk: {', '.join(head_ids)}")
     log(f"warming {len(server.dispatcher.buckets)} bucket(s) x "
         f"{len(server.dispatcher.batch_classes)} batch class(es): "
         f"buckets={list(server.dispatcher.buckets)}")
@@ -1208,8 +1354,49 @@ def build_parser() -> argparse.ArgumentParser:
     ftp.add_argument("--events-jsonl", type=creatable_path,
                      help="unified telemetry events stream "
                           "(docs/observability.md)")
+    ftp.add_argument("--register-head", metavar="REGISTRY_DIR",
+                     help="save the trained head into this head "
+                          "registry (content-addressed artifact with "
+                          "trunk fingerprint + eval metrics; serve it "
+                          "with `pbt serve --registry` — "
+                          "docs/finetuning.md)")
+    ftp.add_argument("--head-name",
+                     help="human-readable name recorded on the "
+                          "registered head artifact")
     ftp.add_argument("--set", action="append", metavar="PATH=VALUE")
     ftp.set_defaults(fn=cmd_finetune)
+
+    eh = sub.add_parser("eval-heads",
+                        help="score registered task heads against a "
+                             "trunk (downstream eval harness)")
+    eh.add_argument("--registry", required=True,
+                    help="head registry directory (pbt finetune "
+                         "--register-head)")
+    eh.add_argument("--pretrained", required=True,
+                    help="pretrain checkpoint dir for the resident trunk")
+    eh.add_argument("--preset", default="tiny",
+                    choices=["tiny", "base", "long", "large"])
+    eh.add_argument("--pretrained-set", action="append",
+                    metavar="PATH=VALUE",
+                    help="config override the pretrain run was made with")
+    eh.add_argument("--heads", default="all",
+                    help="comma-separated head ids, or 'all' (default)")
+    eh.add_argument("--data", type=existing_file,
+                    help="labeled TSV (data/finetune_data.py format; "
+                         "single task kind); default: synthetic "
+                         "labeled batches")
+    eh.add_argument("--batch-size", type=int, default=16)
+    eh.add_argument("--seed", type=int, default=0,
+                    help="synthetic eval data seed")
+    eh.add_argument("--no-trunk-check", action="store_true",
+                    help="skip the trunk-fingerprint compatibility "
+                         "check (scores then describe a mismatched "
+                         "pairing — debugging only)")
+    eh.add_argument("--events-jsonl", type=creatable_path,
+                    help="append head_eval events to this JSONL stream")
+    eh.add_argument("--output", type=creatable_path,
+                    help="also write all results as one JSON object")
+    eh.set_defaults(fn=cmd_eval_heads)
 
     def add_infer_args(sp, output_required=False):
         sp.add_argument("--pretrained", required=True,
@@ -1399,6 +1586,17 @@ def build_parser() -> argparse.ArgumentParser:
                     help="on an SLO breach, capture an on-demand "
                          "jax.profiler device trace here (cooldown-"
                          "limited)")
+    sv.add_argument("--registry",
+                    help="head registry directory: serve registered "
+                         "finetuned heads over the shared trunk "
+                         "(predict_task requests for different heads "
+                         "batch together — docs/serving.md multi-"
+                         "tenant section)")
+    sv.add_argument("--heads", default=None,
+                    help="comma-separated head ids to load at start, "
+                         "or 'all' (default: all); requires --registry. "
+                         "Heads can also be added/removed live via "
+                         "POST /v1/heads/{add,remove}")
     sv.set_defaults(fn=cmd_serve)
 
     return p

@@ -1,0 +1,97 @@
+"""Split-apply execution: one shared trunk pass, many cheap head tails.
+
+The multi-tenant serving shape (ISSUE 8): a micro-batch of requests for
+DIFFERENT finetuned tasks runs the expensive trunk forward ONCE —
+`trunk_batch` is one jitted executable per (batch_class, bucket_len)
+shape, independent of which heads ride the batch — and each distinct
+head then runs as a cheap jitted matmul tail over the full batch
+(`head_batch`), with each request keeping its own head's row. Head
+parameters are traced arguments, so every head of the same structure
+(linear vs one-hidden-layer MLP, same dims, same task kind) shares ONE
+compiled head executable: adding a tenant never adds a trunk compile
+and usually adds no compile at all.
+
+Numerics contract: `head_batch` composes `models/finetune.apply_head`
+over `models/proteinbert.encode_trunk` — the exact decomposition the
+monolithic `models/finetune.apply` is built from — so split-apply
+output is the same computation, and a row's result is independent of
+which other rows (other tenants' requests) share its batch (per-row
+independence of the trunk forward; tests/test_heads.py asserts bit
+identity of mixed-batch vs per-head serving).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from proteinbert_tpu.configs import ModelConfig
+from proteinbert_tpu.models import finetune as ft_model
+from proteinbert_tpu.models import proteinbert
+
+
+@partial(jax.jit, static_argnames="cfg")
+def trunk_batch(params, tokens, annotations, cfg: ModelConfig):
+    """The shared executable: (B, L) tokens + (B, A) annotations →
+    {"local", "global", "pad_mask"} trunk representation. One compile
+    per (B, L) shape regardless of which heads consume it."""
+    return proteinbert.encode_trunk(params, tokens, cfg, annotations)
+
+
+@partial(jax.jit, static_argnames="kind")
+def head_batch(head, local, global_, pad_mask, kind: str):
+    """One head's tail over a whole trunk-encoded batch: float32
+    logits/predictions shaped by `kind` (models/finetune module doc).
+    `head` is a traced pytree — all heads with one structure share one
+    executable."""
+    return ft_model.apply_head(head, local, global_, pad_mask, kind)
+
+
+def apply_heads(
+    trunk_out: Dict[str, jax.Array],
+    heads: Sequence[Any],
+) -> List[np.ndarray]:
+    """Mixed-head tail: per-row head objects (each with `.params`,
+    `.task.kind`, `.head_id` — heads/registry.LoadedHead) over one
+    shared trunk representation. Each DISTINCT head runs once over the
+    full batch (shape-stable: no per-group-size executables), then
+    every row keeps its own head's output. Returns host arrays aligned
+    to the input rows."""
+    rows_out: List[Optional[np.ndarray]] = [None] * len(heads)
+    by_head: Dict[str, List[int]] = {}
+    head_of: Dict[str, Any] = {}
+    for i, head in enumerate(heads):
+        by_head.setdefault(head.head_id, []).append(i)
+        head_of[head.head_id] = head
+    for head_id, idxs in by_head.items():
+        head = head_of[head_id]
+        out = np.asarray(head_batch(head.params, trunk_out["local"],
+                                    trunk_out["global"],
+                                    trunk_out["pad_mask"],
+                                    head.task.kind))
+        for i in idxs:
+            rows_out[i] = out[i]
+    return rows_out  # type: ignore[return-value]
+
+
+def predict_task_rows(
+    trunk_params,
+    cfg: ModelConfig,
+    head,
+    tokens: np.ndarray,
+    annotations: Optional[np.ndarray] = None,
+) -> np.ndarray:
+    """Offline single-head entry: (N, L) tokens → (N, ...) float32 head
+    outputs through the SAME jitted trunk+head executables serving
+    uses — the sequential-per-head reference mixed-batch parity is
+    measured against, and the eval harness's forward."""
+    if annotations is None:
+        annotations = np.zeros((tokens.shape[0], cfg.num_annotations),
+                               np.float32)
+    trunk_out = trunk_batch(trunk_params, tokens, annotations, cfg)
+    return np.asarray(head_batch(head.params, trunk_out["local"],
+                                 trunk_out["global"],
+                                 trunk_out["pad_mask"], head.task.kind))

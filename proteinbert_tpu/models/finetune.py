@@ -80,6 +80,32 @@ def _head_apply(head: Params, x: jax.Array) -> jax.Array:
     return dense_apply(head["out"], x)
 
 
+def head_features(local: jax.Array, global_: jax.Array,
+                  pad_mask: jax.Array, kind: str) -> jax.Array:
+    """Trunk representation → the feature tensor a `kind` head consumes.
+    Per-residue heads read the local track directly; sequence-level
+    heads read [global ‖ masked-mean local] (see module doc). ONE
+    definition shared by the monolithic `apply` below and the
+    split-apply serving path (heads/apply.py), so the two surfaces
+    cannot drift numerically."""
+    if kind == "token_classification":
+        return local
+    m = pad_mask.astype(local.dtype)[..., None]
+    pooled = (local * m).sum(axis=1) / jnp.maximum(m.sum(axis=1), 1.0)
+    return jnp.concatenate([global_, pooled], axis=-1)
+
+
+def apply_head(head: Params, local: jax.Array, global_: jax.Array,
+               pad_mask: jax.Array, kind: str) -> jax.Array:
+    """Run one task head off an already-computed trunk representation
+    (`proteinbert.encode_trunk`): float32 logits/predictions, shaped by
+    `kind` as in the module doc. This is the cheap per-tenant tail of
+    split-apply serving — the trunk runs once per micro-batch, this
+    runs once per (head, micro-batch)."""
+    return _head_apply(head, head_features(local, global_, pad_mask,
+                                           kind)).astype(jnp.float32)
+
+
 def apply(
     params: Params,
     tokens: jax.Array,
@@ -94,20 +120,14 @@ def apply(
     no GO annotations, which matches the pretraining corruption's
     hide-all-annotations branch (reference data_processing.py:127-128),
     so a zero global input is in-distribution for the trunk.
-    """
-    if pad_mask is None:
-        pad_mask = tokens != PAD_ID
-    if annotations is None:
-        annotations = jnp.zeros(
-            (tokens.shape[0], model_cfg.num_annotations), jnp.float32
-        )
-    local, global_ = proteinbert.encode(
-        params["trunk"], tokens, annotations, model_cfg, pad_mask
-    )
-    if task.kind == "token_classification":
-        return _head_apply(params["head"], local).astype(jnp.float32)
 
-    m = pad_mask.astype(local.dtype)[..., None]
-    pooled = (local * m).sum(axis=1) / jnp.maximum(m.sum(axis=1), 1.0)
-    feats = jnp.concatenate([global_, pooled], axis=-1)
-    return _head_apply(params["head"], feats).astype(jnp.float32)
+    Composed as encode_trunk → apply_head, the exact decomposition the
+    serving path uses (heads/apply.py) — split-apply bit-parity with
+    this monolithic entry is by construction, not by test luck
+    (tests/test_heads.py proves it anyway).
+    """
+    trunk_out = proteinbert.encode_trunk(
+        params["trunk"], tokens, model_cfg, annotations, pad_mask)
+    return apply_head(params["head"], trunk_out["local"],
+                      trunk_out["global"], trunk_out["pad_mask"],
+                      task.kind)

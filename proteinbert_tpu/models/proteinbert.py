@@ -278,6 +278,39 @@ def encode(
     return local, global_
 
 
+def encode_trunk(
+    params: Params,
+    tokens: jax.Array,
+    cfg: ModelConfig,
+    annotations: Optional[jax.Array] = None,
+    pad_mask: Optional[jax.Array] = None,
+) -> Dict[str, jax.Array]:
+    """The SHARED representation every task head consumes (ISSUE 8).
+
+    One forward through the trunk, packaged for split-apply serving:
+    `{"local": (B, L, C), "global": (B, G), "pad_mask": (B, L) bool}`.
+    Any registered head (heads/apply.py) — and the monolithic
+    models/finetune.apply — runs off exactly this dict, so the
+    expensive computation is executed once per micro-batch and the
+    cheap per-head tails are appended (the operator-fusion-for-
+    inference batching shape, PAPERS.md).
+
+    `annotations` defaults to the all-zero "no annotations known"
+    input (the same convention as models/finetune.apply — it is the
+    trained hide-all-annotations branch, so a zero global input is
+    in-distribution for the trunk). Extra keys in `params` (a pretrain
+    checkpoint's `local_head`/`global_head`) are ignored: pretrain
+    params and a stripped finetune trunk encode identically.
+    """
+    if pad_mask is None:
+        pad_mask = tokens != PAD_ID
+    if annotations is None:
+        annotations = jnp.zeros(
+            (tokens.shape[0], cfg.num_annotations), jnp.float32)
+    local, global_ = encode(params, tokens, annotations, cfg, pad_mask)
+    return {"local": local, "global": global_, "pad_mask": pad_mask}
+
+
 def apply(
     params: Params,
     tokens: jax.Array,

@@ -203,6 +203,36 @@ def summarize_serve(records: List[Dict[str, Any]],
         "batch_class": r.get("batch_class"),
     } for r in slow]
 
+    # ---- per-head attribution (multi-tenant serving, ISSUE 8) ----
+    # One tenant's slow or erroring head must be attributable: group
+    # the traced requests by head_id (predict_task requests carry one;
+    # errors/rejections ALWAYS emit regardless of sampling, so error
+    # attribution is complete even at low sample rates).
+    by_head: Dict[str, List[Dict[str, Any]]] = {}
+    for r in reqs:
+        hid = r.get("head_id")
+        if isinstance(hid, str):
+            by_head.setdefault(hid, []).append(r)
+    per_head: Dict[str, Any] = {}
+    for hid, rs in sorted(by_head.items()):
+        lat = sorted(r["e2e_s"] for r in rs
+                     if isinstance(r.get("e2e_s"), (int, float)))
+        outcomes = dict(collections.Counter(r["outcome"] for r in rs))
+        per_head[hid] = {
+            "n": len(rs),
+            "outcomes": outcomes,
+            "errors": sum(v for k, v in outcomes.items()
+                          if k not in ("ok", "cache_hit")),
+            "p50_s": _percentile(lat, 0.50),
+            "p99_s": _percentile(lat, 0.99),
+        }
+    out["per_head"] = per_head
+    head_rejects = collections.Counter(
+        r["head_id"] for r in rejects
+        if r.get("reason") == "unknown_head"
+        and isinstance(r.get("head_id"), str))
+    out["unknown_head_rejects"] = dict(head_rejects)
+
     # ---- rejections (with queue depth where the emitter knew it) ----
     depths = [r["queue_depth"] for r in rejects
               if isinstance(r.get("queue_depth"), int)]
@@ -273,6 +303,21 @@ def render_serve(summary: Dict[str, Any]) -> str:
             f"  slow: {s['request_id']} {s['kind']} {s['outcome']} "
             f"{s['e2e_s'] * 1e3:.2f}ms (mostly {s['dominant_stage']}, "
             f"L={s['bucket_len']} cls={s['batch_class']})")
+    per_head = summary.get("per_head") or {}
+    if per_head:
+        lines.append("per-head (multi-tenant predict_task traffic):")
+        for hid, h in per_head.items():
+            p50 = f"{h['p50_s'] * 1e3:.2f}ms" if h["p50_s"] is not None \
+                else "n/a"
+            p99 = f"{h['p99_s'] * 1e3:.2f}ms" if h["p99_s"] is not None \
+                else "n/a"
+            outc = ", ".join(f"{k}={v}"
+                             for k, v in sorted(h["outcomes"].items()))
+            lines.append(f"  head {hid}: n={h['n']} p50 {p50} p99 {p99} "
+                         f"errors={h['errors']} ({outc})")
+    for hid, n in sorted((summary.get("unknown_head_rejects")
+                          or {}).items()):
+        lines.append(f"  unknown-head rejects: {hid} x{n}")
     rej = summary["rejects"]
     if rej["total"]:
         lines.append(

@@ -81,12 +81,25 @@ EVENT_FIELDS: Dict[str, Dict[str, Any]] = {
     # faster than it accrues). Extra fields: window_s, bad, total,
     # bad_fraction, attribution, profile_path.
     "slo_breach": {"objective": str, "burn_rate": (int, float)},
+    # ---- multi-tenant head registry (ISSUE 8) ----
+    # A finetuned head landed in the registry (train/finetune.finetune
+    # with registry=, or `pbt finetune --register-head`). `kind` is the
+    # TaskConfig kind. Extra fields: name, trunk_fingerprint, metrics.
+    "head_registered": {"head_id": str, "kind": str},
+    # One downstream-task eval of a registered head (heads/eval.py,
+    # `pbt eval-heads`, bench.py --heads). `metrics` carries the
+    # per-task numbers (per_residue_accuracy / accuracy+auc_proxy /
+    # spearman+mse) plus a normalized `score` — the series the bench-
+    # trajectory sentinel fits so finetune-quality regressions gate
+    # like perf does. Extra fields: kind, name.
+    "head_eval": {"head_id": str, "metrics": dict},
 }
 
 CKPT_PHASES = ("dispatch", "landed", "save")
 OUTCOMES = ("completed", "preempted", "early_stopped", "nan_halt", "error")
 SERVE_OUTCOMES = ("drained", "aborted")
-SERVE_REJECT_REASONS = ("queue_full", "deadline", "closed", "too_long")
+SERVE_REJECT_REASONS = ("queue_full", "deadline", "closed", "too_long",
+                        "unknown_head")
 # Terminal per-request outcomes: ok/cache_hit resolve a result; error is
 # a dispatch/finalize failure; expired missed its deadline; evicted lost
 # its queue slot to newer work; rejected never got past admission;
@@ -206,6 +219,21 @@ def validate_record(rec: Any) -> None:
                 raise ValueError(
                     f"serve_request.stages[{name!r}] must be a "
                     f"non-negative finite number, got {v!r}")
+        # head_id is optional (only predict_task requests carry one —
+        # the per-tenant attribution field of diagnose --serve) but
+        # typed when present.
+        hid = rec.get("head_id")
+        if hid is not None and not isinstance(hid, str):
+            raise ValueError(f"serve_request.head_id must be a string, "
+                             f"got {hid!r}")
+    if event == "head_eval":
+        for name, v in rec["metrics"].items():
+            if isinstance(v, bool) or (
+                    not isinstance(v, (int, float, str))
+                    and v is not None):
+                raise ValueError(
+                    f"head_eval.metrics[{name!r}] must be a number, "
+                    f"string, or null, got {type(v).__name__}")
     if event == "slo_breach":
         br = rec["burn_rate"]
         if isinstance(br, bool) or not math.isfinite(br) or br < 0:
@@ -235,6 +263,11 @@ def make_example(event: str) -> Dict[str, Any]:
                           "request_id": "r000001",
                           "stages": {"queue": 0.001, "execute": 0.004}},
         "slo_breach": {"objective": "latency_e2e", "burn_rate": 2.5},
+        "head_registered": {"head_id": "a1b2c3d4e5f60708",
+                            "kind": "token_classification"},
+        "head_eval": {"head_id": "a1b2c3d4e5f60708",
+                      "metrics": {"per_residue_accuracy": 0.9,
+                                  "score": 0.9}},
     }
     return make_record(event, seq=0, t=0.0, **payloads[event])
 

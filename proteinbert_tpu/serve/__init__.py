@@ -40,13 +40,15 @@ docs/serving.md.
 """
 
 from proteinbert_tpu.serve.cache import EmbeddingCache, content_key
-from proteinbert_tpu.serve.dispatch import BucketDispatcher
+from proteinbert_tpu.serve.dispatch import TASK_KIND, BucketDispatcher
 from proteinbert_tpu.serve.errors import (
     DeadlineExceededError,
     QueueFullError,
     SequenceTooLongError,
     ServeError,
     ServerClosedError,
+    TrunkMismatchError,
+    UnknownHeadError,
 )
 from proteinbert_tpu.serve.queue import Request, RequestQueue
 from proteinbert_tpu.serve.scheduler import MicroBatchScheduler
@@ -62,9 +64,12 @@ __all__ = [
     "RequestTrace",
     "EmbeddingCache",
     "content_key",
+    "TASK_KIND",
     "ServeError",
     "QueueFullError",
     "DeadlineExceededError",
     "ServerClosedError",
     "SequenceTooLongError",
+    "UnknownHeadError",
+    "TrunkMismatchError",
 ]

@@ -31,8 +31,9 @@ the same jitted kernels the same padded shapes.
 
 from __future__ import annotations
 
+import threading
 import time
-from typing import Dict, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -41,8 +42,20 @@ import numpy as np
 from proteinbert_tpu.configs import PretrainConfig
 from proteinbert_tpu.data.vocab import EOS_ID, PAD_ID, SOS_ID
 from proteinbert_tpu import inference
+from proteinbert_tpu.heads import apply as heads_apply
+from proteinbert_tpu.heads.registry import LoadedHead, UnknownHeadError
 
 KINDS = ("embed", "predict_go", "predict_residues")
+
+# The dynamic request kind (ISSUE 8): a predict_task request names a
+# REGISTERED HEAD instead of a pretraining output. All predict_task
+# requests — whatever head they carry — share one warm TRUNK executable
+# per (bucket_len, batch_class) ("trunk" entries in `_warm`), plus a
+# cheap per-head tail (heads/apply.head_batch) whose executable is
+# shared by every head of the same structure. Adding a head NEVER adds
+# a trunk compile (the executable-count-stays-flat contract,
+# tests/test_heads.py).
+TASK_KIND = "predict_task"
 
 
 def resolve_buckets(cfg: PretrainConfig, buckets=None) -> Tuple[int, ...]:
@@ -136,7 +149,22 @@ class BucketDispatcher:
             self._shardings = serve_batch_sharding(mesh)
         self._compile_hist = (metrics.histogram("serve_compile_seconds")
                               if metrics is not None else None)
+        # Warm-shape bookkeeping. Mutated by the scheduler thread per
+        # batch and READ (iterated) from client/HTTP threads
+        # (warm_head, trunk_executable_count) — iteration during a
+        # concurrent add is a RuntimeError in CPython, so both sides
+        # take the lock (negligible next to a model call).
         self._warm: set = set()
+        self._warm_lock = threading.Lock()
+        # Registered heads (ISSUE 8): head_id → LoadedHead with params
+        # already on device. Mutated by hot add/remove from client
+        # threads while the scheduler serves — guarded; requests carry
+        # their OWN head reference from admission time, so a removal
+        # only affects new submits (drain semantics, serve/server.py).
+        self.heads: Dict[str, LoadedHead] = {}
+        self._heads_lock = threading.Lock()
+        self.warmup_report: Dict = {"trunk_executables": 0,
+                                    "trunk_s": 0.0, "heads": {}}
 
     # ------------------------------------------------------------ routing
 
@@ -156,6 +184,90 @@ class BucketDispatcher:
         raise ValueError(f"{rows} rows exceed the largest batch class "
                          f"{self.batch_classes[-1]}")
 
+    # ------------------------------------------------------ head registry
+
+    @property
+    def trunk_executable_count(self) -> int:
+        """Warm shared-trunk executables — the number the multi-tenant
+        contract says stays FLAT across head add/remove."""
+        with self._warm_lock:
+            return sum(1 for k in self._warm if k[0] == "trunk")
+
+    def add_head(self, head: LoadedHead, warm: bool = False) -> float:
+        """Register a head for predict_task serving: parameters go to
+        device once, and with `warm=True` (a live server) the head's
+        tail is pre-run against every already-warm trunk shape — the
+        PER-HEAD INCREMENTAL warmup cost, returned in seconds and
+        recorded in `warmup_report["heads"]`. The trunk is never
+        recompiled (asserted by tests/test_heads.py)."""
+        head = LoadedHead(head_id=head.head_id, name=head.name,
+                          task=head.task,
+                          params=jax.device_put(head.params),
+                          meta=head.meta)
+        with self._heads_lock:
+            self.heads[head.head_id] = head
+        return self.warm_head(head) if warm else 0.0
+
+    def remove_head(self, head_id: str) -> LoadedHead:
+        """Unregister a head; raises UnknownHeadError if absent. New
+        submits for it 404 immediately; already-admitted requests hold
+        their own reference and complete normally (drain semantics)."""
+        with self._heads_lock:
+            try:
+                return self.heads.pop(head_id)
+            except KeyError:
+                raise UnknownHeadError(
+                    f"no head {head_id!r} is registered on this "
+                    "server") from None
+
+    def get_head(self, head_id: str) -> LoadedHead:
+        with self._heads_lock:
+            try:
+                return self.heads[head_id]
+            except KeyError:
+                raise UnknownHeadError(
+                    f"no head {head_id!r} is registered on this server; "
+                    f"have {sorted(self.heads)}") from None
+
+    def list_heads(self) -> List[Dict]:
+        with self._heads_lock:
+            return [{"head_id": h.head_id, "name": h.name,
+                     "kind": h.task.kind,
+                     "num_outputs": h.task.num_outputs}
+                    for h in self.heads.values()]
+
+    def _dummy_batch(self, L: int, cls: int):
+        tokens = np.full((cls, L), PAD_ID, np.int32)
+        tokens[:, 0] = SOS_ID
+        tokens[:, 1] = EOS_ID
+        ann = np.zeros((cls, self.cfg.model.num_annotations), np.float32)
+        return tokens, ann
+
+    def warm_head(self, head: LoadedHead) -> float:
+        """Compile one head's tail for every already-warm trunk shape;
+        returns the incremental seconds. The tail is warmed on ZERO
+        dummies of the trunk-output shapes (local (cls, L, C) / global
+        (cls, G) in the compute dtype, pad_mask (cls, L) bool) — the
+        identical tail executable, with NO trunk execution at all, so a
+        control-plane hot add cannot spike the data plane's tail
+        latency. The trunk never compiles here:
+        `trunk_executable_count` is flat across this call."""
+        with self._warm_lock:
+            shapes = sorted({(k[1], k[2]) for k in self._warm
+                             if k[0] == "trunk"})
+        dtype = jnp.dtype(self.cfg.model.dtype)
+        total = 0.0
+        for L, cls in shapes:
+            local = jnp.zeros((cls, L, self.cfg.model.local_dim), dtype)
+            global_ = jnp.zeros((cls, self.cfg.model.global_dim), dtype)
+            pad_mask = jnp.zeros((cls, L), bool)
+            t0 = time.perf_counter()
+            jax.block_until_ready(heads_apply.head_batch(
+                head.params, local, global_, pad_mask, head.task.kind))
+            total += time.perf_counter() - t0
+        self.warmup_report["heads"][head.head_id] = round(total, 6)
+        return total
+
     # ----------------------------------------------------------- execution
 
     def _fn(self, kind: str):
@@ -174,21 +286,26 @@ class BucketDispatcher:
                 jax.device_put(annotations, self._shardings["annotations"]))
 
     def run(self, kind: str, tokens: np.ndarray,
-            annotations: Optional[np.ndarray] = None):
+            annotations: Optional[np.ndarray] = None,
+            heads: Optional[Sequence[LoadedHead]] = None):
         """Run one micro-batch: tokens (r, L) with L a bucket length,
         annotations (r, A) or None. Rows are padded up to the batch
         class, outputs come back trimmed to r on host.
 
         Returns {"global", "local_mean"} for "embed", (r, A) probs for
-        "predict_go", (r, L, V) probs for "predict_residues".
+        "predict_go", (r, L, V) probs for "predict_residues". For
+        "predict_task", `heads` carries row i's LoadedHead and the
+        return is a list of r per-row float32 head outputs (shapes
+        differ between heads of different task kinds).
         """
         result, _ = self.run_timed(kind, tokens, annotations,
-                                   timed=False)
+                                   timed=False, heads=heads)
         return result
 
     def run_timed(self, kind: str, tokens: np.ndarray,
                   annotations: Optional[np.ndarray] = None,
-                  timed: bool = True):
+                  timed: bool = True,
+                  heads: Optional[Sequence[LoadedHead]] = None):
         """`run()` that also returns stage attribution for request
         traces: {"prep_s": pad + device placement, "device_s": model
         call through host fetch (the compile lands here on a cold
@@ -199,6 +316,11 @@ class BucketDispatcher:
         if L not in self.buckets:
             raise ValueError(f"tokens length {L} is not one of the "
                              f"buckets {self.buckets}")
+        if (kind == TASK_KIND) != (heads is not None):
+            raise ValueError(
+                f"kind {kind!r} and heads={'set' if heads is not None else 'None'} "
+                "do not agree: predict_task batches carry per-row heads, "
+                "pretrain kinds never do")
         timings: Dict[str, float] = {}
         t0 = time.perf_counter() if timed else 0.0
         annotations = inference.check_annotations(annotations, rows, self.cfg)
@@ -209,14 +331,26 @@ class BucketDispatcher:
         if rows < cls:
             tokens = np.pad(tokens, ((0, cls - rows), (0, 0)))
             annotations = np.pad(annotations, ((0, cls - rows), (0, 0)))
-        fn = self._fn(kind)
         tb, ab = self._place(tokens, annotations)
         if timed:
             t1 = time.perf_counter()
             timings["prep_s"] = round(t1 - t0, 9)
-        res = fn(self.params, tb, ab, self.cfg.model)
-        self._warm.add((kind, L, cls))
-        out = jax.tree.map(lambda a: np.asarray(a)[:rows], res)
+        if heads is not None:
+            # Multi-tenant path: ONE shared trunk executable for the
+            # whole (possibly mixed-head) batch, then each distinct
+            # head's cheap tail over the full batch — every row keeps
+            # its own head's output (heads/apply.py).
+            trunk_out = heads_apply.trunk_batch(self.params, tb, ab,
+                                                self.cfg.model)
+            with self._warm_lock:
+                self._warm.add(("trunk", L, cls))
+            out = heads_apply.apply_heads(trunk_out, heads)
+        else:
+            fn = self._fn(kind)
+            res = fn(self.params, tb, ab, self.cfg.model)
+            with self._warm_lock:
+                self._warm.add((kind, L, cls))
+            out = jax.tree.map(lambda a: np.asarray(a)[:rows], res)
         if timed:
             timings["device_s"] = round(time.perf_counter() - t1, 9)
         return out, timings
@@ -226,19 +360,29 @@ class BucketDispatcher:
         given kinds so no live request pays a compile; returns how many
         shape classes were warmed. Cost is |kinds| x |buckets| x
         |classes| compiles — keep `kinds` to what the deployment
-        serves (the others compile lazily on first use)."""
+        serves (the others compile lazily on first use).
+
+        The predict_task family warms automatically whenever heads are
+        registered (or "predict_task" is named in `kinds`): the SHARED
+        trunk compiles once per (bucket, class) — counted in the return
+        value and `warmup_report["trunk_executables"]` — and every
+        registered head's tail is pre-run with its per-head incremental
+        cost recorded in `warmup_report["heads"]`. Heads added LATER to
+        a live server never recompile the trunk (`add_head(warm=True)`
+        pays only the tail)."""
         n = 0
+        kinds = tuple(kinds)
         for kind in kinds:
+            if kind == TASK_KIND:
+                continue
             if kind not in KINDS:
                 raise ValueError(f"unknown request kind {kind!r}; "
-                                 f"have {KINDS}")
+                                 f"have {KINDS + (TASK_KIND,)}")
             for L in self.buckets:
                 for cls in self.batch_classes:
                     if (kind, L, cls) in self._warm:
                         continue
-                    dummy = np.full((cls, L), PAD_ID, np.int32)
-                    dummy[:, 0] = SOS_ID
-                    dummy[:, 1] = EOS_ID
+                    dummy, _ = self._dummy_batch(L, cls)
                     if self._compile_hist is not None:
                         t0 = time.perf_counter()
                         self.run(kind, dummy)
@@ -246,6 +390,49 @@ class BucketDispatcher:
                     else:
                         self.run(kind, dummy)
                     n += 1
+        if TASK_KIND in kinds or self.heads:
+            n += self._warmup_task()
+        return n
+
+    def _warmup_task(self) -> int:
+        """Warm the shared trunk once per (bucket, class) and every
+        registered head's tail at each shape; returns NEW trunk
+        executables warmed. Per-head seconds land in
+        `warmup_report["heads"]` — on a warm trunk they are the cost of
+        compiling one tiny matmul tail (and near-zero for a second head
+        of the same structure, which shares the tail executable)."""
+        report = self.warmup_report
+        with self._heads_lock:
+            heads = list(self.heads.values())
+        n = 0
+        for L in self.buckets:
+            for cls in self.batch_classes:
+                tokens, ann = self._dummy_batch(L, cls)
+                tb, ab = self._place(tokens, ann)
+                with self._warm_lock:
+                    new = ("trunk", L, cls) not in self._warm
+                t0 = time.perf_counter()
+                trunk_out = heads_apply.trunk_batch(self.params, tb, ab,
+                                                    self.cfg.model)
+                jax.block_until_ready(trunk_out)
+                dt = time.perf_counter() - t0
+                if new:
+                    with self._warm_lock:
+                        self._warm.add(("trunk", L, cls))
+                    report["trunk_executables"] += 1
+                    report["trunk_s"] = round(report["trunk_s"] + dt, 6)
+                    if self._compile_hist is not None:
+                        self._compile_hist.observe(dt)
+                    n += 1
+                for head in heads:
+                    t0 = time.perf_counter()
+                    jax.block_until_ready(heads_apply.head_batch(
+                        head.params, trunk_out["local"],
+                        trunk_out["global"], trunk_out["pad_mask"],
+                        head.task.kind))
+                    report["heads"][head.head_id] = round(
+                        report["heads"].get(head.head_id, 0.0)
+                        + time.perf_counter() - t0, 6)
         return n
 
     # ------------------------------------------------- offline batch path

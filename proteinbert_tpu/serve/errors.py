@@ -17,6 +17,15 @@ from __future__ import annotations
 # too (the silent-truncation fix) and inference must not depend on serve.
 from proteinbert_tpu.inference import SequenceTooLongError  # noqa: F401
 
+# Same convention for the multi-tenant head errors (ISSUE 8): they live
+# in heads/registry.py because the registry raises them offline too;
+# the serving layer maps UnknownHeadError to a typed 404 ("this head
+# does not exist / was removed") and TrunkMismatchError to a 400 at
+# head-add time ("this head cannot ever be served by this trunk").
+from proteinbert_tpu.heads.registry import (  # noqa: F401
+    TrunkMismatchError, UnknownHeadError,
+)
+
 
 class ServeError(Exception):
     """Base class for all serving-layer rejections."""

@@ -14,14 +14,26 @@ Routes (POST bodies and responses are JSON):
   POST /v1/predict_residues  {"seq", "deadline_ms"?}
        → {"filled": "..."} (probs stay server-side: a (L, V) matrix
          per request is transfer weight, not serving signal)
+  POST /v1/predict_task      {"head_id", "seq", "annotations"?,
+                              "deadline_ms"?}
+       → {"head_id", "outputs": [...]} — one registered head's float32
+         logits/prediction, shaped by its task kind (multi-tenant
+         serving, ISSUE 8); unknown/removed head → typed 404
+  GET  /v1/heads             → {"heads": [{head_id, name, kind, ...}]}
+  POST /v1/heads/add         {"head_id"} → load from the server's
+                             registry (trunk-compat enforced; mismatch
+                             → 400 {"type": "trunk_mismatch"})
+  POST /v1/heads/remove      {"head_id"} → hot-remove (drain: queued
+                             requests for it still complete)
   GET  /healthz              → {"ok": true, "stats": {...}}
   GET  /metrics              → Prometheus textfile (the registry's
                                exposition; empty when telemetry is off)
 
 Typed-error → status mapping (the backpressure contract, visible to
 clients): QueueFullError → 429, DeadlineExceededError → 504,
-ServerClosedError → 503, SequenceTooLongError/ValueError/bad JSON →
-400. `ThreadingHTTPServer` gives one thread per connection; they all
+ServerClosedError → 503, UnknownHeadError → 404,
+TrunkMismatchError/SequenceTooLongError/ValueError/bad JSON → 400.
+`ThreadingHTTPServer` gives one thread per connection; they all
 funnel into the one scheduler through Server.submit, so HTTP
 concurrency IS the micro-batching concurrency.
 """
@@ -34,14 +46,15 @@ from typing import Optional
 
 from proteinbert_tpu.serve.errors import (
     DeadlineExceededError, QueueFullError, SequenceTooLongError,
-    ServerClosedError,
+    ServerClosedError, TrunkMismatchError, UnknownHeadError,
 )
 from proteinbert_tpu.serve.server import Server
 
 _MAX_BODY = 32 * 1024 * 1024  # a seq + an 8943-float annotation vector fit
 
 
-def _result_payload(kind: str, value, top_k: Optional[int]):
+def _result_payload(kind: str, value, top_k: Optional[int],
+                    head_id: Optional[str] = None):
     if kind == "embed":
         return {"global": [float(x) for x in value["global"]],
                 "local_mean": [float(x) for x in value["local_mean"]]}
@@ -49,6 +62,8 @@ def _result_payload(kind: str, value, top_k: Optional[int]):
         if top_k is not None:
             return {"top": [[i, p] for i, p in value]}
         return {"probs": [float(x) for x in value]}
+    if kind == "predict_task":
+        return {"head_id": head_id, "outputs": value.tolist()}
     filled, _probs = value
     return {"filled": filled}
 
@@ -77,6 +92,8 @@ def make_handler(server: Server):
         def do_GET(self):
             if self.path in ("/healthz", "/stats"):
                 self._reply(200, {"ok": True, "stats": server.stats()})
+            elif self.path == "/v1/heads":
+                self._reply(200, {"heads": server.list_heads()})
             elif self.path == "/metrics":
                 text = ""
                 if getattr(server.tele, "metrics", None) is not None:
@@ -94,20 +111,55 @@ def make_handler(server: Server):
             else:
                 self._reply(404, {"error": f"no such route {self.path}"})
 
+        def _read_body(self):
+            length = int(self.headers.get("Content-Length", 0))
+            if not 0 < length <= _MAX_BODY:
+                raise ValueError(f"bad Content-Length {length}")
+            return json.loads(self.rfile.read(length))
+
+        def _head_lifecycle(self, add: bool) -> None:
+            """POST /v1/heads/{add,remove}: hot head management on the
+            live server (the multi-tenant control plane)."""
+            try:
+                body = self._read_body()
+                head_id = body["head_id"]
+                if not isinstance(head_id, str):
+                    raise ValueError("'head_id' must be a string")
+                if add:
+                    server.add_head(head_id)
+                else:
+                    server.remove_head(head_id)
+            except UnknownHeadError as e:
+                self._reply(404, {"error": str(e), "type": "unknown_head"})
+            except TrunkMismatchError as e:
+                self._reply(400, {"error": str(e),
+                                  "type": "trunk_mismatch"})
+            except (KeyError, ValueError, json.JSONDecodeError) as e:
+                self._reply(400, {"error": f"bad request: {e}",
+                                  "type": "bad_request"})
+            else:
+                self._reply(200, {"ok": True, "head_id": head_id,
+                                  "heads": server.list_heads()})
+
         def do_POST(self):
+            if self.path == "/v1/heads/add":
+                self._head_lifecycle(add=True)
+                return
+            if self.path == "/v1/heads/remove":
+                self._head_lifecycle(add=False)
+                return
             route = {"/v1/embed": "embed",
                      "/v1/predict_go": "predict_go",
-                     "/v1/predict_residues": "predict_residues"}
+                     "/v1/predict_residues": "predict_residues",
+                     "/v1/predict_task": "predict_task"}
             kind = route.get(self.path)
             if kind is None:
                 self._reply(404, {"error": f"no such route {self.path}"})
                 return
             request_id = None
+            head_id = None
             try:
-                length = int(self.headers.get("Content-Length", 0))
-                if not 0 < length <= _MAX_BODY:
-                    raise ValueError(f"bad Content-Length {length}")
-                body = json.loads(self.rfile.read(length))
+                body = self._read_body()
                 seq = body["seq"]
                 if not isinstance(seq, str):
                     raise ValueError("'seq' must be a string")
@@ -120,13 +172,23 @@ def make_handler(server: Server):
                 if top_k is not None and (isinstance(top_k, bool)
                                           or not isinstance(top_k, int)):
                     raise ValueError("'top_k' must be an integer")
+                if kind == "predict_task":
+                    head_id = body["head_id"]
+                    if not isinstance(head_id, str):
+                        raise ValueError("'head_id' must be a string")
                 future = server.submit(
                     kind, seq, annotations=body.get("annotations"),
                     deadline_s=(deadline_ms / 1000.0
                                 if deadline_ms is not None else None),
-                    top_k=top_k)
+                    top_k=top_k, head_id=head_id)
                 request_id = getattr(future, "pbt_request_id", None)
                 value = future.result()
+            except UnknownHeadError as e:
+                # The typed 404 of the multi-tenant contract: this head
+                # does not exist on this server (never added, or hot-
+                # removed). Distinct from a route 404 by its body type.
+                self._reply(404, {"error": str(e), "type": "unknown_head"},
+                            getattr(e, "pbt_request_id", request_id))
             except QueueFullError as e:
                 self._reply(429, {"error": str(e), "type": "queue_full"},
                             request_id)
@@ -150,7 +212,8 @@ def make_handler(server: Server):
                 self._reply(500, {"error": f"internal error: {e}",
                                   "type": "internal"}, request_id)
             else:
-                self._reply(200, _result_payload(kind, value, top_k),
+                self._reply(200, _result_payload(kind, value, top_k,
+                                                 head_id),
                             request_id)
 
     return Handler

@@ -56,6 +56,14 @@ class Request:
     cache_key: Optional[str] = None           # None = uncacheable/disabled
     trace: Optional[object] = None            # serve/trace.RequestTrace
                                               # (None = telemetry off)
+    head: Optional[object] = None             # heads/registry.LoadedHead
+                                              # (predict_task only).
+                                              # Resolved at ADMISSION:
+                                              # the request keeps its
+                                              # own reference, so a hot
+                                              # remove_head drains
+                                              # queued work instead of
+                                              # failing it
 
 
 class RequestQueue:

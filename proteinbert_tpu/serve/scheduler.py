@@ -59,6 +59,7 @@ class MicroBatchScheduler:
         max_batch: int = 8,
         max_wait_s: float = 0.01,
         clock=time.monotonic,
+        partition_heads: bool = False,
         telemetry=None,
         latency_observer: Optional[Callable[[float], None]] = None,
         expire_observer: Optional[Callable[[Request], None]] = None,
@@ -74,6 +75,13 @@ class MicroBatchScheduler:
         self.max_batch = int(max_batch)
         self.max_wait_s = float(max_wait_s)
         self.clock = clock
+        # Multi-tenant grouping (ISSUE 8): requests group by
+        # (kind, bucket) ONLY — all predict_task requests share the
+        # kind "predict_task", so one micro-batch MIXES heads through
+        # the shared trunk executable. partition_heads=True appends the
+        # head id to the group key instead (per-head batches) — the
+        # baseline `bench.py --heads` measures the mixed win against.
+        self.partition_heads = bool(partition_heads)
         self.tele = as_telemetry(telemetry)
         self._latency = latency_observer or (lambda s: None)
         # Called per deadline-expired request (scheduler thread): the
@@ -128,7 +136,10 @@ class MicroBatchScheduler:
             for req in items:
                 if req.trace is not None:
                     req.trace.mark_ingested(now)
-                key = (req.kind, req.bucket_len)
+                kind = req.kind
+                if self.partition_heads and req.head is not None:
+                    kind = f"{kind}:{req.head.head_id}"
+                key = (kind, req.bucket_len)
                 group = self._pending.get(key)
                 if group is None:
                     group = self._pending[key] = collections.deque()
@@ -195,7 +206,10 @@ class MicroBatchScheduler:
     # --------------------------------------------------------- dispatch
 
     def _dispatch(self, key: GroupKey, now: float) -> int:
-        kind, bucket_len = key
+        # Under partition_heads the group key's kind carries a
+        # ":<head_id>" suffix; the dispatcher and events see the base
+        # kind (per-row heads travel on the requests themselves).
+        kind, bucket_len = key[0].split(":", 1)[0], key[1]
         with self._pending_lock:
             group = self._pending.get(key)
             if not group:  # raced an abort's fail_pending
@@ -223,6 +237,14 @@ class MicroBatchScheduler:
             for r in batch])
         ctx = {"rows": len(batch), "batch_class": cls,
                "bucket_len": bucket_len}
+        # predict_task rows carry their own LoadedHead (resolved at
+        # admission): pass them through so the dispatcher runs the
+        # shared trunk once and each head's cheap tail per group.
+        heads = ([r.head for r in batch]
+                 if batch[0].head is not None else None)
+        extra = {"heads": heads} if heads is not None else {}
+        if heads is not None:
+            ctx["heads"] = sorted({h.head_id for h in heads})
         t0 = time.perf_counter()
         run0 = self.clock()
         try:
@@ -232,10 +254,12 @@ class MicroBatchScheduler:
             run_timed = (getattr(self.dispatcher, "run_timed", None)
                          if tracing and timed else None)
             if run_timed is not None:
-                result, timings = run_timed(kind, tokens, annotations)
+                result, timings = run_timed(kind, tokens, annotations,
+                                            **extra)
                 ctx.update(timings)
             else:
-                result = self.dispatcher.run(kind, tokens, annotations)
+                result = self.dispatcher.run(kind, tokens, annotations,
+                                             **extra)
         except Exception as e:  # fail THIS batch, keep serving
             logger.exception("batch dispatch failed (%s, L=%d, rows=%d)",
                              kind, bucket_len, len(batch))
@@ -284,7 +308,8 @@ class MicroBatchScheduler:
         self.tele.emit("serve_batch", kind=kind, bucket_len=bucket_len,
                        rows=len(batch), batch_class=cls,
                        batch_seconds=round(dt, 6),
-                       pad_fraction=ctx.get("pad_fraction"))
+                       pad_fraction=ctx.get("pad_fraction"),
+                       heads=ctx.get("heads"))
         return len(batch)
 
     def poll(self, now: Optional[float] = None) -> int:

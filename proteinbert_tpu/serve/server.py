@@ -68,8 +68,11 @@ import numpy as np
 
 from proteinbert_tpu import inference
 from proteinbert_tpu.configs import PretrainConfig
+from proteinbert_tpu.heads.registry import (
+    HeadRegistry, LoadedHead, UnknownHeadError, trunk_fingerprint,
+)
 from proteinbert_tpu.serve.cache import EmbeddingCache, content_key
-from proteinbert_tpu.serve.dispatch import KINDS, BucketDispatcher
+from proteinbert_tpu.serve.dispatch import KINDS, TASK_KIND, BucketDispatcher
 from proteinbert_tpu.serve.errors import (
     SequenceTooLongError, ServerClosedError,
 )
@@ -102,6 +105,9 @@ class Server:
         slos=None,
         slo_profile_dir: Optional[str] = None,
         slo_breach_cooldown_s: float = 60.0,
+        registry=None,
+        heads=None,
+        partition_heads: bool = False,
     ):
         from proteinbert_tpu.obs import as_telemetry
 
@@ -122,9 +128,23 @@ class Server:
         self.scheduler = MicroBatchScheduler(
             self.queue, self.dispatcher, self._finalize,
             max_batch=max_batch, max_wait_s=max_wait_s, clock=clock,
+            partition_heads=partition_heads,
             telemetry=telemetry, latency_observer=self._observe_latency,
             expire_observer=self._count_expiry,
             complete_observer=self._on_complete)
+        # Multi-tenant heads (ISSUE 8): an optional registry to resolve
+        # head ids from, plus the resident trunk's fingerprint computed
+        # LAZILY (one device→host fetch of the whole trunk — only paid
+        # when a head is actually loaded). Every registry load checks
+        # the artifact's trunk_fingerprint against the resident trunk:
+        # a head trained against a different trunk raises the typed
+        # TrunkMismatchError instead of silently serving garbage.
+        if isinstance(registry, str):
+            registry = HeadRegistry(registry)
+        self.registry = registry
+        self._trunk_fp: Optional[str] = None
+        for h in (heads or ()):
+            self.add_head(h)
         # The p50/p99 ring lives in the obs registry (QuantileWindow):
         # /metrics scrapes, stats(), and serve_request events all read
         # the same ring. A disabled registry (NULL telemetry) returns a
@@ -173,7 +193,7 @@ class Server:
         self._latency_h = metrics.histogram("serve_latency_seconds")
         self._truncated_c = metrics.counter("serve_truncated_total")
         self._req_c = {k: metrics.counter("serve_requests_total", kind=k)
-                       for k in KINDS}
+                       for k in KINDS + (TASK_KIND,)}
         from proteinbert_tpu.obs.events import SERVE_REJECT_REASONS
 
         self._rej_c = {r: metrics.counter("serve_rejected_total", reason=r)
@@ -217,10 +237,59 @@ class Server:
                      if self.slo else []),
             "mesh": (dict(self.dispatcher.mesh.shape)
                      if self.dispatcher.mesh is not None else None),
+            "heads": sorted(self.dispatcher.heads),
+            "warmup": self.dispatcher.warmup_report,
         })
         self.scheduler.start()
         self._started = True
         return self
+
+    # -------------------------------------------------- multi-tenant heads
+
+    def trunk_fp(self) -> str:
+        """The resident trunk's fingerprint (computed once); the value
+        every registry load is checked against."""
+        if self._trunk_fp is None:
+            self._trunk_fp = trunk_fingerprint(self.dispatcher.params)
+        return self._trunk_fp
+
+    def add_head(self, head) -> str:
+        """Hot-add a head to a (possibly live) server: a head id
+        resolved through the registry (trunk-compatibility ENFORCED —
+        TrunkMismatchError if it was trained against a different
+        trunk), or an already-LoadedHead (trusted: in-process producers
+        like tests/bench build these directly). On a live server the
+        head's tail is warmed incrementally; the trunk is never
+        recompiled. Returns the head id."""
+        if isinstance(head, str):
+            if self.registry is None:
+                raise UnknownHeadError(
+                    f"cannot resolve head id {head!r}: this server has "
+                    "no registry (pass registry= or a LoadedHead)")
+            head = self.registry.load(head, trunk_fp=self.trunk_fp())
+        assert isinstance(head, LoadedHead)
+        warm_s = self.dispatcher.add_head(
+            head, warm=getattr(self, "_started", False))
+        self.tele.emit("note", source="serve", kind="head_added",
+                       head_id=head.head_id, name=head.name,
+                       task=head.task.kind,
+                       incremental_warmup_s=round(warm_s, 6))
+        return head.head_id
+
+    def remove_head(self, head_id: str) -> None:
+        """Hot-remove a head: new submits for it get the typed
+        UnknownHeadError (HTTP 404) immediately; already-admitted
+        requests carry their own head reference and complete normally
+        (drain semantics — tests/test_heads.py exercises this under
+        concurrent traffic)."""
+        head = self.dispatcher.remove_head(head_id)
+        self.tele.emit("note", source="serve", kind="head_removed",
+                       head_id=head.head_id, name=head.name)
+
+    def list_heads(self):
+        """[{head_id, name, kind, num_outputs}] of the currently
+        servable heads."""
+        return self.dispatcher.list_heads()
 
     def __enter__(self) -> "Server":
         return self.start()
@@ -277,18 +346,26 @@ class Server:
 
     def submit(self, kind: str, seq: str, annotations=None,
                deadline_s: Optional[float] = None,
-               top_k: Optional[int] = None) -> Future:
+               top_k: Optional[int] = None,
+               head_id: Optional[str] = None) -> Future:
         """Enqueue one request; returns its future (which carries the
         trace id as `.pbt_request_id` when tracing is on). Raises
         SequenceTooLongError (on_long="reject", or a '?' beyond the
-        window for predict_residues) and ServerClosedError
-        synchronously; QueueFullError / DeadlineExceededError land on
-        futures (the evicted/expired request's, which may be an earlier
-        caller's — never silently dropped)."""
-        if kind not in KINDS:
-            raise ValueError(f"unknown request kind {kind!r}; have {KINDS}")
+        window for predict_residues), UnknownHeadError (predict_task
+        for an unregistered/removed head — the typed 404), and
+        ServerClosedError synchronously; QueueFullError /
+        DeadlineExceededError land on futures (the evicted/expired
+        request's, which may be an earlier caller's — never silently
+        dropped)."""
+        if kind not in KINDS and kind != TASK_KIND:
+            raise ValueError(f"unknown request kind {kind!r}; have "
+                             f"{KINDS + (TASK_KIND,)}")
         if not seq:
             raise ValueError("empty sequence")
+        if (kind == TASK_KIND) != (head_id is not None):
+            raise ValueError(
+                f"head_id is required for kind {TASK_KIND!r} and invalid "
+                "for every other kind")
         now0 = self.clock()
         trace = None
         if self.trace_sample_rate is not None:
@@ -296,6 +373,23 @@ class Server:
             trace = RequestTrace(
                 f"{self._id_prefix}{n:x}", kind, now0,
                 sampled=stride_sampled(n, self.trace_sample_rate))
+            trace.head_id = head_id
+        head = None
+        if kind == TASK_KIND:
+            try:
+                head = self.dispatcher.get_head(head_id)
+            except UnknownHeadError as exc:
+                # Typed 404: the head was never added or was hot-
+                # removed. Counted + traced like every other rejection.
+                self._rej_c["unknown_head"].inc()
+                self._bump("rejected_total", "unknown_head")
+                self.tele.emit("serve_reject", reason="unknown_head",
+                               kind=kind, queue_depth=len(self.queue),
+                               head_id=head_id)
+                self._seal(trace, "rejected", self.clock())
+                if trace is not None:
+                    exc.pbt_request_id = trace.request_id
+                raise
         window = self.cfg.data.seq_len - 2
         if len(seq) > window:
             if (self.on_long == "reject"
@@ -335,7 +429,12 @@ class Server:
         if self.cache.capacity:
             if trace is not None:
                 trace.cache = "miss"
-            key = content_key(kind, seq, annotations)
+            # A head id is content-addressed over its weights + task +
+            # trunk, so including it keys cached task results to the
+            # exact model that produced them.
+            key = content_key(kind if head is None
+                              else f"{kind}:{head.head_id}",
+                              seq, annotations)
             hit = self.cache.get(key)
             if hit is not None:
                 self._bump("cache_hit_returns")
@@ -356,7 +455,7 @@ class Server:
             kind=kind, seq=seq, tokens=tokens, bucket_len=bucket_len,
             future=future, enqueued_at=now, annotations=annotations,
             deadline=(now + deadline_s if deadline_s is not None else None),
-            top_k=top_k, cache_key=key, trace=trace)
+            top_k=top_k, cache_key=key, trace=trace, head=head)
         try:
             evicted = self.queue.push(req)
         except ServerClosedError as exc:
@@ -406,6 +505,20 @@ class Server:
         return self.submit("predict_residues", seq,
                            deadline_s=deadline_s).result(timeout)
 
+    def predict_task(self, head_id: str, seq: str, annotations=None,
+                     timeout: Optional[float] = None,
+                     deadline_s: Optional[float] = None) -> np.ndarray:
+        """One registered head's float32 output for one sequence:
+        (L, num_outputs) logits for token_classification,
+        (num_outputs,) logits for sequence_classification, (1,) value
+        for sequence_regression — the serving form of
+        heads/apply.predict_task_rows. The request rides whatever
+        micro-batch is forming for its bucket, alongside requests for
+        OTHER heads (one shared trunk pass, per-head tails)."""
+        return self.submit(TASK_KIND, seq, annotations,
+                           deadline_s=deadline_s,
+                           head_id=head_id).result(timeout)
+
     # ------------------------------------------------------- finalization
 
     def _present(self, kind: str, value, top_k: Optional[int]):
@@ -424,7 +537,7 @@ class Server:
         if req.kind == "embed":
             value = {"global": np.asarray(row["global"]),
                      "local_mean": np.asarray(row["local_mean"])}
-        elif req.kind == "predict_go":
+        elif req.kind in ("predict_go", TASK_KIND):
             value = np.asarray(row)
         else:  # predict_residues: fill '?' via the argmax amino acid
             probs = np.asarray(row)
@@ -511,6 +624,7 @@ class Server:
         out = {
             "completed": self.completed_total,
             **mirrors,
+            "heads": len(self.dispatcher.heads),
             "batches": self.scheduler.batches_total,
             "batched_rows": self.scheduler.rows_total,
             "queue_depth": len(self.queue),

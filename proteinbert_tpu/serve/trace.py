@@ -67,7 +67,7 @@ class RequestTrace:
         "t_submit", "t_enqueued", "t_ingested", "t_popped",
         "t_run0", "t_run1", "t_done",
         "bucket_len", "batch_class", "rows", "pad_fraction",
-        "prep_s", "device_s", "cache", "outcome", "error",
+        "prep_s", "device_s", "cache", "outcome", "error", "head_id",
     )
 
     def __init__(self, request_id: str, kind: str, now: float,
@@ -94,6 +94,10 @@ class RequestTrace:
         self.cache: str = "off"          # off | miss | hit
         self.outcome: Optional[str] = None
         self.error: Optional[str] = None
+        self.head_id: Optional[str] = None  # predict_task tenant id —
+                                            # per-head latency/error
+                                            # attribution in
+                                            # `pbt diagnose --serve`
 
     # ------------------------------------------------------------ marks
 
@@ -205,7 +209,7 @@ class RequestTrace:
             "sampled": self.sampled,
         }
         for name in ("bucket_len", "batch_class", "rows", "pad_fraction",
-                     "prep_s", "device_s", "error"):
+                     "prep_s", "device_s", "error", "head_id"):
             v = getattr(self, name)
             if v is not None:
                 fields[name] = v
@@ -219,6 +223,8 @@ class RequestTrace:
         tid = zlib.crc32(self.request_id.encode()) & 0x7FFFFFFF
         base_args = {"request_id": self.request_id, "kind": self.kind,
                      "outcome": self.outcome or "ok"}
+        if self.head_id is not None:
+            base_args["head_id"] = self.head_id
         if self.bucket_len is not None:
             base_args["bucket_len"] = self.bucket_len
         if self.batch_class is not None:

@@ -158,14 +158,28 @@ def finetune(
     checkpointer=None,                  # train.checkpoint.Checkpointer
     log_fn=None,
     telemetry=None,                     # obs.Telemetry (None = no-op)
+    registry=None,                      # heads.HeadRegistry (opt-in: save
+                                        # the trained head as a servable
+                                        # artifact — ISSUE 8)
+    register_name: Optional[str] = None,
 ) -> Dict[str, Any]:
-    """Epoch loop; returns {"state", "history", "best"}.
+    """Epoch loop; returns {"state", "history", "best"} (+ "head_id"
+    when a `registry` is given).
 
     `best` tracks the best eval epoch by accuracy (classification) or
     -loss (regression), and with a `checkpointer` each epoch's state is
     saved (epoch number as the step) — the per-epoch-checkpoint +
     model-selection design of the reference's sketch (reference
     utils.py:442-458).
+
+    With `registry`, the trained head is saved as a content-addressed
+    artifact carrying the fingerprint of the trunk it was ACTUALLY
+    trained against (post-training — with freeze_trunk that equals the
+    pretrained trunk, so the head serves directly over the resident
+    trunk; without it the fingerprint records the co-trained trunk and
+    serving over a different one raises the typed TrunkMismatchError
+    instead of silently producing garbage), plus the best eval metrics;
+    a `head_registered` event lands on the telemetry stream.
     """
     from proteinbert_tpu.obs import as_telemetry
 
@@ -242,8 +256,38 @@ def finetune(
 
     if checkpointer is not None:
         checkpointer.wait()
+
+    head_id = None
+    if registry is not None:
+        import numpy as np
+
+        from proteinbert_tpu.heads.registry import trunk_fingerprint
+
+        # Fingerprint the trunk the head was trained AGAINST (the
+        # post-training trunk: identical to the pretrained one under
+        # freeze_trunk, the co-trained one otherwise) — the serving
+        # side's compatibility check compares resident-trunk
+        # fingerprints against exactly this value.
+        fp = trunk_fingerprint(state.params["trunk"])
+        metrics = {k: v for k, v in (history[-1] if history else {}).items()
+                   if isinstance(v, (int, float))}
+        metrics.update({k: v for k, v in best.items()
+                        if k.startswith(("eval_", "train_"))
+                        and isinstance(v, (int, float))})
+        head_id = registry.save(
+            jax.tree.map(np.asarray, state.params["head"]),
+            cfg.task, fp, name=register_name, metrics=metrics,
+            model={"local_dim": cfg.model.local_dim,
+                   "global_dim": cfg.model.global_dim})
+        tele.emit("head_registered", head_id=head_id, kind=cfg.task.kind,
+                  name=register_name or head_id, trunk_fingerprint=fp,
+                  metrics=metrics)
+        logger.info("registered head %s (%s) in %s", head_id,
+                    cfg.task.kind, registry.directory)
+
     # (emit sanitizes: a never-evaluated best's -inf score becomes null)
     tele.emit("run_end", outcome="completed", kind="finetune",
               perf={"best_epoch": best["epoch"],
                     "best_score": best["score"]})
-    return {"state": state, "history": history, "best": best}
+    return {"state": state, "history": history, "best": best,
+            "head_id": head_id}

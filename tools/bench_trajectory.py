@@ -149,6 +149,13 @@ _EVENT_METRICS = (
     ("serve_capture", "served_requests_per_sec", "serve_requests_per_sec"),
     ("serve_capture", "speedup_x", "serve_speedup_x"),
     ("pack_capture", "effective_speedup_x", "pack_effective_speedup_x"),
+    # Multi-tenant heads (ISSUE 8): mixed-head throughput + the WORST
+    # normalized downstream-eval score across heads — finetune-quality
+    # regressions gate through the same sentinel as perf.
+    ("heads_capture", "mixed_requests_per_sec",
+     "heads_mixed_requests_per_sec"),
+    ("heads_capture", "mixed_speedup_x", "heads_mixed_speedup_x"),
+    ("heads_capture", "eval_score_min", "heads_eval_score_min"),
 )
 
 

@@ -67,6 +67,21 @@ timeout -k 10 420 env JAX_PLATFORMS=cpu \
 rcs=$?
 [ "$rc" -eq 0 ] && rc=$rcs
 
+# Multi-tenant heads smoke (ISSUE 8 satellite): the platform loop end
+# to end — tiny finetune → register into a head registry → serve one
+# mixed-head micro-batch through the shared trunk → downstream eval.
+# Contract failures (mixed-batch parity, trunk-recompile-on-add, lost
+# requests, schema-invalid events) exit nonzero and fail the gate; the
+# mixed-vs-partitioned throughput is reported, not gated.
+echo "=== heads smoke (finetune → register → mixed serve → eval, CPU) ==="
+timeout -k 10 420 env JAX_PLATFORMS=cpu \
+  PBT_HEADS_BENCH_SEQ_LEN=96 PBT_HEADS_BENCH_DIM=32 \
+  PBT_HEADS_BENCH_REQUESTS=36 PBT_HEADS_BENCH_CLIENTS=9 \
+  PBT_HEADS_BENCH_ROUNDS=2 \
+  python "$(dirname "$0")/../bench.py" --heads
+rch=$?
+[ "$rc" -eq 0 ] && rc=$rch
+
 if [ "$PACKED_MD" = "1" ]; then
   echo "=== packed multi-device parity tier (8 virtual devices, opt-in) ==="
   timeout -k 10 900 env JAX_PLATFORMS=cpu PBT_RUN_PACKED_MD=1 \

@@ -65,6 +65,16 @@ def self_test() -> int:
          "objective": "latency_e2e"},  # missing burn_rate
         {"v": 1, "event": "slo_breach", "seq": 0, "t": 0.0,
          "objective": "latency_e2e", "burn_rate": float("nan")},
+        # multi-tenant head registry (ISSUE 8):
+        {"v": 1, "event": "head_registered", "seq": 0, "t": 0.0,
+         "kind": "token_classification"},  # missing head_id
+        {"v": 1, "event": "head_eval", "seq": 0, "t": 0.0,
+         "head_id": "a1b2", "metrics": {"score": [0.5]}},  # non-scalar
+        {"v": 1, "event": "serve_request", "seq": 0, "t": 0.0,
+         "kind": "predict_task", "outcome": "ok", "request_id": "r1",
+         "stages": {}, "head_id": 17},  # head_id must be a string
+        {"v": 1, "event": "serve_reject", "seq": 0, "t": 0.0,
+         "reason": "no_such_reason"},  # unknown_head is valid; this isn't
     ]
     for rec in bad:
         try:

@@ -761,7 +761,289 @@ def run_pack():
     print(json.dumps(record))
 
 
-def run_serve():
+def parse_length_mix(spec):
+    """`--serve-length-mix` spec → (median, sigma, seed) for the
+    log-normal request-length population (clamped to the model window
+    downstream). Accepts 'median=48,sigma=0.6,seed=7' with any subset
+    of keys; None means 'use the historical defaults' (median
+    seq_len//10, sigma 0.45, seed 0 — byte-identical traffic to every
+    earlier capture)."""
+    out = {"median": None, "sigma": 0.45, "seed": 0}
+    if spec:
+        for part in spec.split(","):
+            if not part:
+                continue
+            key, _, val = part.partition("=")
+            key = key.strip()
+            if key not in out:
+                raise SystemExit(
+                    f"--serve-length-mix: unknown key {key!r} "
+                    f"(have {sorted(out)})")
+            out[key] = float(val) if key == "sigma" else int(float(val))
+    return out["median"], out["sigma"], out["seed"]
+
+
+def _serve_ragged_ab(Server, params, cfg, seqs, max_batch, max_wait_s,
+                     n_clients, failures):
+    """Phase 4 of `bench.py --serve` (ISSUE 9): bucketed vs ragged
+    packed serving on IDENTICAL traffic. Gates (appended to `failures`):
+    per-request parity within the documented jitted ≤1e-5 tolerance,
+    no lost requests, ragged warm-executable count O(kinds). Reports:
+    sustained requests/s per mode (median over interleaved rounds),
+    executable/warmup accounting, and pad_wasted (pad_fraction-weighted
+    execute seconds) per mode from the serve_batch event streams."""
+    import shutil
+    import tempfile
+    import threading
+    from statistics import median as _median
+
+    from proteinbert_tpu.obs import Telemetry, read_events
+
+    rounds = int(os.environ.get("PBT_SERVE_BENCH_RAGGED_ROUNDS", 3))
+    # Ragged row count: the executable's fixed (rows, seq_len) grid
+    # should hold about the same REQUEST count per dispatch as the
+    # bucketed max_batch does at the traffic's typical span — a grid
+    # sized for max_batch full-length rows would run mostly-empty at
+    # short-sequence loads and pay full-grid FLOPs for it (the
+    # capacity-matching rule, docs/serving.md "ragged batching").
+    seq_len = cfg.data.seq_len
+    buckets = np.asarray(cfg.data.buckets or (seq_len,))
+    spans = buckets[np.searchsorted(
+        buckets, np.minimum([len(s) + 2 for s in seqs], seq_len))]
+    auto_rows = int(np.clip(round(max_batch * float(spans.mean())
+                                  / seq_len), 1, max_batch))
+    ragged_rows = int(os.environ.get("PBT_SERVE_BENCH_RAGGED_ROWS",
+                                     auto_rows))
+    # The dense span ladder: in ragged mode the bucket set is purely a
+    # span-quantization rule (the compiled shape stays (rows, seq_len)),
+    # so a ladder 2x denser than the compiled bucketed one costs ZERO
+    # executables — the pad_wasted lever. Its numerics are gated against
+    # the offline dense-bucketed reference below (same span semantics).
+    step = int(buckets[0])
+    dense_buckets = tuple(range(step, seq_len + 1, step))
+    if dense_buckets[-1] != seq_len:
+        dense_buckets = dense_buckets + (seq_len,)
+    tdir = tempfile.mkdtemp(prefix="pbt_serve_ragged_")
+    arms = (("bucketed", "bucketed", None),
+            ("ragged", "ragged", None),
+            ("ragged_dense", "ragged", dense_buckets))
+    servers, teles, warm = {}, {}, {}
+    for name, mode, arm_buckets in arms:
+        tele = Telemetry(events_path=os.path.join(tdir, f"{name}.jsonl"))
+        srv = Server(params, cfg, buckets=arm_buckets,
+                     max_batch=(ragged_rows if mode == "ragged"
+                                else max_batch),
+                     max_wait_s=max_wait_s, queue_depth=4 * len(seqs),
+                     cache_size=0, warm_kinds=("embed",), telemetry=tele,
+                     trace_sample_rate=0.0, serve_mode=mode)
+        # Timed batches: pad_fraction lands on every serve_batch event
+        # (the pad_wasted accounting below); sampled-out traces keep
+        # the per-request hot path at its measured <1% cost.
+        srv.scheduler.time_batches = True
+        t0 = time.perf_counter()
+        srv.start()
+        warm[name] = round(time.perf_counter() - t0, 2)
+        servers[name], teles[name] = srv, tele
+
+    def run_load(srv, clients):
+        results = {}
+
+        def client(worker):
+            for i in range(worker, len(seqs), clients):
+                try:
+                    results[i] = srv.embed(seqs[i], timeout=120)
+                except Exception as e:  # noqa: BLE001 — report, don't hang
+                    failures.append(f"ragged A/B request {i}: "
+                                    f"{type(e).__name__}: {e}")
+        threads = [threading.Thread(target=client, args=(w,))
+                   for w in range(clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(300)
+        dt = time.perf_counter() - t0
+        deadline = time.monotonic() + 5.0
+        prev = -1
+        while time.monotonic() < deadline:  # quiesce (phase 2's rule)
+            cur = srv.scheduler.rows_total
+            if (cur == prev and len(srv.queue) == 0
+                    and srv.scheduler.pending_rows() == 0):
+                break
+            prev = cur
+            time.sleep(0.02)
+        return results, dt
+
+    # Warm pass per mode (its results double as the parity population —
+    # per-request outputs are independent of batch composition in both
+    # modes), then interleaved measured rounds.
+    ref = {}
+    for mode, srv in servers.items():
+        ref[mode], _ = run_load(srv, n_clients)
+        if len(ref[mode]) != len(seqs):
+            failures.append(
+                f"ragged A/B ({mode}): lost requests — "
+                f"{len(seqs) - len(ref[mode])} of {len(seqs)} never "
+                "resolved")
+    rps = {m: [] for m in servers}
+    for _ in range(rounds):
+        for mode, srv in servers.items():
+            res, dt = run_load(srv, n_clients)
+            rps[mode].append(len(res) / dt)
+
+    # ---- parity gates (deterministic numerics, so GATED) -------------
+    # (a) matched-ladder ragged vs the live bucketed server, per
+    # request; (b) dense-ladder ragged vs the OFFLINE dense-bucketed
+    # reference (`inference.embed(bucketed=True)` at the dense ladder —
+    # same span semantics, compiled the classic way).
+    from proteinbert_tpu import inference as _inf
+
+    dense_offline = _inf.embed(params, cfg, seqs, bucketed=True,
+                               buckets=dense_buckets,
+                               batch_size=max_batch)
+
+    def parity_of(get_ref, name):
+        checked = within = bit = 0
+        max_diff = 0.0
+        for i in range(len(seqs)):
+            b, r = get_ref(i), ref[name].get(i)
+            if b is None or r is None:
+                continue  # the lost-request failure above already fired
+            checked += 1
+            ok = True
+            for k in ("global", "local_mean"):
+                max_diff = max(max_diff,
+                               float(np.abs(b[k] - r[k]).max()))
+                if not np.allclose(b[k], r[k], atol=1e-5, rtol=1e-5):
+                    ok = False
+            within += ok
+            bit += all(np.array_equal(b[k], r[k])
+                       for k in ("global", "local_mean"))
+        return {"checked": checked, "within_tolerance": within,
+                "bit_identical": bit,
+                "max_abs_diff": float(f"{max_diff:.3e}")}
+
+    parity = parity_of(ref["bucketed"].get, "ragged")
+    if parity["within_tolerance"] != parity["checked"]:
+        failures.append(
+            f"ragged parity broke: "
+            f"{parity['checked'] - parity['within_tolerance']}"
+            f"/{parity['checked']} requests outside the documented "
+            f"1e-5 tolerance (max |diff| {parity['max_abs_diff']:.2e})")
+    parity_dense = parity_of(
+        lambda i: {k: dense_offline[k][i]
+                   for k in ("global", "local_mean")}, "ragged_dense")
+    if parity_dense["within_tolerance"] != parity_dense["checked"]:
+        failures.append(
+            f"dense-ladder ragged parity vs the offline dense-bucketed "
+            f"reference broke: "
+            f"{parity_dense['checked'] - parity_dense['within_tolerance']}"
+            f"/{parity_dense['checked']} outside 1e-5 "
+            f"(max |diff| {parity_dense['max_abs_diff']:.2e})")
+
+    stats = {m: servers[m].stats() for m in servers}
+    # O(kinds) executable gate: one warm kind ("embed") must mean ONE
+    # ragged executable — deterministic, so gated (unlike wall-clock) —
+    # for BOTH ladders (the dense ladder must cost zero executables).
+    for name in ("ragged", "ragged_dense"):
+        if stats[name]["executables"] > 1:
+            failures.append(
+                f"{name} executable count {stats[name]['executables']} "
+                "> O(kinds)=1 for the single warmed kind")
+    for srv in servers.values():
+        srv.drain(timeout=60)
+    for tele in teles.values():
+        tele.close()
+
+    def pad_stats(mode):
+        recs = [r for r in read_events(
+            os.path.join(tdir, f"{mode}.jsonl"), strict=True)
+            if r["event"] == "serve_batch"]
+        exec_s = sum(r.get("batch_seconds") or 0.0 for r in recs)
+        pad_s = sum((r.get("pad_fraction") or 0.0)
+                    * (r.get("batch_seconds") or 0.0) for r in recs)
+        pads = [r["pad_fraction"] for r in recs
+                if isinstance(r.get("pad_fraction"), (int, float))]
+        segs = [r["segments"] for r in recs
+                if isinstance(r.get("segments"), int)]
+        return {
+            "batches": len(recs),
+            "execute_s": round(exec_s, 4),
+            "pad_wasted_s": round(pad_s, 4),
+            "pad_wasted_share": (round(pad_s / exec_s, 4)
+                                 if exec_s else None),
+            "mean_pad_fraction": (round(sum(pads) / len(pads), 4)
+                                  if pads else None),
+            "mean_segments_per_batch": (round(sum(segs) / len(segs), 2)
+                                        if segs else None),
+        }
+
+    per_mode = {}
+    for name in servers:
+        per_mode[name] = {
+            "requests_per_sec": round(_median(rps[name]), 2),
+            "rps_per_round": [round(v, 2) for v in rps[name]],
+            "executables": stats[name]["executables"],
+            "warmup_s": warm[name],
+            "warmup_seconds_gauge": stats[name]["warmup_seconds"],
+            "batches": stats[name]["batches"],
+            "pad": pad_stats(name),
+        }
+    shutil.rmtree(tdir, ignore_errors=True)
+    speedup = (per_mode["ragged"]["requests_per_sec"]
+               / max(per_mode["bucketed"]["requests_per_sec"], 1e-9))
+    speedup_dense = (per_mode["ragged_dense"]["requests_per_sec"]
+                     / max(per_mode["bucketed"]["requests_per_sec"],
+                           1e-9))
+    return {
+        "rounds": rounds,
+        "requests": len(seqs),
+        "ragged_rows": ragged_rows,
+        "mean_span": round(float(spans.mean()), 1),
+        "dense_buckets": list(dense_buckets),
+        "bucketed": per_mode["bucketed"],
+        "ragged": per_mode["ragged"],
+        "ragged_dense": per_mode["ragged_dense"],
+        # Wall-clock: REPORTED, not gated (the CPU capture for the
+        # ≥1.2x acceptance claim lives in docs/performance.md).
+        "ragged_speedup_x": round(speedup, 2),
+        "ragged_dense_speedup_x": round(speedup_dense, 2),
+        "speedup_ge_1_2x": bool(max(speedup, speedup_dense) >= 1.2),
+        "parity": parity,
+        "parity_dense": parity_dense,
+    }
+
+
+def _mirror_ragged_note(record):
+    """Best-effort mirror of the ragged A/B capture onto the shared
+    bench event stream (the sentinel's input)."""
+    try:
+        from proteinbert_tpu.obs.events import EventLog
+
+        ab = record["ragged_ab"]
+        ev = EventLog(os.path.join(os.path.dirname(LAST_GOOD_PATH),
+                                   "bench_events.jsonl"))
+        ev.emit("note", source="bench", kind="serve_ragged_capture",
+                platform=record["platform"], seq_len=record["seq_len"],
+                n_requests=record["n_requests"],
+                ragged_speedup_x=ab["ragged_speedup_x"],
+                bucketed_rps=ab["bucketed"]["requests_per_sec"],
+                ragged_rps=ab["ragged"]["requests_per_sec"],
+                bucketed_executables=ab["bucketed"]["executables"],
+                ragged_executables=ab["ragged"]["executables"],
+                bucketed_pad_wasted_share=(
+                    ab["bucketed"]["pad"]["pad_wasted_share"]),
+                ragged_pad_wasted_share=(
+                    ab["ragged"]["pad"]["pad_wasted_share"]),
+                parity_within_tolerance=ab["parity"]["within_tolerance"],
+                parity_checked=ab["parity"]["checked"],
+                failures=len(record["failures"]))
+        ev.close()
+    except Exception as e:
+        print(f"bench events stream unavailable: {e}", file=sys.stderr)
+
+
+def run_serve(length_mix=None):
     """`bench.py --serve`: sustained-load online serving vs the
     one-request-at-a-time offline baseline — one JSON line, CPU-
     measurable (ISSUE 5 acceptance).
@@ -795,10 +1077,32 @@ def run_serve():
     capture is mirrored as a `note` on bench_events.jsonl like the
     other sweeps.
 
+    4. **ragged A/B** (ISSUE 9) — the SAME mixed-length population
+       through a bucketed server and a ragged packed server
+       (`serve_mode="ragged"`: requests pack into fixed-shape
+       (max_batch, seq_len) rows, one warm executable per kind).
+       GATED: every ragged per-request output matches the bucketed
+       dispatcher's within the documented jitted ≤1e-5 tolerance
+       (bucket-quantized spans — docs/serving.md), no request lost,
+       ragged warm-executable count stays O(kinds). REPORTED: the
+       sustained-load speedup (the ≥1.2x acceptance capture), warm
+       executable counts, warmup seconds, and per-mode `pad_wasted`
+       (pad_fraction-weighted execute seconds) from the serve_batch
+       streams.
+
+    `length_mix` (--serve-length-mix 'median=48,sigma=0.9,seed=7')
+    reshapes the log-normal request-length population so the benchmark
+    measures the padding waste ragged serving exists to remove; default
+    traffic is byte-identical to earlier captures.
+
+    PBT_SERVE_BENCH_PHASES selects phases: "all" (default), "core"
+    (1-3 only — the historical smoke), "ragged" (phase 4 only — the
+    tier-1 ragged stage).
+
     Knobs: PBT_SERVE_BENCH_SEQ_LEN (512), PBT_SERVE_BENCH_DIM (64),
     PBT_SERVE_BENCH_REQUESTS (96), PBT_SERVE_BENCH_CLIENTS (16),
     PBT_SERVE_BENCH_MAX_BATCH (8), PBT_SERVE_BENCH_TRACE_ROUNDS (5),
-    PBT_SERVE_BENCH_MEDIAN_LEN
+    PBT_SERVE_BENCH_RAGGED_ROUNDS (3), PBT_SERVE_BENCH_MEDIAN_LEN
     (seq_len // 8).
     """
     import threading
@@ -817,6 +1121,14 @@ def run_serve():
     from proteinbert_tpu.data.vocab import ALPHABET
     from proteinbert_tpu.serve import QueueFullError, Server
     from proteinbert_tpu.train import create_train_state
+
+    phases_env = os.environ.get("PBT_SERVE_BENCH_PHASES", "all").strip()
+    wanted = ({"core", "ragged"} if phases_env == "all"
+              else {p for p in phases_env.split(",") if p})
+    bad = wanted - {"core", "ragged"}
+    if bad or not wanted:
+        raise SystemExit(f"PBT_SERVE_BENCH_PHASES must name phases from "
+                         f"core,ragged or 'all'; got {phases_env!r}")
 
     seq_len = int(os.environ.get("PBT_SERVE_BENCH_SEQ_LEN", 512))
     dim = int(os.environ.get("PBT_SERVE_BENCH_DIM", 64))
@@ -839,13 +1151,45 @@ def run_serve():
         train=TrainConfig(max_steps=1))
     params = create_train_state(jax.random.PRNGKey(0), cfg).params
 
-    # UniRef-like ragged lengths, clipped to the model window.
-    rng = np.random.default_rng(0)
+    # UniRef-like ragged lengths, clipped to the model window. With no
+    # --serve-length-mix this is BYTE-IDENTICAL traffic to every
+    # earlier capture (median seq_len//10, sigma 0.45, seed 0).
+    mix_median, mix_sigma, mix_seed = parse_length_mix(length_mix)
+    if mix_median is None:
+        mix_median = median
+    else:
+        median = mix_median
+    rng = np.random.default_rng(mix_seed)
     lengths = np.clip(
-        rng.lognormal(mean=np.log(median), sigma=0.45, size=n_requests),
+        rng.lognormal(mean=np.log(mix_median), sigma=mix_sigma,
+                      size=n_requests),
         10, seq_len - 2).astype(np.int64)
     alphabet = np.array(list(ALPHABET))
     seqs = ["".join(rng.choice(alphabet, size=int(L))) for L in lengths]
+
+    if "core" not in wanted:
+        # Ragged-only run (the tier-1 ragged smoke stage): skip the
+        # baseline/tracing/overflow phases and gate just the ragged
+        # A/B contracts.
+        failures = []
+        ragged_ab = _serve_ragged_ab(Server, params, cfg, seqs, max_batch,
+                                     max_wait_s, n_clients, failures)
+        record = {
+            "metric": "serve_ragged",
+            "platform": jax.devices()[0].platform,
+            "seq_len": seq_len, "model_dim": dim, "median_len": median,
+            "length_sigma": mix_sigma, "buckets": list(buckets),
+            "max_batch": max_batch, "n_requests": n_requests,
+            "ragged_ab": ragged_ab,
+            "failures": failures,
+        }
+        _mirror_ragged_note(record)
+        print(json.dumps(record))
+        if failures:
+            for f in failures:
+                print(f"SERVE CONTRACT FAILURE: {f}", file=sys.stderr)
+            sys.exit(1)
+        return
 
     # ---- phase 1: sequential single-request offline baseline ----------
     inference.embed(params, cfg, [seqs[0]], batch_size=1)  # compile
@@ -1153,10 +1497,16 @@ def run_serve():
     if resolved != len(burst):
         failures.append("overflow burst had silently dropped requests")
 
+    # ---- phase 4: ragged packed serving A/B (ISSUE 9) -----------------
+    ragged_ab = (_serve_ragged_ab(Server, params, cfg, seqs, max_batch,
+                                  max_wait_s, n_clients, failures)
+                 if "ragged" in wanted else None)
+
     record = {
         "metric": "serve_load",
         "platform": jax.devices()[0].platform,
         "seq_len": seq_len, "model_dim": dim, "median_len": median,
+        "length_sigma": mix_sigma,
         "buckets": list(buckets), "max_batch": max_batch,
         "n_requests": n_requests,
         "baseline_sequential": baseline,
@@ -1166,8 +1516,11 @@ def run_serve():
         "tracing": tracing,
         "parity_per_bucket": parity,
         "overflow": overflow,
+        "ragged_ab": ragged_ab,
         "failures": failures,
     }
+    if ragged_ab is not None:
+        _mirror_ragged_note(record)
     try:  # mirror onto the shared bench event stream (best-effort)
         from proteinbert_tpu.obs.events import EventLog
 
@@ -1730,8 +2083,17 @@ def main():
                     help="sustained-load online serving vs the "
                          "sequential single-request baseline: "
                          "throughput, p50/p99 latency, per-bucket "
-                         "bit-parity, queue-overflow rejection — one "
-                         "JSON line, CI-measurable without a TPU")
+                         "bit-parity, queue-overflow rejection, plus a "
+                         "ragged-vs-bucketed packed-serving A/B with a "
+                         "per-request parity gate — one JSON line, "
+                         "CI-measurable without a TPU")
+    ap.add_argument("--serve-length-mix", default=None, metavar="SPEC",
+                    help="--serve request-length mix: log-normal "
+                         "'median=48,sigma=0.9,seed=7' (any subset of "
+                         "keys), clamped to the model window — the "
+                         "mixed-length workload ragged serving exists "
+                         "to speed up; default traffic is identical "
+                         "to earlier captures")
     ap.add_argument("--heads", action="store_true",
                     help="the multi-tenant head platform end to end: "
                          "finetune → register → serve mixed-head "
@@ -1756,7 +2118,7 @@ def main():
         return
 
     if cli.serve:
-        run_serve()
+        run_serve(length_mix=cli.serve_length_mix)
         return
 
     if cli.heads:

@@ -1184,6 +1184,8 @@ def cmd_serve(args) -> int:
         slo_profile_dir=args.slo_profile_dir,
         registry=registry,
         heads=head_ids,
+        serve_mode=args.serve_mode,
+        pack_max_segments=args.pack_max_segments,
     )
     if head_ids:
         # Trunk-compat was enforced per head at load (TrunkMismatchError
@@ -1191,9 +1193,15 @@ def cmd_serve(args) -> int:
         # for any of these heads through the shared trunk executable.
         log(f"serving {len(head_ids)} registered head(s) over the "
             f"shared trunk: {', '.join(head_ids)}")
-    log(f"warming {len(server.dispatcher.buckets)} bucket(s) x "
-        f"{len(server.dispatcher.batch_classes)} batch class(es): "
-        f"buckets={list(server.dispatcher.buckets)}")
+    if args.serve_mode == "ragged":
+        log(f"ragged packed serving: one ({args.max_batch}, "
+            f"{cfg.data.seq_len}) executable per request kind; spans "
+            f"quantized to buckets={list(server.dispatcher.buckets)}, "
+            f"up to {args.pack_max_segments} requests per row")
+    else:
+        log(f"warming {len(server.dispatcher.buckets)} bucket(s) x "
+            f"{len(server.dispatcher.batch_classes)} batch class(es): "
+            f"buckets={list(server.dispatcher.buckets)}")
     server.start()
     httpd = make_http_server(server, args.host, args.port)
     port = httpd.server_address[1]
@@ -1545,9 +1553,23 @@ def build_parser() -> argparse.ArgumentParser:
                     help="0 = ephemeral (read it back via --port-file)")
     sv.add_argument("--port-file", type=creatable_path,
                     help="write the bound port here once listening")
+    sv.add_argument("--serve-mode", default="bucketed",
+                    choices=["bucketed", "ragged"],
+                    help="bucketed: one warm executable per "
+                         "(bucket, batch class); ragged: pack "
+                         "heterogeneous requests into fixed-shape "
+                         "(max_batch, seq_len) rows — one executable "
+                         "per request kind, outputs matching bucketed "
+                         "within jitted tolerance (docs/serving.md, "
+                         "ragged batching)")
+    sv.add_argument("--pack-max-segments", type=int, default=8,
+                    help="ragged mode: max requests packed into one "
+                         "row (a batch carries up to max_batch x this "
+                         "many requests)")
     sv.add_argument("--max-batch", type=int, default=8,
                     help="micro-batch size cap (dispatch when a "
-                         "(kind, bucket) group reaches it)")
+                         "(kind, bucket) group reaches it); in ragged "
+                         "mode, the packed ROW count per executable")
     sv.add_argument("--max-wait-ms", type=float, default=10.0,
                     help="max queueing delay before an under-full "
                          "batch dispatches anyway")

@@ -107,6 +107,98 @@ class PackPlanner:
         return closed
 
 
+class OnlinePacker:
+    """Incremental first-fit packer for ONLINE serving (ISSUE 9).
+
+    The serving-side sibling of `PackPlanner`: the same first-fit
+    residual-capacity placement rule, but items carry PAYLOADS (admitted
+    requests) and rows are taken by the caller's dispatch policy (the
+    ragged scheduler pops the oldest rows at batch formation) instead of
+    closing on a streaming bound. Placement is O(open rows) per item and
+    deterministic in arrival order, so packed-batch composition is a
+    pure function of (arrival order, spans, pops) — the property the
+    fake-clock formation tests rely on.
+
+    Each open row tracks `residual` capacity out of `seq_len` and an
+    ordered `items` list of (payload, start, span) triples; a row takes
+    a new item when `residual >= span` and it holds fewer than
+    `max_segments` items. Rows pop oldest-first; because items arrive in
+    order and rows are created in order, the FIRST item of the FIRST row
+    is always the oldest pending payload (the deadline-trigger anchor).
+    """
+
+    __slots__ = ("seq_len", "max_segments", "_rows")
+
+    def __init__(self, seq_len: int, max_segments: int):
+        if max_segments < 1:
+            raise ValueError(f"max_segments must be >= 1, got {max_segments}")
+        if seq_len < _MIN_FIT:
+            raise ValueError(f"seq_len must be >= {_MIN_FIT}, got {seq_len}")
+        self.seq_len = int(seq_len)
+        self.max_segments = int(max_segments)
+        # Each row: [residual, [(payload, start, span), ...]]
+        self._rows: List[List] = []
+
+    def __len__(self) -> int:
+        """Open row count."""
+        return len(self._rows)
+
+    def total_items(self) -> int:
+        return sum(len(r[1]) for r in self._rows)
+
+    def place(self, payload, span: int) -> int:
+        """First-fit one item; returns the row index it landed in."""
+        span = int(span)
+        if not 0 < span <= self.seq_len:
+            raise ValueError(f"span {span} not in (0, {self.seq_len}]")
+        for i, row in enumerate(self._rows):
+            if row[0] >= span and len(row[1]) < self.max_segments:
+                row[1].append((payload, self.seq_len - row[0], span))
+                row[0] -= span
+                return i
+        self._rows.append([self.seq_len - span, [(payload, 0, span)]])
+        return len(self._rows) - 1
+
+    def row_heads(self) -> List:
+        """The first (oldest) payload of every open row. Items within a
+        row stay in arrival order (even across `expire`), so the oldest
+        pending payload overall is always among these — what the
+        max-wait dispatch trigger scans."""
+        return [row[1][0][0] for row in self._rows]
+
+    def expire(self, predicate) -> List:
+        """Remove every item whose payload satisfies `predicate` and
+        drop rows that become empty; returns the removed payloads. A
+        removed item's span stays dead space in its row (residual is
+        NOT returned) — holes cost capacity, not correctness."""
+        removed: List = []
+        rows: List[List] = []
+        for row in self._rows:
+            kept = []
+            for item in row[1]:
+                if predicate(item[0]):
+                    removed.append(item[0])
+                else:
+                    kept.append(item)
+            if kept:
+                row[1] = kept
+                rows.append(row)
+        self._rows = rows
+        return removed
+
+    def pop_rows(self, n: int) -> List[List[Tuple]]:
+        """Take the oldest `n` rows (fewer if fewer are open); each is
+        the row's ordered [(payload, start, span), ...] list."""
+        taken, self._rows = self._rows[:n], self._rows[n:]
+        return [row[1] for row in taken]
+
+    def drain_items(self) -> List:
+        """Abort path: every pending payload, row-major, and reset."""
+        items = [p for _, row in self._rows for p, _, _ in row]
+        self._rows = []
+        return items
+
+
 def pack_rows(
     fetched_tokens: np.ndarray,
     fetched_annotations: np.ndarray,

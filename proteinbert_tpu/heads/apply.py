@@ -23,9 +23,10 @@ identity of mixed-batch vs per-head serving).
 from __future__ import annotations
 
 from functools import partial
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from proteinbert_tpu.configs import ModelConfig
@@ -48,6 +49,88 @@ def head_batch(head, local, global_, pad_mask, kind: str):
     `head` is a traced pytree — all heads with one structure share one
     executable."""
     return ft_model.apply_head(head, local, global_, pad_mask, kind)
+
+
+@partial(jax.jit, static_argnames="cfg")
+def packed_trunk_batch(params, tokens, segment_ids, annotations,
+                       cfg: ModelConfig):
+    """The ragged-serving shared executable (ISSUE 9): one fixed-shape
+    (rows, seq_len) PACKED batch → {"local" (B, L, C), "global"
+    (B, S, G), "seg_mask" (B, S, L) bool} per-segment trunk
+    representation. One compile per request-kind shape regardless of
+    which heads consume it — the packed sibling of `trunk_batch`.
+    `seg_mask` is True only at a segment's REAL token positions (a
+    bucket-quantized span's <pad> tail is excluded), so the head tails
+    pool exactly the positions the bucketed path's pad_mask keeps."""
+    from proteinbert_tpu import inference
+    from proteinbert_tpu.data.vocab import PAD_ID
+
+    local, global_ = proteinbert.encode(params, tokens, annotations, cfg,
+                                        pad_mask=(tokens != PAD_ID),
+                                        segment_ids=segment_ids)
+    return {"local": local, "global": global_,
+            "seg_mask": inference._segment_real_mask(
+                tokens, segment_ids, annotations.shape[1])}
+
+
+def packed_head_features(local: jax.Array, global_: jax.Array,
+                         seg_mask: jax.Array, kind: str) -> jax.Array:
+    """Per-SEGMENT feature tensor for a `kind` head over a packed trunk
+    representation — the segment-aware sibling of
+    `models/finetune.head_features` (same pooling math per segment:
+    mask-weighted mean over real positions, concatenated with the
+    segment's own global vector), so a span's head input matches the
+    bucketed path's within jitted tolerance. token_classification heads
+    read the local track directly; callers slice each segment's span
+    from the (B, L, out) result."""
+    if kind == "token_classification":
+        return local
+    m = seg_mask.astype(local.dtype)  # (B, S, L)
+    pooled = (jnp.einsum("bsl,blc->bsc", m, local)
+              / jnp.maximum(m.sum(-1)[..., None], 1.0))
+    return jnp.concatenate([global_, pooled], axis=-1)
+
+
+@partial(jax.jit, static_argnames="kind")
+def packed_head_batch(head, local, global_, seg_mask, kind: str):
+    """One head's tail over a packed trunk batch: float32 outputs shaped
+    (B, L, out) for token_classification (slice spans out) or (B, S,
+    out) per segment otherwise. `head` is traced — all heads of one
+    structure share one executable, same as `head_batch`."""
+    return ft_model._head_apply(
+        head, packed_head_features(local, global_, seg_mask, kind)
+    ).astype(jnp.float32)
+
+
+def apply_heads_packed(
+    trunk_out: Dict[str, jax.Array],
+    riders: Sequence[Tuple[Any, int, int, int, int]],
+) -> List[np.ndarray]:
+    """Mixed-head tail for a PACKED batch: `riders` is one (head, row,
+    segment_index, start, span) tuple per request, row-major. Each
+    DISTINCT head runs once over the full packed batch, then every
+    rider keeps its own segment's slice — (span, out) for
+    token_classification (aligned with the bucketed (bucket_len, out)
+    output), (out,) / (1,) otherwise. Returns host arrays aligned to
+    `riders` order."""
+    out: List[Optional[np.ndarray]] = [None] * len(riders)
+    by_head: Dict[str, List[int]] = {}
+    head_of: Dict[str, Any] = {}
+    for i, (head, _, _, _, _) in enumerate(riders):
+        by_head.setdefault(head.head_id, []).append(i)
+        head_of[head.head_id] = head
+    for head_id, idxs in by_head.items():
+        head = head_of[head_id]
+        res = np.asarray(packed_head_batch(
+            head.params, trunk_out["local"], trunk_out["global"],
+            trunk_out["seg_mask"], head.task.kind))
+        for i in idxs:
+            _, row, seg, start, span = riders[i]
+            if head.task.kind == "token_classification":
+                out[i] = res[row, start:start + span]
+            else:
+                out[i] = res[row, seg]
+    return out  # type: ignore[return-value]
 
 
 def apply_heads(

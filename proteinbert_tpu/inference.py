@@ -99,6 +99,62 @@ def _encode_batch(params, tokens, annotations, cfg: ModelConfig,
     return out
 
 
+def _segment_real_mask(tokens, segment_ids, num_segments: int):
+    """(B, S, L) bool: True where position l belongs to segment s AND
+    holds a real (non-<pad>) token. A ragged serving span is bucket-
+    quantized (serve/dispatch.RaggedDispatcher), so its tail holds
+    <pad> tokens that must be excluded from pooling/attention exactly
+    as the bucketed path's pad_mask excludes them."""
+    seg = (segment_ids[:, None, :]
+           == jnp.arange(1, num_segments + 1,
+                         dtype=segment_ids.dtype)[None, :, None])
+    return seg & (tokens != PAD_ID)[:, None, :]
+
+
+@partial(jax.jit, static_argnames="cfg")
+def _packed_encode_batch(params, tokens, segment_ids, annotations,
+                         cfg: ModelConfig):
+    """The ragged serving form of `_encode_batch`: one fixed-shape
+    (rows, seq_len) packed batch of up to S segments per row →
+    {"local_mean": (B, S, C), "global": (B, S, G)} float32 per-SEGMENT
+    representations. Per-segment math mirrors the bucketed entry
+    row-for-row (mask-weighted mean over real positions), so a span's
+    outputs match the bucketed dispatcher's within jitted tolerance
+    (docs/serving.md, ragged batching)."""
+    pad_mask = tokens != PAD_ID
+    local, global_ = proteinbert.encode(params, tokens, annotations, cfg,
+                                        pad_mask=pad_mask,
+                                        segment_ids=segment_ids)
+    m = _segment_real_mask(tokens, segment_ids,
+                           annotations.shape[1]).astype(jnp.float32)
+    local = local.astype(jnp.float32)
+    local_mean = (jnp.einsum("bsl,blc->bsc", m, local)
+                  / jnp.maximum(m.sum(-1)[..., None], 1.0))
+    return {"local_mean": local_mean, "global": global_.astype(jnp.float32)}
+
+
+@partial(jax.jit, static_argnames="cfg")
+def _packed_go_probs_batch(params, tokens, segment_ids, annotations,
+                           cfg: ModelConfig):
+    """(B, S, A) sigmoid GO probabilities per packed segment."""
+    _, global_logits = proteinbert.apply(
+        params, tokens, annotations, cfg, pad_mask=(tokens != PAD_ID),
+        segment_ids=segment_ids)
+    return jax.nn.sigmoid(global_logits)
+
+
+@partial(jax.jit, static_argnames="cfg")
+def _packed_residue_probs_batch(params, tokens, segment_ids, annotations,
+                                cfg: ModelConfig):
+    """(B, L, V) per-position softmax over a packed batch; callers
+    slice each segment's span back out (the span's rows line up with
+    the bucketed entry's (bucket_len, V) output)."""
+    local_logits, _ = proteinbert.apply(
+        params, tokens, annotations, cfg, pad_mask=(tokens != PAD_ID),
+        segment_ids=segment_ids)
+    return jax.nn.softmax(local_logits, -1)
+
+
 @partial(jax.jit, static_argnames="cfg")
 def _go_probs_batch(params, tokens, annotations, cfg: ModelConfig):
     _, global_logits = proteinbert.apply(params, tokens, annotations, cfg)

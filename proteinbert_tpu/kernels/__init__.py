@@ -1,6 +1,7 @@
 """Pallas TPU kernels (SURVEY §7 stage 8)."""
 
 from proteinbert_tpu.kernels.fused_block import (
+    FALLBACK_TOTAL,
     MAX_PALLAS_DIM,
     fused_local_track,
     fused_local_track_segments,
@@ -9,10 +10,13 @@ from proteinbert_tpu.kernels.fused_block import (
     local_track_segment_reference,
     local_track_valid_reference,
     pallas_supported,
+    register_fallback_observer,
     track_halo,
+    unregister_fallback_observer,
 )
 
 __all__ = [
+    "FALLBACK_TOTAL",
     "MAX_PALLAS_DIM",
     "fused_local_track",
     "fused_local_track_segments",
@@ -21,5 +25,7 @@ __all__ = [
     "local_track_segment_reference",
     "local_track_valid_reference",
     "pallas_supported",
+    "register_fallback_observer",
     "track_halo",
+    "unregister_fallback_observer",
 ]

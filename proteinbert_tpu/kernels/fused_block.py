@@ -63,7 +63,8 @@ implementation for fused-local-track schedules at sharded
 from __future__ import annotations
 
 import functools
-from typing import Dict
+import logging
+from typing import Callable, Dict, List
 
 import jax
 import jax.numpy as jnp
@@ -72,7 +73,45 @@ from jax.ad_checkpoint import checkpoint_name
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+logger = logging.getLogger(__name__)
+
 Params = Dict[str, jax.Array]
+
+# Process-wide count of fused-kernel → XLA-reference fallbacks, by
+# reason, bumped at TRACE time — i.e. once per EXECUTABLE built on the
+# fallback path, which is exactly the granularity the MFU question
+# needs ("how many of my compiled shapes missed the fast path"), not
+# once per step. `register_fallback_observer` lets a telemetry owner
+# (serve/server.Server, or any trainer holding a registry) mirror the
+# bumps into a registry counter (`fused_kernel_fallback_total{reason=}`)
+# so the gap is visible in /metrics and `pbt diagnose` instead of
+# folklore (ISSUE 9 satellite; ROADMAP open item 2 is the fix).
+FALLBACK_TOTAL: Dict[str, int] = {}
+_FALLBACK_OBSERVERS: List[Callable[[str], None]] = []
+_FALLBACK_WARNED: set = set()
+
+
+def register_fallback_observer(cb: Callable[[str], None]) -> None:
+    """`cb(reason)` is invoked on every fallback bump (trace time)."""
+    _FALLBACK_OBSERVERS.append(cb)
+
+
+def unregister_fallback_observer(cb: Callable[[str], None]) -> None:
+    if cb in _FALLBACK_OBSERVERS:
+        _FALLBACK_OBSERVERS.remove(cb)
+
+
+def _note_fallback(reason: str) -> None:
+    FALLBACK_TOTAL[reason] = FALLBACK_TOTAL.get(reason, 0) + 1
+    if reason not in _FALLBACK_WARNED:
+        _FALLBACK_WARNED.add(reason)
+        logger.warning(
+            "fused local-track kernel fell back to the XLA reference "
+            "path (reason=%s) — this executable runs without the fused "
+            "fast path; counted in fused_kernel_fallback_total "
+            "(ROADMAP open item 2 closes the gap)", reason)
+    for cb in list(_FALLBACK_OBSERVERS):
+        cb(reason)
 
 # Largest feature dim whose weights fit the VMEM budget whole (see
 # module doc); larger dims use the channel-tiled kernel.
@@ -183,8 +222,15 @@ def fused_local_track_segments(
     (semantically identical, boundary-masked). When the kernel learns
     boundaries this becomes the dispatch point — callers already route
     every packed use_pallas call here (models/proteinbert.block_apply),
-    so the swap will be one-line."""
+    so the swap will be one-line.
+
+    Every routing through this guard counts in
+    `FALLBACK_TOTAL["segments"]` (once per executable — the bump
+    happens at trace time) with a one-time warning, so the MFU gap
+    packed training AND ragged serving pay on this path shows up in
+    telemetry (`pbt diagnose`, /metrics) instead of folklore."""
     del interpret  # reserved for the future kernel dispatch
+    _note_fallback("segments")
     return local_track_segment_reference(
         params, x, broadcast_pos, segment_ids, narrow_dilation, wide_dilation
     )

@@ -176,8 +176,14 @@ def block_apply(
     # leading (B, S) axes, only attention needs the segment mask.
     dense1 = jax.nn.gelu(dense_apply(params["global_dense1"], global_))
     if packed:
+        # pad_mask is the REAL-token mask: for training packs it equals
+        # segment_ids > 0 (segments hold no pad), so this is a no-op
+        # there; the ragged serving path packs bucket-quantized spans
+        # with <pad> tails and passes tokens != PAD_ID, which must be
+        # excluded from the softmax like the bucketed path excludes it.
         attn = packed_global_attention_apply(
-            params["attention"], local, global_, segment_ids)
+            params["attention"], local, global_, segment_ids,
+            real_mask=pad_mask)
     else:
         attn = global_attention_apply(
             params["attention"], local, global_, pad_mask)

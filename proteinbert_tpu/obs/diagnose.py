@@ -251,6 +251,10 @@ def summarize_serve(records: List[Dict[str, Any]],
            if isinstance(b.get("batch_class"), int) and b["batch_class"]]
     pads = [b["pad_fraction"] for b in batches
             if isinstance(b.get("pad_fraction"), (int, float))]
+    segs = [b["segments"] for b in batches
+            if isinstance(b.get("segments"), int)]
+    spr = [b["segments_per_row"] for b in batches
+           if isinstance(b.get("segments_per_row"), (int, float))]
     out["batches"] = {
         "n": len(batches),
         "rows": sum(rows),
@@ -259,7 +263,30 @@ def summarize_serve(records: List[Dict[str, Any]],
                            if occ else None),
         "mean_pad_fraction": (round(sum(pads) / len(pads), 4)
                               if pads else None),
+        # Ragged packed batches (ISSUE 9): requests per batch and per
+        # row — absent on a purely bucketed stream.
+        "modes": dict(collections.Counter(
+            b["mode"] for b in batches if isinstance(b.get("mode"), str))),
+        "segments": sum(segs) if segs else None,
+        "mean_segments_per_row": (round(sum(spr) / len(spr), 4)
+                                  if spr else None),
     }
+
+    # ---- executable zoo + fused-kernel fallback (ISSUE 9) ----
+    # From the terminal stats snapshot: warm executable count (the
+    # bucketed |buckets|x|classes|xkinds ladder vs ragged O(kinds)),
+    # cumulative warmup seconds, and how many executables were built on
+    # the fused kernel's XLA fallback path (ROADMAP open item 2's gap,
+    # made visible instead of folklore).
+    end_stats = (end.get("stats") if end is not None
+                 and isinstance(end.get("stats"), dict) else None)
+    if end_stats is not None:
+        out["executables"] = {
+            "serve_mode": end_stats.get("serve_mode"),
+            "count": end_stats.get("executables"),
+            "warmup_seconds": end_stats.get("warmup_seconds"),
+            "fused_fallback": end_stats.get("fused_fallback"),
+        }
 
     # ---- SLO breaches ----
     out["slo_breaches"] = [{
@@ -332,6 +359,23 @@ def render_serve(summary: Dict[str, Any]) -> str:
                      f"{b['mean_rows']}/batch, occupancy "
                      f"{b['mean_occupancy']}, pad fraction "
                      f"{b['mean_pad_fraction']})")
+        if b.get("segments"):
+            lines.append(
+                f"  packed: {b['segments']} segments, "
+                f"{b['mean_segments_per_row']} per row "
+                f"(modes: " + ", ".join(
+                    f"{k}={v}" for k, v in sorted(b["modes"].items()))
+                + ")")
+    ex = summary.get("executables")
+    if ex and ex.get("count") is not None:
+        lines.append(
+            f"executables: {ex['count']} warm "
+            f"(mode {ex.get('serve_mode')}, warmup "
+            f"{ex.get('warmup_seconds')}s)")
+        fb = ex.get("fused_fallback") or {}
+        for reason, n in sorted(fb.items()):
+            lines.append(f"  fused-kernel fallback ({reason}): "
+                         f"{n} executable(s) on the XLA reference path")
     for br in summary["slo_breaches"]:
         lines.append(f"SLO BREACH: {br['objective']} burn "
                      f"{br['burn_rate']:.2f} ({br['bad']}/{br['total']} "

@@ -59,7 +59,11 @@ EVENT_FIELDS: Dict[str, Dict[str, Any]] = {
     # depth, cache size) — the serving counterpart of run_start.
     "serve_start": {"config": dict, "pid": int},
     # One per dispatched micro-batch: which compiled shape class ran and
-    # how full it was (rows ≤ the padded batch class size).
+    # how full it was (rows ≤ the padded batch class size). Ragged
+    # packed batches (ISSUE 9) additionally carry `mode` ("ragged"),
+    # `segments` (requests packed into the batch), `segments_per_row`,
+    # and `pad_fraction` of the fixed (rows, seq_len) grid — typed
+    # below when present.
     "serve_batch": {"kind": str, "bucket_len": int, "rows": int},
     # One per rejected request: reason in SERVE_REJECT_REASONS
     # (+ queue_depth at rejection time, when the emitter knows it).
@@ -154,6 +158,36 @@ def build_record(event: str, seq: int, t: float,
         return None
 
 
+_SERVE_MODES = ("bucketed", "ragged")
+
+
+def _validate_packed_fields(event: str, rec: Dict[str, Any]) -> None:
+    """Optional ragged-packing fields shared by serve_batch and
+    serve_request (ISSUE 9): typed when present, absent on older
+    streams and the bucketed path."""
+    seg = rec.get("segments")
+    if seg is not None and (not isinstance(seg, int)
+                            or isinstance(seg, bool) or seg < 0):
+        raise ValueError(f"{event}.segments must be a non-negative int, "
+                         f"got {seg!r}")
+    spr = rec.get("segments_per_row")
+    if spr is not None and (isinstance(spr, bool)
+                            or not isinstance(spr, (int, float))
+                            or not math.isfinite(spr) or spr < 0):
+        raise ValueError(f"{event}.segments_per_row must be a "
+                         f"non-negative finite number, got {spr!r}")
+    mode = rec.get("mode")
+    if mode is not None and mode not in _SERVE_MODES:
+        raise ValueError(f"{event}.mode {mode!r} not in {_SERVE_MODES}")
+    pf = rec.get("pad_fraction")
+    if pf is not None and (isinstance(pf, bool)
+                           or not isinstance(pf, (int, float))
+                           or not math.isfinite(pf)
+                           or not 0.0 <= pf <= 1.0):
+        raise ValueError(f"{event}.pad_fraction must be a number in "
+                         f"[0, 1], got {pf!r}")
+
+
 def validate_record(rec: Any) -> None:
     """Raise ValueError (with a pinpointing message) unless `rec` is a
     well-formed event record. The writer, tools/validate_events.py, and
@@ -209,7 +243,9 @@ def validate_record(rec: Any) -> None:
                 raise ValueError(
                     f"serve_batch.{field} must be a non-negative int, "
                     f"got {v!r}")
+        _validate_packed_fields(event, rec)
     if event == "serve_request":
+        _validate_packed_fields(event, rec)
         if rec["outcome"] not in SERVE_REQUEST_OUTCOMES:
             raise ValueError(f"serve_request.outcome {rec['outcome']!r} "
                              f"not in {SERVE_REQUEST_OUTCOMES}")

@@ -102,6 +102,7 @@ def packed_global_attention_apply(
     local: jax.Array,
     global_: jax.Array,
     segment_ids: jax.Array,
+    real_mask: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Per-SEGMENT global attention over a packed row (data/packing.py).
 
@@ -119,6 +120,12 @@ def packed_global_attention_apply(
       local: (B, L, C) local track.
       global_: (B, S, G) per-segment global track.
       segment_ids: (B, L) int, 0 = pad, 1..S = segment index.
+      real_mask: optional (B, L) bool, True at REAL (non-<pad>) token
+        positions. Training packs carry no pad inside a segment, so it
+        defaults to every in-segment position; the ragged SERVING path
+        (serve/dispatch.RaggedDispatcher) packs bucket-quantized spans
+        whose tails hold <pad> tokens — those must stay out of the
+        softmax exactly as the bucketed path's pad_mask keeps them out.
     Returns:
       (B, S, G) attention output in the activation dtype of `local`.
     """
@@ -141,6 +148,8 @@ def packed_global_attention_apply(
         segment_ids[:, None, :]
         == jnp.arange(1, S + 1, dtype=segment_ids.dtype)[None, :, None]
     )  # (B, S, L)
+    if real_mask is not None:
+        seg_mask = seg_mask & real_mask[:, None, :]
     scores = jnp.where(seg_mask[:, :, None, :], scores, jnp.float32(-1e30))
     weights = jax.nn.softmax(scores, axis=-1).astype(dtype)
 

@@ -34,13 +34,25 @@ serving (PAPERS.md), built from five cooperating pieces:
 - **http** (`serve/http.py`) — a thin stdlib `http.server` JSON
   endpoint over the same facade (`pbt serve`).
 
+Two dispatch modes (ISSUE 9): the default **bucketed** ladder above,
+and **ragged** (`serve_mode="ragged"` / `pbt serve --serve-mode
+ragged`) — heterogeneous requests PACK into fixed-shape
+(rows, seq_len) rows at bucket-quantized spans via the training-side
+packing representation (`data/packing.py`, tokens + segment_ids), so
+ONE warm executable per request kind serves every length mix
+(`RaggedDispatcher` + `PackedBatchScheduler`), with per-request
+outputs matching the bucketed dispatcher's within the documented
+jitted ≤1e-5 tolerance (docs/serving.md, "Ragged batching").
+
 Benchmarked by `bench.py --serve` (throughput + latency percentiles vs
 the one-request-at-a-time offline baseline); documented in
 docs/serving.md.
 """
 
 from proteinbert_tpu.serve.cache import EmbeddingCache, content_key
-from proteinbert_tpu.serve.dispatch import TASK_KIND, BucketDispatcher
+from proteinbert_tpu.serve.dispatch import (
+    TASK_KIND, BucketDispatcher, RaggedDispatcher,
+)
 from proteinbert_tpu.serve.errors import (
     DeadlineExceededError,
     QueueFullError,
@@ -51,14 +63,20 @@ from proteinbert_tpu.serve.errors import (
     UnknownHeadError,
 )
 from proteinbert_tpu.serve.queue import Request, RequestQueue
-from proteinbert_tpu.serve.scheduler import MicroBatchScheduler
-from proteinbert_tpu.serve.server import Server
+from proteinbert_tpu.serve.scheduler import (
+    MicroBatchScheduler, PackedBatchScheduler,
+)
+from proteinbert_tpu.serve.server import SERVE_MODES, Server
+
 from proteinbert_tpu.serve.trace import RequestTrace
 
 __all__ = [
     "Server",
+    "SERVE_MODES",
     "BucketDispatcher",
+    "RaggedDispatcher",
     "MicroBatchScheduler",
+    "PackedBatchScheduler",
     "RequestQueue",
     "Request",
     "RequestTrace",

@@ -25,7 +25,12 @@ Routes (POST bodies and responses are JSON):
                              → 400 {"type": "trunk_mismatch"})
   POST /v1/heads/remove      {"head_id"} → hot-remove (drain: queued
                              requests for it still complete)
-  GET  /healthz              → {"ok": true, "stats": {...}}
+  GET  /healthz              → {"ok": true, "mode": "bucketed"|"ragged",
+                               "stats": {...}} — `mode` is the serving
+                               dispatch mode (`pbt serve --serve-mode`,
+                               ISSUE 9); stats carries the executable-
+                               zoo accounting (executables,
+                               warmup_seconds, fused_fallback)
   GET  /metrics              → Prometheus textfile (the registry's
                                exposition; empty when telemetry is off)
 
@@ -91,7 +96,8 @@ def make_handler(server: Server):
 
         def do_GET(self):
             if self.path in ("/healthz", "/stats"):
-                self._reply(200, {"ok": True, "stats": server.stats()})
+                self._reply(200, {"ok": True, "mode": server.serve_mode,
+                                  "stats": server.stats()})
             elif self.path == "/v1/heads":
                 self._reply(200, {"heads": server.list_heads()})
             elif self.path == "/metrics":

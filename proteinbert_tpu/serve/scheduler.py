@@ -382,3 +382,284 @@ class MicroBatchScheduler:
                 req.future.set_exception(exc)
                 failed.append(req)
         return failed
+
+
+class PackedBatchScheduler(MicroBatchScheduler):
+    """RAGGED packed batch formation (ISSUE 9 tentpole).
+
+    Replaces the (kind, bucket) grouping with PACKING: admission places
+    each request into an open packed row for its KIND via the same
+    first-fit residual-capacity rule as `data/packing.PackPlanner`
+    (`data/packing.OnlinePacker`), at the request's bucket-quantized
+    span. One dispatch runs `rows_per_batch` rows through the kind's
+    single fixed-shape executable (`serve/dispatch.RaggedDispatcher`)
+    — so every length mix shares one compiled shape, and a batch
+    carries up to rows_per_batch x max_segments requests.
+
+    Dispatch policy (the same two-knob contract as the bucketed
+    scheduler, per KIND):
+
+    - a kind with MORE than `rows_per_batch` open rows dispatches the
+      oldest `rows_per_batch` immediately (throughput bound — the
+      extra row is the open frontier, so the popped rows have already
+      been topped off by first-fit);
+    - otherwise a kind dispatches when the oldest request in ANY of
+      its open rows has waited `max_wait_s` (latency bound), padding
+      the executable's row count with empty rows;
+    - when the queue is closed (drain), remaining rows flush oldest
+      kind first.
+
+    Deadlines: expiry sweeps open rows every poll (an expired request
+    is REMOVED from its row — its span stays dead space, costing
+    capacity, never correctness) and re-checks at dispatch pop, so an
+    expired request never resolves with a result.
+
+    Single-threaded against `poll(now=)` the formation is a
+    deterministic function of arrival order and the clock, exactly
+    like the bucketed scheduler (tests/test_serve_ragged.py).
+    """
+
+    def __init__(
+        self,
+        queue: RequestQueue,
+        dispatcher,
+        finalize: Callable[[Request, object], None],
+        rows_per_batch: int = 4,
+        max_wait_s: float = 0.01,
+        clock=time.monotonic,
+        max_segments: int = 8,
+        telemetry=None,
+        latency_observer: Optional[Callable[[float], None]] = None,
+        expire_observer: Optional[Callable[[Request], None]] = None,
+        complete_observer=None,
+    ):
+        super().__init__(
+            queue, dispatcher, finalize, max_batch=rows_per_batch,
+            max_wait_s=max_wait_s, clock=clock, partition_heads=False,
+            telemetry=telemetry, latency_observer=latency_observer,
+            expire_observer=expire_observer,
+            complete_observer=complete_observer)
+        # Lazy import: data/packing pulls the dataset module, which the
+        # pure-logic scheduler tests (stub dispatchers) need not load.
+        from proteinbert_tpu.data.packing import OnlinePacker
+
+        self._packer_cls = OnlinePacker
+        self.rows_per_batch = int(rows_per_batch)
+        self.max_segments = int(max_segments)
+        self.seq_len = int(dispatcher.cfg.data.seq_len)
+        # kind -> OnlinePacker of open rows (payloads are Requests).
+        # Guarded by the inherited _pending_lock, same contract as the
+        # base class's _pending map.
+        self._packers: "collections.OrderedDict[str, object]" = \
+            collections.OrderedDict()
+
+    # -------------------------------------------------------- formation
+
+    def pending_rows(self) -> int:
+        """Pending REQUESTS (the quiesce-poll unit, matching the base
+        class's per-request semantics — not physical packed rows)."""
+        with self._pending_lock:
+            return sum(p.total_items() for p in self._packers.values())
+
+    def _ingest(self, now: float) -> None:
+        items = self.queue.pop_all()
+        if not items:
+            return
+        with self._pending_lock:
+            for req in items:
+                if req.trace is not None:
+                    req.trace.mark_ingested(now)
+                packer = self._packers.get(req.kind)
+                if packer is None:
+                    packer = self._packers[req.kind] = self._packer_cls(
+                        self.seq_len, self.max_segments)
+                packer.place(req, req.bucket_len)
+
+    def _expire_requests(self, expired: List[Request], now: float) -> None:
+        if not expired:
+            return
+        depth = self.pending_rows() + len(self.queue)
+        for req in expired:
+            self.expired_total += 1
+            self._observe_wait(req, now)
+            req.future.set_exception(DeadlineExceededError(
+                f"deadline passed after "
+                f"{now - req.enqueued_at:.3f}s waiting for a batch"))
+            self.tele.emit("serve_reject", reason="deadline",
+                           kind=req.kind, queue_depth=depth)
+            self._on_expire(req)
+            self._on_complete(req, "expired", now, None, None)
+
+    def _expire_pending(self, now: float) -> None:
+        expired: List[Request] = []
+        with self._pending_lock:
+            for kind in list(self._packers):
+                packer = self._packers[kind]
+                expired.extend(packer.expire(
+                    lambda r: r.deadline is not None and now >= r.deadline))
+                if len(packer) == 0:
+                    del self._packers[kind]
+        self._expire_requests(expired, now)
+
+    def _select_group(self, now: float):
+        """Dispatch decision per KIND: a kind holding MORE than
+        rows_per_batch open rows first (most rows wins, ties to the
+        oldest head) — the extra row is the open frontier, so the
+        popped oldest rows have already been topped off by first-fit
+        instead of shipping a barely-started newest row — else the kind
+        whose oldest row-head request waited past max_wait_s, else —
+        draining — the oldest head outright."""
+        def oldest(packer) -> float:
+            return min(r.enqueued_at for r in packer.row_heads())
+
+        with self._pending_lock:
+            candidates = [(k, p) for k, p in self._packers.items()
+                          if len(p)]
+            full = [(len(p), -oldest(p), k) for k, p in candidates
+                    if len(p) > self.rows_per_batch]
+            if full:
+                return max(full)[2]
+            overdue = [(oldest(p), k) for k, p in candidates
+                       if now - oldest(p) >= self.max_wait_s]
+            if overdue:
+                return min(overdue)[1]
+            if self.queue.closed and candidates:
+                return min((oldest(p), k) for k, p in candidates)[1]
+            return None
+
+    # --------------------------------------------------------- dispatch
+
+    def _dispatch(self, key, now: float) -> int:
+        kind = key
+        R, L, S = self.rows_per_batch, self.seq_len, self.max_segments
+        with self._pending_lock:
+            packer = self._packers.get(kind)
+            if packer is None or len(packer) == 0:  # raced fail_pending
+                return 0
+            rows = packer.pop_rows(R)
+            if len(packer) == 0:
+                del self._packers[kind]
+        num_ann = self.dispatcher.cfg.model.num_annotations
+        tokens = np.zeros((R, L), np.int32)
+        segment_ids = np.zeros((R, L), np.int32)
+        annotations = np.zeros((R, S, num_ann), np.float32)
+        riders: List[Tuple[Request, int, int, int, int]] = []
+        expired: List[Request] = []
+        tracing = False
+        timed = self.time_batches
+        for r, row in enumerate(rows):
+            for s, (req, start, span) in enumerate(row):
+                if req.deadline is not None and now >= req.deadline:
+                    expired.append(req)  # raced in since the last sweep
+                    continue
+                tokens[r, start:start + span] = req.tokens
+                segment_ids[r, start:start + span] = s + 1
+                if req.annotations is not None:
+                    annotations[r, s] = req.annotations
+                riders.append((req, r, s, start, span))
+                self._observe_wait(req, now)
+                if req.trace is not None:
+                    tracing = True
+                    if req.trace.sampled:
+                        timed = True
+                    req.trace.mark_popped(now)
+        self._expire_requests(expired, now)
+        if not riders:
+            return len(expired)
+        batch = [r[0] for r in riders]
+        geom = [(r, s, start, span) for (_, r, s, start, span) in riders]
+        heads = ([req.head for req in batch]
+                 if batch[0].head is not None else None)
+        n_riders = len(riders)
+        ctx = {"rows": R, "batch_class": R, "bucket_len": L,
+               "segments": n_riders,
+               "segments_per_row": round(n_riders / R, 4),
+               "mode": "ragged"}
+        if heads is not None:
+            ctx["heads"] = sorted({h.head_id for h in heads})
+        t0 = time.perf_counter()
+        run0 = self.clock()
+        try:
+            if tracing and timed:
+                outs, timings = self.dispatcher.run_packed_timed(
+                    kind, tokens, segment_ids, annotations, geom,
+                    heads=heads)
+                ctx.update(timings)
+            else:
+                outs = self.dispatcher.run_packed(
+                    kind, tokens, segment_ids, annotations, geom,
+                    heads=heads)
+        except Exception as e:  # fail THIS batch, keep serving
+            logger.exception("packed batch dispatch failed "
+                             "(%s, rows=%d, segments=%d)",
+                             kind, R, n_riders)
+            self.tele.emit("note", source="serve", error=str(e),
+                           kind=kind, bucket_len=L, mode="ragged")
+            fail_t = self.clock()
+            for req, _, _, _, span in riders:
+                if req.trace is not None:
+                    req.trace.mark_run(run0, fail_t)
+                    req.trace.mark_batch(
+                        span, R, R,
+                        pad_fraction=ctx.get("pad_fraction"),
+                        segments=n_riders,
+                        segments_per_row=ctx["segments_per_row"],
+                        mode="ragged")
+                if not req.future.done():
+                    req.future.set_exception(e)
+                self._on_complete(req, "error", fail_t, e, ctx)
+            return n_riders
+        dt = time.perf_counter() - t0
+        run1 = self.clock()
+        self._batch_h.observe(dt)
+        done_t = self.clock()
+        for (req, _, _, _, span), out in zip(riders, outs):
+            outcome, err = "ok", None
+            try:
+                self.finalize(req, out)
+            except Exception as e:
+                outcome, err = "error", e
+                if not req.future.done():
+                    req.future.set_exception(e)
+            self._latency(done_t - req.enqueued_at)
+            if req.trace is not None:
+                req.trace.mark_run(run0, run1)
+                req.trace.mark_batch(
+                    span, R, R,
+                    pad_fraction=ctx.get("pad_fraction"),
+                    prep_s=ctx.get("prep_s"),
+                    device_s=ctx.get("device_s"),
+                    segments=n_riders,
+                    segments_per_row=ctx["segments_per_row"],
+                    mode="ragged")
+            self._on_complete(req, outcome, self.clock(), err, ctx)
+        self.batches_total += 1
+        self.rows_total += n_riders
+        # Occupancy for a packed grid is token occupancy (1 - pad
+        # fraction) when the batch was timed, else segment-slot fill.
+        pad = ctx.get("pad_fraction")
+        self._occupancy_g.set(1.0 - pad if pad is not None
+                              else n_riders / (R * S))
+        self._rows_h.observe(n_riders)
+        self.tele.emit("serve_batch", kind=kind, bucket_len=L,
+                       rows=R, batch_class=R,
+                       batch_seconds=round(dt, 6),
+                       pad_fraction=pad,
+                       segments=n_riders,
+                       segments_per_row=ctx["segments_per_row"],
+                       mode="ragged",
+                       heads=ctx.get("heads"))
+        return n_riders
+
+    def fail_pending(self, exc: Exception) -> List[Request]:
+        with self._pending_lock:
+            reqs: List[Request] = []
+            for packer in self._packers.values():
+                reqs.extend(packer.drain_items())
+            self._packers.clear()
+        failed = []
+        for req in reqs:
+            if not req.future.done():
+                req.future.set_exception(exc)
+                failed.append(req)
+        return failed

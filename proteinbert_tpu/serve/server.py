@@ -72,13 +72,19 @@ from proteinbert_tpu.heads.registry import (
     HeadRegistry, LoadedHead, UnknownHeadError, trunk_fingerprint,
 )
 from proteinbert_tpu.serve.cache import EmbeddingCache, content_key
-from proteinbert_tpu.serve.dispatch import KINDS, TASK_KIND, BucketDispatcher
+from proteinbert_tpu.serve.dispatch import (
+    KINDS, TASK_KIND, BucketDispatcher, RaggedDispatcher,
+)
 from proteinbert_tpu.serve.errors import (
     SequenceTooLongError, ServerClosedError,
 )
 from proteinbert_tpu.serve.queue import Request, RequestQueue
-from proteinbert_tpu.serve.scheduler import MicroBatchScheduler
+from proteinbert_tpu.serve.scheduler import (
+    MicroBatchScheduler, PackedBatchScheduler,
+)
 from proteinbert_tpu.serve.trace import RequestTrace, stride_sampled
+
+SERVE_MODES = ("bucketed", "ragged")
 
 
 class Server:
@@ -108,30 +114,68 @@ class Server:
         registry=None,
         heads=None,
         partition_heads: bool = False,
+        serve_mode: str = "bucketed",
+        pack_max_segments: int = 8,
     ):
         from proteinbert_tpu.obs import as_telemetry
 
         if on_long not in ("truncate", "reject"):
             raise ValueError(f"on_long must be 'truncate' or 'reject', "
                              f"got {on_long!r}")
+        if serve_mode not in SERVE_MODES:
+            raise ValueError(f"serve_mode must be one of {SERVE_MODES}, "
+                             f"got {serve_mode!r}")
         self.cfg = cfg
         self.on_long = on_long
         self.default_deadline_s = default_deadline_s
         self.clock = clock
+        self.serve_mode = serve_mode
         self.tele = as_telemetry(telemetry)
         metrics = self.tele.metrics
-        self.dispatcher = BucketDispatcher(
-            params, cfg, buckets=buckets, max_batch=max_batch,
-            batch_classes=batch_classes, mesh=mesh, metrics=metrics)
         self.cache = EmbeddingCache(cache_size, metrics=metrics)
         self.queue = RequestQueue(queue_depth)
-        self.scheduler = MicroBatchScheduler(
-            self.queue, self.dispatcher, self._finalize,
-            max_batch=max_batch, max_wait_s=max_wait_s, clock=clock,
-            partition_heads=partition_heads,
-            telemetry=telemetry, latency_observer=self._observe_latency,
-            expire_observer=self._count_expiry,
-            complete_observer=self._on_complete)
+        if serve_mode == "ragged":
+            # Ragged packed serving (ISSUE 9): heterogeneous requests
+            # PACK into fixed-shape (max_batch, seq_len) rows at their
+            # bucket-quantized spans — one warm executable per request
+            # kind, outputs matching the bucketed dispatcher's within
+            # the documented jitted tolerance (docs/serving.md).
+            # `max_batch` means packed ROWS per executable here; a
+            # batch carries up to max_batch * pack_max_segments
+            # requests.
+            if partition_heads:
+                raise ValueError(
+                    "partition_heads is a bucketed-mode baseline knob; "
+                    "ragged packing mixes heads through the shared "
+                    "trunk by construction")
+            if batch_classes is not None:
+                raise ValueError(
+                    "batch_classes is meaningless in ragged mode — the "
+                    "executable shape is fixed at (max_batch, seq_len)")
+            self.dispatcher = RaggedDispatcher(
+                params, cfg, buckets=buckets, rows_per_batch=max_batch,
+                max_segments=pack_max_segments, mesh=mesh,
+                metrics=metrics)
+            self.scheduler = PackedBatchScheduler(
+                self.queue, self.dispatcher, self._finalize,
+                rows_per_batch=max_batch, max_wait_s=max_wait_s,
+                clock=clock, max_segments=pack_max_segments,
+                telemetry=telemetry,
+                latency_observer=self._observe_latency,
+                expire_observer=self._count_expiry,
+                complete_observer=self._on_complete)
+        else:
+            self.dispatcher = BucketDispatcher(
+                params, cfg, buckets=buckets, max_batch=max_batch,
+                batch_classes=batch_classes, mesh=mesh, metrics=metrics)
+            self.scheduler = MicroBatchScheduler(
+                self.queue, self.dispatcher, self._finalize,
+                max_batch=max_batch, max_wait_s=max_wait_s, clock=clock,
+                partition_heads=partition_heads,
+                telemetry=telemetry,
+                latency_observer=self._observe_latency,
+                expire_observer=self._count_expiry,
+                complete_observer=self._on_complete)
         # Multi-tenant heads (ISSUE 8): an optional registry to resolve
         # head ids from, plus the resident trunk's fingerprint computed
         # LAZILY (one device→host fetch of the whole trunk — only paid
@@ -208,6 +252,31 @@ class Server:
         self._mirror_lock = threading.Lock()
         self.truncated_total = 0
         self.rejected_total = {r: 0 for r in self._rej_c}
+        # Fused-kernel fallback visibility (ISSUE 9 satellite): mirror
+        # kernels/fused_block fallback bumps (the packed rows this
+        # server dispatches take the XLA reference path under
+        # use_pallas — ROADMAP open item 2) into the registry so
+        # /metrics and stats() expose fused_kernel_fallback_total.
+        # Registered LAST — after every raising statement above — so a
+        # failed construction (bad SLO spec, trunk-mismatched head)
+        # cannot leak a process-global observer; drain()/abort()
+        # unregister it.
+        from proteinbert_tpu.kernels.fused_block import (
+            register_fallback_observer,
+        )
+
+        self._fallback_c: Dict[str, Any] = {}
+
+        def _mirror_fallback(reason: str,
+                             _metrics=metrics, _c=self._fallback_c) -> None:
+            c = _c.get(reason)
+            if c is None:
+                c = _c[reason] = _metrics.counter(
+                    "fused_kernel_fallback_total", reason=reason)
+            c.inc()
+
+        self._fallback_cb = _mirror_fallback
+        register_fallback_observer(self._fallback_cb)
 
     def _bump(self, mirror: str, reason: Optional[str] = None) -> None:
         with self._mirror_lock:
@@ -224,8 +293,11 @@ class Server:
             raise RuntimeError("server already started")
         warmed = self.dispatcher.warmup(self._warm_kinds)
         self.tele.emit("serve_start", pid=os.getpid(), config={
+            "serve_mode": self.serve_mode,
             "buckets": list(self.dispatcher.buckets),
             "batch_classes": list(self.dispatcher.batch_classes),
+            "pack_max_segments": getattr(self.dispatcher,
+                                         "max_segments", None),
             "max_batch": self.scheduler.max_batch,
             "max_wait_s": self.scheduler.max_wait_s,
             "queue_depth": self.queue.max_depth,
@@ -306,9 +378,17 @@ class Server:
         done = self.scheduler.join(timeout)
         if not self._ended:
             self._ended = True
+            self._release_fallback_observer()
             self.tele.emit("serve_end", outcome="drained",
                            stats=self.stats())
         return done
+
+    def _release_fallback_observer(self) -> None:
+        from proteinbert_tpu.kernels.fused_block import (
+            unregister_fallback_observer,
+        )
+
+        unregister_fallback_observer(self._fallback_cb)
 
     def abort(self) -> None:
         """Hard shutdown: fail all queued + pending work with
@@ -329,6 +409,7 @@ class Server:
         n = len(failed)
         if not self._ended:
             self._ended = True
+            self._release_fallback_observer()
             self.tele.emit("note", source="serve", kind="abort",
                            failed_requests=n)
             self.tele.emit("serve_end", outcome="aborted",
@@ -620,10 +701,22 @@ class Server:
                 "truncated": self.truncated_total,
                 "rejected": dict(self.rejected_total),
             }
+        from proteinbert_tpu.kernels.fused_block import FALLBACK_TOTAL
+
         qw = self.scheduler.queue_wait
         out = {
             "completed": self.completed_total,
             **mirrors,
+            "serve_mode": self.serve_mode,
+            # Executable-zoo accounting (ISSUE 9): warm trunk-level
+            # executables + cumulative warmup seconds — the numbers the
+            # ragged mode's O(kinds) collapse is measured by.
+            "executables": self.dispatcher.executable_count,
+            "warmup_seconds": round(self.dispatcher.warmup_seconds_total,
+                                    6),
+            # Process-wide fused-kernel fallback counts (trace-time,
+            # one per executable built on the XLA reference path).
+            "fused_fallback": dict(FALLBACK_TOTAL),
             "heads": len(self.dispatcher.heads),
             "batches": self.scheduler.batches_total,
             "batched_rows": self.scheduler.rows_total,

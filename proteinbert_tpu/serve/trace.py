@@ -68,6 +68,7 @@ class RequestTrace:
         "t_run0", "t_run1", "t_done",
         "bucket_len", "batch_class", "rows", "pad_fraction",
         "prep_s", "device_s", "cache", "outcome", "error", "head_id",
+        "segments", "segments_per_row", "mode",
     )
 
     def __init__(self, request_id: str, kind: str, now: float,
@@ -98,6 +99,12 @@ class RequestTrace:
                                             # per-head latency/error
                                             # attribution in
                                             # `pbt diagnose --serve`
+        # Ragged packed serving (ISSUE 9): how many requests (segments)
+        # shared the rider's packed batch, the batch's mean occupancy,
+        # and which dispatch mode ran it. None on the bucketed path.
+        self.segments: Optional[int] = None
+        self.segments_per_row: Optional[float] = None
+        self.mode: Optional[str] = None
 
     # ------------------------------------------------------------ marks
 
@@ -117,16 +124,25 @@ class RequestTrace:
     def mark_batch(self, bucket_len: int, batch_class: int, rows: int,
                    pad_fraction: Optional[float] = None,
                    prep_s: Optional[float] = None,
-                   device_s: Optional[float] = None) -> None:
+                   device_s: Optional[float] = None,
+                   segments: Optional[int] = None,
+                   segments_per_row: Optional[float] = None,
+                   mode: Optional[str] = None) -> None:
         """Batch-level context, stamped onto every rider of the batch
         (same executable, same padded grid — the attribution is shared
-        by construction)."""
+        by construction). On the ragged path `bucket_len` is the
+        rider's SPAN (its bucket-quantized length inside the packed
+        row), `batch_class` the executable's fixed row count, and
+        `segments`/`segments_per_row`/`mode` describe the packing."""
         self.bucket_len = bucket_len
         self.batch_class = batch_class
         self.rows = rows
         self.pad_fraction = pad_fraction
         self.prep_s = prep_s
         self.device_s = device_s
+        self.segments = segments
+        self.segments_per_row = segments_per_row
+        self.mode = mode
 
     # ---------------------------------------------------------- finish
 
@@ -209,7 +225,8 @@ class RequestTrace:
             "sampled": self.sampled,
         }
         for name in ("bucket_len", "batch_class", "rows", "pad_fraction",
-                     "prep_s", "device_s", "error", "head_id"):
+                     "prep_s", "device_s", "error", "head_id",
+                     "segments", "segments_per_row", "mode"):
             v = getattr(self, name)
             if v is not None:
                 fields[name] = v

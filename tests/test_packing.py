@@ -90,6 +90,88 @@ def test_planner_full_row_and_segment_cap():
     assert p.flush() == []
 
 
+def test_planner_fuzz_adversarial_streams():
+    """ISSUE 9 satellite: seeded fuzz over random length streams —
+    PackPlanner and the serving-side OnlinePacker must both uphold the
+    packing invariants on every stream: no row exceeds seq_len, no row
+    exceeds max_segments, every id is emitted exactly once, and the
+    plan is deterministic across re-runs."""
+    from proteinbert_tpu.data.packing import OnlinePacker
+
+    rng = np.random.default_rng(1234)
+    for trial in range(25):
+        seq_len = int(rng.integers(8, 200))
+        max_seg = int(rng.integers(1, 9))
+        max_open = int(rng.integers(1, 12))
+        n = int(rng.integers(1, 120))
+        # Adversarial mix: tiny, huge (clamped), exact-fit, and
+        # off-by-one lengths all appear.
+        lengths = rng.choice(
+            [1, 2, 3, seq_len // 2, seq_len - 1, seq_len,
+             seq_len + 17, int(rng.integers(1, 2 * seq_len))],
+            size=n).astype(int)
+
+        def run_planner():
+            p = PackPlanner(seq_len, max_seg, max_open)
+            groups = []
+            for rid, ln in enumerate(lengths):
+                groups += p.add(rid, int(ln))
+            groups += p.flush()
+            return groups
+
+        groups = run_planner()
+        assert groups == run_planner()  # deterministic re-run
+        seen = [r for g in groups for r in g]
+        assert sorted(seen) == list(range(n)), (trial, "ids lost/dup")
+        for g in groups:
+            assert 1 <= len(g) <= max_seg
+            assert sum(min(int(lengths[r]), seq_len) for r in g) <= seq_len
+
+        def run_online():
+            if seq_len < 2:
+                return None
+            op = OnlinePacker(seq_len, max_seg)
+            popped = []
+            for rid, ln in enumerate(lengths):
+                op.place(rid, min(max(int(ln), 1), seq_len))
+                if len(op) > max_open:  # caller-driven dispatch
+                    popped += op.pop_rows(max_open // 2 + 1)
+            popped += op.pop_rows(len(op))
+            return popped
+
+        rows = run_online()
+        assert rows == run_online()  # deterministic re-run
+        seen = [item[0] for row in rows for item in row]
+        assert sorted(seen) == list(range(n)), (trial, "online ids")
+        for row in rows:
+            assert 1 <= len(row) <= max_seg
+            # spans tile the row without overlap and stay in bounds
+            end = 0
+            for _, start, span in row:
+                assert start >= end and span >= 1
+                end = start + span
+            assert end <= seq_len
+
+
+def test_online_packer_expire_and_row_heads():
+    from proteinbert_tpu.data.packing import OnlinePacker
+
+    op = OnlinePacker(100, 4)
+    for rid, span in enumerate([60, 30, 50, 40]):
+        op.place(rid, span)
+    # first-fit: row0=[0(60),1(30)], row1=[2(50),3(40)]
+    assert op.row_heads() == [0, 2]
+    assert op.total_items() == 4
+    removed = op.expire(lambda r: r in (0, 2))
+    assert removed == [0, 2]
+    # holes keep later items' starts; rows survive while non-empty
+    assert op.row_heads() == [1, 3]
+    rows = op.pop_rows(5)
+    assert [(i, start) for row in rows for i, start, _ in row] == \
+        [(1, 60), (3, 50)]
+    assert len(op) == 0 and op.drain_items() == []
+
+
 def test_packed_iterator_shapes_and_invariants(ds, packed_batch):
     b = packed_batch
     assert b["tokens"].shape == (2, SEQ_LEN)

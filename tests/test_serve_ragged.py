@@ -1,0 +1,519 @@
+"""Ragged packed serving (proteinbert_tpu/serve/, ISSUE 9).
+
+Two tiers, mirroring tests/test_serve.py:
+
+- **pure-logic tests**: `PackedBatchScheduler` formation against a stub
+  packed dispatcher and a fake clock — first-fit placement geometry,
+  the open-frontier dispatch trigger, max-wait, deadline expiry inside
+  open rows, drain, fail_pending. Deterministic via `poll(now=)`.
+- **end-to-end tests**: one tiny untrained trunk (module fixture)
+  proving THE parity contract — every ragged-mode per-request output
+  matches the bucketed dispatcher's on identical traffic within the
+  documented jitted ≤1e-5 tolerance (PR 7 split-parity precedent;
+  bucket-quantized spans make the two programs compute the same math —
+  serve/dispatch.RaggedDispatcher module doc) — plus the O(kinds)
+  executable-count collapse, packed telemetry fields round-tripping the
+  schema validator, `pbt diagnose --serve` surfacing, and the
+  fused-kernel fallback counter satellite.
+"""
+
+import logging
+import threading
+import time
+from concurrent.futures import Future
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+import jax
+
+from proteinbert_tpu import inference
+from proteinbert_tpu.configs import (
+    CheckpointConfig, DataConfig, ModelConfig, OptimizerConfig,
+    PretrainConfig, TaskConfig, TrainConfig,
+)
+from proteinbert_tpu.data.vocab import ALPHABET
+from proteinbert_tpu.heads.registry import LoadedHead
+from proteinbert_tpu.models import finetune as ft_model
+from proteinbert_tpu.serve import (
+    DeadlineExceededError, PackedBatchScheduler, RaggedDispatcher,
+    Request, RequestQueue, Server, ServerClosedError,
+)
+from proteinbert_tpu.train import create_train_state
+
+SEQ_LEN = 48
+BUCKETS = (16, 32, 48)
+MODEL = ModelConfig(local_dim=16, global_dim=32, key_dim=8, num_heads=2,
+                    num_blocks=2, num_annotations=32, dtype="float32")
+
+
+def _cfg():
+    return PretrainConfig(
+        model=MODEL,
+        data=DataConfig(seq_len=SEQ_LEN, batch_size=4, buckets=BUCKETS),
+        optimizer=OptimizerConfig(warmup_steps=5),
+        train=TrainConfig(seed=0, max_steps=1),
+        checkpoint=CheckpointConfig(),
+    )
+
+
+@pytest.fixture(scope="module")
+def trunk():
+    cfg = _cfg()
+    state = create_train_state(jax.random.PRNGKey(cfg.train.seed), cfg)
+    return state.params, cfg
+
+
+@pytest.fixture(scope="module")
+def seqs():
+    rng = np.random.default_rng(11)
+    return ["".join(rng.choice(list(ALPHABET), size=int(n)))
+            for n in rng.integers(4, SEQ_LEN - 2, size=14)]
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> float:
+        self.t += dt
+        return self.t
+
+
+class StubRaggedDispatcher:
+    """Records packed batches; returns one token-of-proof per rider."""
+
+    def __init__(self, seq_len=SEQ_LEN, num_ann=4):
+        self.cfg = SimpleNamespace(
+            data=SimpleNamespace(seq_len=seq_len),
+            model=SimpleNamespace(num_annotations=num_ann))
+        self.calls = []
+        self.fail_with = None
+
+    def run_packed(self, kind, tokens, segment_ids, annotations, riders,
+                   heads=None):
+        if self.fail_with is not None:
+            raise self.fail_with
+        self.calls.append({
+            "kind": kind, "tokens": tokens.copy(),
+            "segment_ids": segment_ids.copy(),
+            "riders": [tuple(r) for r in riders]})
+        return [("ok", kind) + tuple(r) for r in riders]
+
+    def run_packed_timed(self, kind, tokens, segment_ids, annotations,
+                         riders, heads=None):
+        outs = self.run_packed(kind, tokens, segment_ids, annotations,
+                               riders, heads=heads)
+        real = int((tokens != 0).sum())
+        grid = tokens.size
+        return outs, {"pad_fraction": round(1 - real / grid, 6),
+                      "segments": len(riders),
+                      "segments_per_row": round(
+                          len(riders) / tokens.shape[0], 4)}
+
+
+def _req(kind="embed", seq="MKT", span=16, clock=None, deadline=None):
+    tokens = np.full(span, 7, np.int32)
+    return Request(kind=kind, seq=seq, tokens=tokens, bucket_len=span,
+                   future=Future(), enqueued_at=clock() if clock else 0.0,
+                   deadline=deadline)
+
+
+def _sched(dispatcher=None, rows=2, max_wait=0.01, clock=None,
+           max_segments=4, **kw):
+    q = RequestQueue(max_depth=64)
+    done = []
+
+    def finalize(req, row):  # the Server's _finalize resolves futures
+        done.append((req, row))
+        if not req.future.done():
+            req.future.set_result(row)
+
+    sched = PackedBatchScheduler(
+        q, dispatcher or StubRaggedDispatcher(), finalize,
+        rows_per_batch=rows, max_wait_s=max_wait,
+        clock=clock or FakeClock(), max_segments=max_segments, **kw)
+    return q, sched, done
+
+
+# ----------------------------------------------------- formation logic
+
+class TestPackedFormation:
+    def test_first_fit_geometry_rides_the_batch(self):
+        clock = FakeClock()
+        disp = StubRaggedDispatcher()
+        q, sched, done = _sched(disp, rows=2, clock=clock)
+        # spans 20+20 fill row0 to 40 (<48-2 left over), 20 opens row1
+        for s in ("a", "b", "c"):
+            q.push(_req(seq=s, span=20, clock=clock))
+        q.close()
+        assert sched.poll(clock()) == 3
+        (call,) = disp.calls
+        # riders: (row, seg0based, start, span), row-major
+        assert call["riders"] == [(0, 0, 0, 20), (0, 1, 20, 20),
+                                  (1, 0, 0, 20)]
+        assert (call["segment_ids"][0, :20] == 1).all()
+        assert (call["segment_ids"][0, 20:40] == 2).all()
+        assert (call["segment_ids"][0, 40:] == 0).all()
+        assert (call["segment_ids"][1, :20] == 1).all()
+        assert len(done) == 3
+
+    def test_open_frontier_trigger_keeps_newest_row(self):
+        clock = FakeClock()
+        disp = StubRaggedDispatcher()
+        q, sched, done = _sched(disp, rows=1, clock=clock)
+        # Two full-ish rows + a third opens: dispatch pops the OLDEST
+        # row only; the frontier row stays open for more fill.
+        for s in "abc":
+            q.push(_req(seq=s, span=40, clock=clock))
+        assert sched.poll(clock()) == 1      # >1 open rows -> oldest
+        assert sched.pending_rows() == 2
+        assert sched.poll(clock()) == 1      # still >1 (b, c)
+        assert sched.pending_rows() == 1
+        assert sched.poll(clock()) == 0      # one open row, not overdue
+        clock.advance(0.02)                  # max_wait trigger
+        assert sched.poll(clock()) == 1
+        assert sched.pending_rows() == 0
+
+    def test_max_wait_dispatches_underfull(self):
+        clock = FakeClock()
+        q, sched, done = _sched(rows=4, clock=clock)
+        q.push(_req(span=16, clock=clock))
+        assert sched.poll(clock()) == 0
+        clock.advance(0.005)
+        assert sched.poll(clock()) == 0      # not overdue yet
+        clock.advance(0.006)
+        assert sched.poll(clock()) == 1      # overdue -> ships 1 rider
+        assert len(done) == 1
+
+    def test_deadline_expires_inside_open_row(self):
+        clock = FakeClock()
+        q, sched, done = _sched(rows=4, clock=clock)
+        doomed = _req(span=16, clock=clock, deadline=clock() + 0.002)
+        live = _req(span=16, clock=clock)
+        q.push(doomed)
+        q.push(live)
+        sched.poll(clock())                  # ingest + pack, no dispatch
+        clock.advance(0.005)                 # past doomed's deadline
+        sched.poll(clock())
+        with pytest.raises(DeadlineExceededError):
+            doomed.future.result(timeout=0)
+        assert sched.expired_total == 1
+        clock.advance(0.01)
+        assert sched.poll(clock()) == 1      # live one still ships
+        assert live.future.result(timeout=0)[0] == "ok"
+
+    def test_dispatch_failure_fails_batch_only(self):
+        clock = FakeClock()
+        disp = StubRaggedDispatcher()
+        q, sched, done = _sched(disp, rows=1, clock=clock)
+        boom = RuntimeError("device on fire")
+        disp.fail_with = boom
+        r1 = _req(span=16, clock=clock)
+        q.push(r1)
+        clock.advance(0.02)
+        assert sched.poll(clock()) == 1
+        assert r1.future.exception(timeout=0) is boom
+        disp.fail_with = None
+        r2 = _req(span=16, clock=clock)
+        q.push(r2)
+        clock.advance(0.02)
+        assert sched.poll(clock()) == 1      # scheduler survived
+        assert r2.future.result(timeout=0)[0] == "ok"
+
+    def test_fail_pending_drains_packed_rows(self):
+        clock = FakeClock()
+        q, sched, done = _sched(rows=8, clock=clock)
+        reqs = [_req(seq=s, span=16, clock=clock) for s in "abcd"]
+        for r in reqs:
+            q.push(r)
+        sched.poll(clock())                  # packed, not dispatched
+        failed = sched.fail_pending(ServerClosedError("abort"))
+        assert [id(r) for r in failed] == [id(r) for r in reqs]
+        for r in reqs:
+            with pytest.raises(ServerClosedError):
+                r.future.result(timeout=0)
+        assert sched.pending_rows() == 0
+
+    def test_formation_deterministic_under_fake_clock(self):
+        def run():
+            clock = FakeClock()
+            disp = StubRaggedDispatcher()
+            q, sched, _ = _sched(disp, rows=2, clock=clock)
+            rng = np.random.default_rng(5)
+            for i in range(12):
+                q.push(_req(seq=str(i), span=int(rng.choice(BUCKETS)),
+                            clock=clock))
+                clock.advance(0.001)
+                sched.poll(clock())
+            q.close()
+            while sched.poll(clock()):
+                pass
+            return [c["riders"] for c in disp.calls]
+
+        assert run() == run()
+
+
+# ------------------------------------------------------- end to end
+
+def _drain_poll(srv, futs):
+    srv.queue.close()
+    while srv.scheduler.poll():
+        pass
+    return [f.result(timeout=5) for f in futs]
+
+
+def _serve(trunk, mode, kind, seqs, heads=None, head_of=None, **kw):
+    params, cfg = trunk
+    srv = Server(params, cfg, max_batch=4, max_wait_s=60.0, cache_size=0,
+                 warm_kinds=(), serve_mode=mode, heads=heads, **kw)
+    futs = [srv.submit(kind, s,
+                       head_id=head_of(i) if head_of else None)
+            for i, s in enumerate(seqs)]
+    out = _drain_poll(srv, futs)
+    stats = srv.stats()
+    srv.drain(timeout=10)
+    return out, stats
+
+
+class TestRaggedParity:
+    """THE acceptance gate: identical traffic, bucketed vs ragged,
+    per-request outputs within the documented jitted ≤1e-5 tolerance."""
+
+    def test_embed_parity_and_executable_collapse(self, trunk, seqs):
+        b, bs = _serve(trunk, "bucketed", "embed", seqs)
+        r, rs = _serve(trunk, "ragged", "embed", seqs)
+        for x, y in zip(b, r):
+            np.testing.assert_allclose(x["global"], y["global"],
+                                       atol=1e-5, rtol=1e-5)
+            np.testing.assert_allclose(x["local_mean"], y["local_mean"],
+                                       atol=1e-5, rtol=1e-5)
+        # O(kinds): one packed executable for the one kind served.
+        assert rs["executables"] == 1
+        assert bs["executables"] > rs["executables"]
+        assert rs["serve_mode"] == "ragged"
+
+    def test_predict_go_parity(self, trunk, seqs):
+        b, _ = _serve(trunk, "bucketed", "predict_go", seqs)
+        r, _ = _serve(trunk, "ragged", "predict_go", seqs)
+        for x, y in zip(b, r):
+            np.testing.assert_allclose(x, y, atol=1e-5, rtol=1e-5)
+
+    def test_predict_residues_parity_shapes_and_fill(self, trunk, seqs):
+        masked = [s[:2] + "?" + s[3:] if len(s) > 3 else s for s in seqs]
+        b, _ = _serve(trunk, "bucketed", "predict_residues", masked)
+        r, _ = _serve(trunk, "ragged", "predict_residues", masked)
+        for (bf, bp), (rf, rp) in zip(b, r):
+            assert bp.shape == rp.shape  # (bucket_len == span, V)
+            np.testing.assert_allclose(bp, rp, atol=1e-5, rtol=1e-5)
+            assert bf == rf              # same argmax fills
+
+    def test_predict_task_mixed_heads_parity(self, trunk, seqs):
+        tasks = [TaskConfig(kind="token_classification", num_outputs=4),
+                 TaskConfig(kind="sequence_classification", num_outputs=3),
+                 TaskConfig(kind="sequence_regression", num_outputs=1)]
+        heads = [LoadedHead(f"h{i}", f"h{i}", t,
+                            ft_model.head_init(jax.random.PRNGKey(i + 1),
+                                               MODEL, t), {})
+                 for i, t in enumerate(tasks)]
+        b, _ = _serve(trunk, "bucketed", "predict_task", seqs,
+                      heads=heads, head_of=lambda i: f"h{i % 3}")
+        r, rs = _serve(trunk, "ragged", "predict_task", seqs,
+                       heads=heads, head_of=lambda i: f"h{i % 3}")
+        for i, (x, y) in enumerate(zip(b, r)):
+            assert x.shape == y.shape, i
+            np.testing.assert_allclose(x, y, atol=1e-5, rtol=1e-5)
+        # One shared packed trunk; tails don't count as trunk shapes.
+        assert rs["executables"] == 1
+
+    def test_ragged_cache_short_circuits(self, trunk):
+        params, cfg = trunk
+        srv = Server(params, cfg, max_batch=2, max_wait_s=60.0,
+                     cache_size=8, warm_kinds=(), serve_mode="ragged")
+        f1 = srv.submit("embed", "MKTAYIAK")
+        _drain_poll(srv, [f1])
+        f2 = srv.submit("embed", "MKTAYIAK")  # hit: resolved future
+        assert f2.done()
+        np.testing.assert_array_equal(f1.result()["global"],
+                                      f2.result()["global"])
+        assert srv.cache_hit_returns == 1
+        srv.drain(timeout=10)
+
+    def test_ragged_drain_no_loss_under_threads(self, trunk, seqs):
+        params, cfg = trunk
+        srv = Server(params, cfg, max_batch=2, max_wait_s=0.002,
+                     cache_size=0, warm_kinds=("embed",),
+                     serve_mode="ragged").start()
+        futs = []
+        lock = threading.Lock()
+
+        def client(w):
+            for s in seqs[w::4]:
+                f = srv.submit("embed", s)
+                with lock:
+                    futs.append(f)
+
+        threads = [threading.Thread(target=client, args=(w,))
+                   for w in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        assert srv.drain(timeout=30)
+        assert len(futs) == len(seqs)
+        for f in futs:
+            assert f.result(timeout=5)["global"].shape == (
+                MODEL.global_dim,)
+        srv.close()
+
+
+class TestRaggedTelemetry:
+    def test_packed_events_validate_and_diagnose(self, trunk, seqs,
+                                                 tmp_path):
+        from proteinbert_tpu.obs import Telemetry, read_events
+        from proteinbert_tpu.obs.diagnose import (
+            render_serve, summarize_serve,
+        )
+
+        params, cfg = trunk
+        path = tmp_path / "events.jsonl"
+        tele = Telemetry(events_path=str(path))
+        srv = Server(params, cfg, max_batch=2, max_wait_s=0.005,
+                     cache_size=0, warm_kinds=("embed",),
+                     serve_mode="ragged", telemetry=tele,
+                     trace_sample_rate=1.0)
+        srv.scheduler.time_batches = True
+        srv.start()
+        futs = [srv.submit("embed", s) for s in seqs]
+        for f in futs:
+            f.result(timeout=30)
+        srv.drain(timeout=30)
+        tele.close()
+
+        recs = read_events(str(path), strict=True)  # schema-valid
+        batches = [r for r in recs if r["event"] == "serve_batch"]
+        assert batches
+        for b in batches:
+            assert b["mode"] == "ragged"
+            assert b["bucket_len"] == SEQ_LEN
+            assert b["rows"] == 2
+            assert 1 <= b["segments"] <= 2 * 8
+            assert 0.0 <= b["pad_fraction"] <= 1.0
+        reqs = [r for r in recs if r["event"] == "serve_request"]
+        assert reqs
+        for r in reqs:
+            assert r["mode"] == "ragged"
+            assert r["segments"] >= 1
+            # span rides the bucket_len field: a real bucket, not L
+            assert r["bucket_len"] in BUCKETS
+        start = next(r for r in recs if r["event"] == "serve_start")
+        assert start["config"]["serve_mode"] == "ragged"
+
+        summary = summarize_serve(recs)
+        assert summary["batches"]["modes"] == {"ragged": len(batches)}
+        assert summary["batches"]["segments"] == len(seqs)
+        assert summary["batches"]["mean_segments_per_row"] > 0
+        assert summary["executables"]["count"] == 1
+        assert summary["executables"]["serve_mode"] == "ragged"
+        text = render_serve(summary)
+        assert "packed:" in text and "executables: 1 warm" in text
+        # pad_wasted attribution (the ragged lever) present
+        assert any("pad_wasted" in k
+                   for k in summary["stage_attribution"])
+
+    def test_executable_gauges_track_warmup(self, trunk):
+        from proteinbert_tpu.obs import Telemetry
+
+        params, cfg = trunk
+        tele = Telemetry()
+        srv = Server(params, cfg, max_batch=2, max_wait_s=60.0,
+                     cache_size=0, warm_kinds=("embed", "predict_go"),
+                     serve_mode="ragged", telemetry=tele)
+        srv.start()
+        m = tele.metrics
+        assert m.gauge("serve_executable_count").value == 2  # O(kinds)
+        assert m.gauge("serve_warmup_seconds_total").value > 0
+        assert srv.stats()["executables"] == 2
+        srv.drain(timeout=10)
+
+
+class TestFusedFallbackCounter:
+    def test_counter_observer_and_one_time_warning(self, caplog):
+        import jax.numpy as jnp
+
+        from proteinbert_tpu.kernels import fused_block as fb
+
+        before = fb.FALLBACK_TOTAL.get("segments", 0)
+        seen = []
+        fb.register_fallback_observer(seen.append)
+        try:
+            params = {
+                "narrow_conv": {"kernel": jnp.zeros((3, 4, 4)),
+                                "bias": jnp.zeros(4)},
+                "wide_conv": {"kernel": jnp.zeros((3, 4, 4)),
+                              "bias": jnp.zeros(4)},
+                "local_ln1": {"scale": jnp.ones(4), "bias": jnp.zeros(4)},
+                "local_dense": {"kernel": jnp.eye(4),
+                                "bias": jnp.zeros(4)},
+                "local_ln2": {"scale": jnp.ones(4), "bias": jnp.zeros(4)},
+            }
+            x = jnp.zeros((1, 8, 4))
+            seg = jnp.ones((1, 8), jnp.int32)
+            with caplog.at_level(logging.WARNING,
+                                 logger=fb.logger.name):
+                fb.fused_local_track_segments(params, x, x, seg)
+                fb.fused_local_track_segments(params, x, x, seg)
+        finally:
+            fb.unregister_fallback_observer(seen.append)
+        assert fb.FALLBACK_TOTAL["segments"] == before + 2
+        assert seen == ["segments", "segments"]
+        warnings = [r for r in caplog.records
+                    if "fused_kernel_fallback_total" in r.getMessage()]
+        assert len(warnings) <= 1  # one-time (0 if an earlier test won)
+
+    def test_server_mirrors_fallback_into_registry(self, trunk):
+        from proteinbert_tpu.kernels import fused_block as fb
+        from proteinbert_tpu.obs import Telemetry
+
+        params, cfg = trunk
+        tele = Telemetry()
+        srv = Server(params, cfg, max_batch=2, max_wait_s=60.0,
+                     cache_size=0, warm_kinds=(), serve_mode="ragged",
+                     telemetry=tele)
+        fb._note_fallback("segments")
+        c = tele.metrics.counter("fused_kernel_fallback_total",
+                                 reason="segments")
+        assert c.value == 1
+        assert srv.stats()["fused_fallback"]["segments"] >= 1
+        srv.drain(timeout=10)
+        fb._note_fallback("segments")  # after drain: observer released
+        assert c.value == 1
+
+
+class TestRaggedDispatcherContracts:
+    def test_mesh_rejected_with_clear_error(self, trunk):
+        params, cfg = trunk
+        mesh = object()
+        with pytest.raises(ValueError, match="ragged serving"):
+            RaggedDispatcher(params, cfg, mesh=mesh)
+
+    def test_bucketed_api_refuses_packed_dispatcher(self, trunk):
+        params, cfg = trunk
+        d = RaggedDispatcher(params, cfg, rows_per_batch=2)
+        with pytest.raises(NotImplementedError, match="run_packed"):
+            d.run("embed", np.zeros((2, SEQ_LEN), np.int32))
+
+    def test_server_mode_validation(self, trunk):
+        params, cfg = trunk
+        with pytest.raises(ValueError, match="serve_mode"):
+            Server(params, cfg, serve_mode="packed")
+        with pytest.raises(ValueError, match="partition_heads"):
+            Server(params, cfg, serve_mode="ragged",
+                   partition_heads=True)
+        with pytest.raises(ValueError, match="batch_classes"):
+            Server(params, cfg, serve_mode="ragged",
+                   batch_classes=(2, 4))

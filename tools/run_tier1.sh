@@ -62,10 +62,26 @@ echo "=== serve smoke (in-process server, CPU, concurrent clients) ==="
 timeout -k 10 420 env JAX_PLATFORMS=cpu \
   PBT_SERVE_BENCH_SEQ_LEN=256 PBT_SERVE_BENCH_DIM=32 \
   PBT_SERVE_BENCH_REQUESTS=64 PBT_SERVE_BENCH_CLIENTS=24 \
-  PBT_SERVE_BENCH_TRACE_ROUNDS=3 \
+  PBT_SERVE_BENCH_TRACE_ROUNDS=3 PBT_SERVE_BENCH_PHASES=core \
   python "$(dirname "$0")/../bench.py" --serve
 rcs=$?
 [ "$rc" -eq 0 ] && rc=$rcs
+
+# Ragged serve smoke (ISSUE 9 satellite): bucketed vs ragged packed
+# serving on a mixed-length log-normal workload. GATED: per-request
+# parity within the documented jitted 1e-5 tolerance (matched ladder vs
+# the live bucketed server, dense ladder vs the offline dense-bucketed
+# reference), no lost requests, ragged warm-executable count O(kinds).
+# Wall-clock speedup and pad_wasted are reported, not gated.
+echo "=== ragged serve smoke (bucketed vs packed A/B, mixed lengths) ==="
+timeout -k 10 420 env JAX_PLATFORMS=cpu \
+  PBT_SERVE_BENCH_SEQ_LEN=256 PBT_SERVE_BENCH_DIM=32 \
+  PBT_SERVE_BENCH_REQUESTS=96 PBT_SERVE_BENCH_CLIENTS=12 \
+  PBT_SERVE_BENCH_PHASES=ragged PBT_SERVE_BENCH_RAGGED_ROUNDS=3 \
+  python "$(dirname "$0")/../bench.py" --serve \
+  --serve-length-mix 'median=32,sigma=1.0,seed=7'
+rcr=$?
+[ "$rc" -eq 0 ] && rc=$rcr
 
 # Multi-tenant heads smoke (ISSUE 8 satellite): the platform loop end
 # to end — tiny finetune → register into a head registry → serve one

@@ -75,6 +75,23 @@ def self_test() -> int:
          "stages": {}, "head_id": 17},  # head_id must be a string
         {"v": 1, "event": "serve_reject", "seq": 0, "t": 0.0,
          "reason": "no_such_reason"},  # unknown_head is valid; this isn't
+        # ragged packed serving (ISSUE 9): packed fields are optional
+        # but TYPED — a writer bug must not slip through as "extra".
+        {"v": 1, "event": "serve_batch", "seq": 0, "t": 0.0,
+         "kind": "embed", "bucket_len": 256, "rows": 4,
+         "segments": -1},  # segments must be >= 0
+        {"v": 1, "event": "serve_batch", "seq": 0, "t": 0.0,
+         "kind": "embed", "bucket_len": 256, "rows": 4,
+         "mode": "bogus"},  # mode is bucketed|ragged
+        {"v": 1, "event": "serve_batch", "seq": 0, "t": 0.0,
+         "kind": "embed", "bucket_len": 256, "rows": 4,
+         "pad_fraction": 1.5},  # pad_fraction in [0, 1]
+        {"v": 1, "event": "serve_request", "seq": 0, "t": 0.0,
+         "kind": "embed", "outcome": "ok", "request_id": "r1",
+         "stages": {}, "segments_per_row": -2.0},  # must be >= 0
+        {"v": 1, "event": "serve_request", "seq": 0, "t": 0.0,
+         "kind": "embed", "outcome": "ok", "request_id": "r1",
+         "stages": {}, "mode": "packed"},  # not a serve mode
     ]
     for rec in bad:
         try:

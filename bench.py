@@ -734,6 +734,13 @@ def run_pack():
 
     unpacked = measure(next(make_pretrain_iterator(ds, batch, seed=0)))
     packed = measure(next(make_packed_iterator(ds, batch, seed=0)))
+
+    # ---- fused-vs-reference packed A/B (ISSUE 10 satellite) ----------
+    failures = []
+    fused_ab = None
+    if int(os.environ.get("PBT_PACK_BENCH_FUSED_AB", 1)):
+        fused_ab = _pack_fused_ab(model, ds, batch, failures)
+
     record = {
         "metric": "packed_throughput",
         "platform": jax.devices()[0].platform,
@@ -744,6 +751,8 @@ def run_pack():
         "effective_speedup_x": round(
             packed["effective_residues_per_sec"]
             / max(unpacked["effective_residues_per_sec"], 1e-9), 2),
+        "fused_ab": fused_ab,
+        "failures": failures,
     }
     try:  # mirror onto the shared bench event stream (best-effort)
         from proteinbert_tpu.obs.events import EventLog
@@ -755,10 +764,173 @@ def run_pack():
                 effective_speedup_x=record["effective_speedup_x"],
                 packed_pad_fraction=packed["pad_fraction"],
                 unpacked_pad_fraction=unpacked["pad_fraction"])
+        if fused_ab is not None:
+            # Separate note so tools/bench_trajectory.py fits the
+            # fused-packed series independently of the pack capture.
+            ev.emit("note", source="bench", kind="pack_fused_capture",
+                    platform=record["platform"], seq_len=seq_len,
+                    batch=batch, fused_dim=fused_ab["fused_dim"],
+                    fused_supported=fused_ab["supported"],
+                    fused_speedup_x=fused_ab["fused_speedup_x"],
+                    parity_max_abs_diff=fused_ab["parity_max_abs_diff"],
+                    pallas_executables=fused_ab["pallas_executables"],
+                    segment_fallbacks=fused_ab["segment_fallbacks"],
+                    failures=len(failures))
         ev.close()
     except Exception as e:
         print(f"bench events stream unavailable: {e}", file=sys.stderr)
     print(json.dumps(record))
+    if failures:
+        for f in failures:
+            print(f"PACK CONTRACT FAILURE: {f}", file=sys.stderr)
+        sys.exit(1)
+
+
+def _pack_fused_ab(model, ds, batch, failures):
+    """Fused-vs-reference packed A/B (`bench.py --pack`, ISSUE 10): the
+    SAME packed batch runs the segment-aware Pallas fused path and the
+    XLA reference path at a lane-aligned dim (the fused kernel needs
+    C % 128 == 0, so the main capture's historical dim series stays
+    untouched and the A/B gets its own PBT_PACK_BENCH_FUSED_DIM,
+    default 128).
+
+    GATED (appended to `failures`, nonzero exit):
+    - fused-vs-reference parity within the documented jitted 1e-5
+      tolerance on local and global logits;
+    - on a supported shape, the fused arm must actually take the
+      Pallas path (`fused_kernel_path_total{path=pallas,reason=packed}`
+      bumps) with ZERO reason=segments fallbacks;
+    - the PBT_FORCE_REFERENCE_KERNEL debug override must route a fresh
+      trace onto the reference path (and agree with it bit-for-bit).
+
+    Wall-clock speedup is REPORTED, not gated: off-TPU the kernel runs
+    in interpret mode, so the CPU number is a plumbing check — the TPU
+    capture is the MFU claim (docs/performance.md, packed fast path).
+    """
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+
+    from proteinbert_tpu.configs import ModelConfig
+    from proteinbert_tpu.data import make_packed_iterator
+    from proteinbert_tpu.kernels import fused_block as fb
+    from proteinbert_tpu.models import proteinbert
+
+    fused_dim = int(os.environ.get("PBT_PACK_BENCH_FUSED_DIM", 128))
+    reps = int(os.environ.get("PBT_PACK_BENCH_FUSED_REPS", 3))
+    forced_env = fb.force_reference_requested()
+
+    pbatch = next(make_packed_iterator(ds, batch, seed=0))
+    seq_len = int(pbatch["tokens"].shape[1])
+    S = int(pbatch["annotations"].shape[1])
+    fused_model = ModelConfig(**{**model.__dict__,
+                                 "local_dim": fused_dim,
+                                 "use_pallas": True})
+    ref_model = ModelConfig(**{**model.__dict__,
+                               "local_dim": fused_dim,
+                               "use_pallas": False})
+    params = proteinbert.init(jax.random.PRNGKey(0), fused_model)
+
+    @partial(jax.jit, static_argnames="mcfg")
+    def fwd(p, tokens, seg, ann, mcfg):
+        return proteinbert.apply(p, tokens, ann, mcfg, segment_ids=seg)
+
+    t = jnp.asarray(pbatch["tokens"])
+    s = jnp.asarray(pbatch["segment_ids"])
+    a = jnp.asarray(pbatch["annotations"])
+    supported = fb.pallas_segments_supported(
+        fused_dim, seq_len, S, fused_model.dtype,
+        fused_model.narrow_kernel, fused_model.wide_kernel,
+        fused_model.wide_dilation)
+
+    before = dict(fb.PATH_TOTAL)
+    out_f = jax.block_until_ready(fwd(params, t, s, a, fused_model))
+    after = dict(fb.PATH_TOTAL)
+    pallas_bumps = (after.get(("pallas", "packed"), 0)
+                    - before.get(("pallas", "packed"), 0))
+    seg_falls = (after.get(("reference", "segments"), 0)
+                 - before.get(("reference", "segments"), 0))
+    out_r = jax.block_until_ready(fwd(params, t, s, a, ref_model))
+
+    max_diff = max(
+        float(np.abs(np.asarray(x, np.float32)
+                     - np.asarray(y, np.float32)).max())
+        for x, y in zip(out_f, out_r))
+    if not all(np.allclose(np.asarray(x, np.float32),
+                           np.asarray(y, np.float32),
+                           atol=1e-5, rtol=1e-5)
+               for x, y in zip(out_f, out_r)):
+        failures.append(
+            f"packed fused-vs-reference parity broke: max |diff| "
+            f"{max_diff:.2e} outside the documented 1e-5 jitted "
+            "tolerance")
+    if supported and not forced_env:
+        if pallas_bumps < 1:
+            failures.append(
+                "packed fused arm did not take the Pallas path on a "
+                f"supported shape (C={fused_dim}, L={seq_len}, S={S})")
+        if seg_falls:
+            failures.append(
+                f"{seg_falls} reason=segments fallback(s) on a "
+                "supported shape — the packed fast path regressed")
+
+    def clock(mcfg):
+        # Await the warm dispatch: an un-awaited async call would bleed
+        # up to one full forward of device work into the timed loop.
+        jax.block_until_ready(fwd(params, t, s, a, mcfg))
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            jax.block_until_ready(fwd(params, t, s, a, mcfg))
+        return (time.perf_counter() - t0) / reps
+
+    dt_f, dt_r = clock(fused_model), clock(ref_model)
+
+    # Debug-override probe: a FRESH jit function forces a new trace, so
+    # the env var (read at trace time) must land it on the reference
+    # path — and the reference path is deterministic, so the outputs
+    # match the use_pallas=False arm bit-for-bit.
+    forced = None
+    if not forced_env:
+        os.environ[fb.FORCE_REFERENCE_ENV] = "1"
+        try:
+            b2 = dict(fb.PATH_TOTAL)
+            forced_fn = jax.jit(
+                lambda p, tt, ss, aa: proteinbert.apply(
+                    p, tt, aa, fused_model, segment_ids=ss))
+            out_fo = jax.block_until_ready(forced_fn(params, t, s, a))
+            a2 = dict(fb.PATH_TOTAL)
+            bumps = (a2.get(("reference", "forced"), 0)
+                     - b2.get(("reference", "forced"), 0))
+            bit = all(np.array_equal(np.asarray(x), np.asarray(y))
+                      for x, y in zip(out_fo, out_r))
+            forced = {"forced_bumps": bumps, "bit_identical": bit}
+            if bumps < 1:
+                failures.append(
+                    "PBT_FORCE_REFERENCE_KERNEL did not route a fresh "
+                    "trace onto the reference path")
+            elif not bit:
+                failures.append(
+                    "forced-reference probe diverged from the "
+                    "use_pallas=False reference arm")
+        finally:
+            del os.environ[fb.FORCE_REFERENCE_ENV]
+
+    return {
+        "fused_dim": fused_dim, "seq_len": seq_len, "max_segments": S,
+        "supported": bool(supported),
+        "pallas_executables": int(pallas_bumps),
+        "segment_fallbacks": int(seg_falls),
+        "parity_max_abs_diff": float(f"{max_diff:.3e}"),
+        "fused_ms_per_fwd": round(dt_f * 1e3, 2),
+        "reference_ms_per_fwd": round(dt_r * 1e3, 2),
+        # Reported, not gated: interpret-mode CPU wall-clock is a
+        # plumbing number, the TPU capture is the claim.
+        "fused_speedup_x": round(dt_r / max(dt_f, 1e-9), 3),
+        "forced_reference_probe": forced,
+        "path_total": {f"{p}/{r}": n
+                       for (p, r), n in sorted(fb.PATH_TOTAL.items())},
+    }
 
 
 def parse_length_mix(spec):
@@ -824,6 +996,13 @@ def _serve_ragged_ab(Server, params, cfg, seqs, max_batch, max_wait_s,
     if dense_buckets[-1] != seq_len:
         dense_buckets = dense_buckets + (seq_len,)
     tdir = tempfile.mkdtemp(prefix="pbt_serve_ragged_")
+    # Fused-path coverage across the whole A/B (ISSUE 10): under
+    # use_pallas, the ragged arms' packed executables must land on the
+    # Pallas fast path when the kernel supports the shape — gated
+    # below from the trace-time PATH_TOTAL delta.
+    from proteinbert_tpu.kernels import fused_block as _fb
+
+    path_before = dict(_fb.PATH_TOTAL)
     arms = (("bucketed", "bucketed", None),
             ("ragged", "ragged", None),
             ("ragged_dense", "ragged", dense_buckets))
@@ -950,6 +1129,31 @@ def _serve_ragged_ab(Server, params, cfg, seqs, max_batch, max_wait_s,
             failures.append(
                 f"{name} executable count {stats[name]['executables']} "
                 "> O(kinds)=1 for the single warmed kind")
+    # ---- fused fast-path coverage gate (ISSUE 10 acceptance) ---------
+    path_delta = {k: _fb.PATH_TOTAL.get(k, 0) - path_before.get(k, 0)
+                  for k in set(_fb.PATH_TOTAL) | set(path_before)
+                  if _fb.PATH_TOTAL.get(k, 0) != path_before.get(k, 0)}
+    fused_path = {
+        "use_pallas": bool(cfg.model.use_pallas),
+        "delta": {f"{p}/{r}": n for (p, r), n in sorted(path_delta.items())},
+    }
+    if cfg.model.use_pallas and not _fb.force_reference_requested():
+        seg_supported = _fb.pallas_segments_supported(
+            cfg.model.local_dim, seq_len,
+            servers["ragged"].dispatcher.max_segments, cfg.model.dtype,
+            cfg.model.narrow_kernel, cfg.model.wide_kernel,
+            cfg.model.wide_dilation)
+        fused_path["segments_supported"] = bool(seg_supported)
+        if seg_supported:
+            if path_delta.get(("pallas", "packed"), 0) < 1:
+                failures.append(
+                    "ragged A/B under use_pallas: no packed executable "
+                    "took the Pallas fast path on a supported shape")
+            if path_delta.get(("reference", "segments"), 0):
+                failures.append(
+                    f"ragged A/B under use_pallas: "
+                    f"{path_delta[('reference', 'segments')]} "
+                    "reason=segments fallback(s) on a supported shape")
     for srv in servers.values():
         srv.drain(timeout=60)
     for tele in teles.values():
@@ -1011,6 +1215,7 @@ def _serve_ragged_ab(Server, params, cfg, seqs, max_batch, max_wait_s,
         "speedup_ge_1_2x": bool(max(speedup, speedup_dense) >= 1.2),
         "parity": parity,
         "parity_dense": parity_dense,
+        "fused_path": fused_path,
     }
 
 
@@ -1138,9 +1343,15 @@ def run_serve(length_mix=None):
     median = int(os.environ.get("PBT_SERVE_BENCH_MEDIAN_LEN", seq_len // 10))
     max_wait_s = 0.01
 
+    # PBT_SERVE_BENCH_USE_PALLAS=1: serve through the fused Pallas
+    # local track (interpret mode off-TPU) — with a lane-aligned DIM
+    # (128+) the ragged arms run the segment-aware packed fast path and
+    # phase 4 GATES that coverage (ISSUE 10 acceptance).
+    use_pallas = bool(int(os.environ.get("PBT_SERVE_BENCH_USE_PALLAS", 0)))
     model = ModelConfig(local_dim=dim, global_dim=2 * dim, key_dim=16,
                         num_heads=4, num_blocks=2,
-                        num_annotations=max(4 * dim, 128), dtype="float32")
+                        num_annotations=max(4 * dim, 128),
+                        dtype="float32", use_pallas=use_pallas)
     buckets = tuple(sorted({max(16, seq_len // 8), seq_len // 4,
                             seq_len // 2, seq_len}))
     cfg = PretrainConfig(

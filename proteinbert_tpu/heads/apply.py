@@ -61,7 +61,10 @@ def packed_trunk_batch(params, tokens, segment_ids, annotations,
     which heads consume it — the packed sibling of `trunk_batch`.
     `seg_mask` is True only at a segment's REAL token positions (a
     bucket-quantized span's <pad> tail is excluded), so the head tails
-    pool exactly the positions the bucketed path's pad_mask keeps."""
+    pool exactly the positions the bucketed path's pad_mask keeps.
+    Under cfg.use_pallas the trunk's local track runs the segment-
+    aware fused Pallas kernel on supported shapes (ISSUE 10) — the
+    shared packed trunk executable is a fast-path executable."""
     from proteinbert_tpu import inference
     from proteinbert_tpu.data.vocab import PAD_ID
 

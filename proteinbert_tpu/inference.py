@@ -120,7 +120,11 @@ def _packed_encode_batch(params, tokens, segment_ids, annotations,
     representations. Per-segment math mirrors the bucketed entry
     row-for-row (mask-weighted mean over real positions), so a span's
     outputs match the bucketed dispatcher's within jitted tolerance
-    (docs/serving.md, ragged batching)."""
+    (docs/serving.md, ragged batching). Under cfg.use_pallas the local
+    track runs the segment-aware fused Pallas kernel on supported
+    shapes (kernels/fused_block.fused_local_track_segments, ISSUE 10)
+    — the packed executables this builds are fast-path executables,
+    counted in fused_kernel_path_total{path=pallas,reason=packed}."""
     pad_mask = tokens != PAD_ID
     local, global_ = proteinbert.encode(params, tokens, annotations, cfg,
                                         pad_mask=pad_mask,

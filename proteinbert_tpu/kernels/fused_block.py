@@ -25,6 +25,18 @@ recomputes the plain-JAX composition (`local_track_reference`) and
 differentiates it — i.e. the kernel behaves like a rematerialised
 (jax.checkpoint) block, saving only (params, x, broadcast).
 
+PACKED rows (data/packing.py) run a SEGMENT-AWARE variant of the same
+kernel (`fused_local_track_segments`, ISSUE 10): each tap's shifted
+matmul operand is masked by segment-id equality inside the block (a
+one-hot lane reduction — exact 0.0 across boundaries, the
+`_segment_conv` semantics), and the per-position global→local
+broadcast is gathered from each position's own segment IN the kernel
+as a (TL, S) @ (S, C) one-hot matmul, so the packed fast path never
+materialises the (B, L, C) broadcast tensor. Scope: the
+weights-resident C <= MAX_PALLAS_DIM regime (`pallas_segments_
+supported`); other shapes fall back to the XLA reference path, counted
+in `PATH_TOTAL` / `fused_kernel_path_total{path=,reason=}`.
+
 VMEM budget: weights dominate at 2·K·C² + C² activation-dtype bytes
 (~10 MB at C=512 bf16). Up to C = 512 the whole weight set resides in
 VMEM and the grid is (B, L/TL). Beyond that (ProteinBERT-Large C=1024)
@@ -64,7 +76,8 @@ from __future__ import annotations
 
 import functools
 import logging
-from typing import Callable, Dict, List
+import os
+from typing import Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -77,22 +90,73 @@ logger = logging.getLogger(__name__)
 
 Params = Dict[str, jax.Array]
 
-# Process-wide count of fused-kernel → XLA-reference fallbacks, by
-# reason, bumped at TRACE time — i.e. once per EXECUTABLE built on the
-# fallback path, which is exactly the granularity the MFU question
-# needs ("how many of my compiled shapes missed the fast path"), not
-# once per step. `register_fallback_observer` lets a telemetry owner
-# (serve/server.Server, or any trainer holding a registry) mirror the
-# bumps into a registry counter (`fused_kernel_fallback_total{reason=}`)
-# so the gap is visible in /metrics and `pbt diagnose` instead of
-# folklore (ISSUE 9 satellite; ROADMAP open item 2 is the fix).
+# Two-sided fast-path accounting (ISSUE 10 satellite): process-wide
+# count of kernel dispatch decisions keyed by (path, reason), bumped at
+# TRACE time — once per traced BLOCK BODY. Under cfg.scan_blocks (every
+# preset) the N blocks share one traced body, so that is once per
+# EXECUTABLE — exactly the granularity the MFU question needs ("how
+# many of my compiled shapes run the fast path"), not once per step;
+# with scan_blocks=False an executable contributes num_blocks bumps
+# (all on the same path — the ratio, and the zero-miss gates, are
+# unaffected). Paths are
+# "pallas" (the fused kernel ran) and "reference" (the XLA composition
+# ran); reasons label WHY/WHAT:
+#   pallas/dense      — the unpacked fused kernel
+#   pallas/packed     — the segment-aware fused kernel (packed rows)
+#   reference/segments          — packed shape the segment kernel has
+#                                 no VMEM plan for (C > MAX_PALLAS_DIM,
+#                                 non-lane-aligned C, ...)
+#   reference/unsupported_shape — dense shape outside pallas_supported
+#   reference/forced            — PBT_FORCE_REFERENCE_KERNEL debug
+#                                 override (read at trace time)
+# `register_path_observer` lets a telemetry owner (serve/server.Server,
+# or any trainer holding a registry) mirror bumps into a registry
+# counter (`fused_kernel_path_total{path=,reason=}`) so fast-path
+# COVERAGE — not just misses — is visible in /metrics, Server.stats()
+# and `pbt diagnose --serve`.
+PATH_TOTAL: Dict[Tuple[str, str], int] = {}
+_PATH_OBSERVERS: List[Callable[[str, str], None]] = []
+
+# DEPRECATED (kept emitting for one release, docs/observability.md):
+# the pre-ISSUE-10 one-sided mirror — reference-path bumps only, keyed
+# by reason. Consumers should move to PATH_TOTAL /
+# fused_kernel_path_total.
 FALLBACK_TOTAL: Dict[str, int] = {}
 _FALLBACK_OBSERVERS: List[Callable[[str], None]] = []
+# One-time warning bookkeeping, keyed by (reason, call-site shape): a
+# server that builds a reference executable for a NEW shape after a
+# fused one must still warn (a process-wide once latch misled there —
+# ISSUE 10 satellite fix).
 _FALLBACK_WARNED: set = set()
+
+# Debug override: force every fused_local_track_segments dispatch onto
+# the XLA reference path. Read at TRACE time — set it before the first
+# call of a given (shape, config), or the cached fused executable wins.
+FORCE_REFERENCE_ENV = "PBT_FORCE_REFERENCE_KERNEL"
+
+
+def force_reference_requested() -> bool:
+    """Whether the debug override is ON. Parsed like the other PBT_*
+    flags: "0"/"false"/empty mean off — a `=0` export must not
+    silently force the slow path."""
+    return os.environ.get(FORCE_REFERENCE_ENV, "").strip().lower() not in (
+        "", "0", "false")
+
+
+def register_path_observer(cb: Callable[[str, str], None]) -> None:
+    """`cb(path, reason)` is invoked on every dispatch bump (trace
+    time), both fast-path and reference — the coverage feed."""
+    _PATH_OBSERVERS.append(cb)
+
+
+def unregister_path_observer(cb: Callable[[str, str], None]) -> None:
+    if cb in _PATH_OBSERVERS:
+        _PATH_OBSERVERS.remove(cb)
 
 
 def register_fallback_observer(cb: Callable[[str], None]) -> None:
-    """`cb(reason)` is invoked on every fallback bump (trace time)."""
+    """DEPRECATED: `cb(reason)` fires on reference-path bumps only.
+    Use `register_path_observer` for two-sided coverage."""
     _FALLBACK_OBSERVERS.append(cb)
 
 
@@ -101,17 +165,31 @@ def unregister_fallback_observer(cb: Callable[[str], None]) -> None:
         _FALLBACK_OBSERVERS.remove(cb)
 
 
-def _note_fallback(reason: str) -> None:
+def note_kernel_path(path: str, reason: str,
+                     shape: Optional[tuple] = None) -> None:
+    """Record one kernel dispatch decision (trace time = once per
+    executable). `shape` keys the one-time reference warning per
+    (reason, call-site shape)."""
+    if path not in ("pallas", "reference"):
+        raise ValueError(f"path must be 'pallas' or 'reference', "
+                         f"got {path!r}")
+    PATH_TOTAL[(path, reason)] = PATH_TOTAL.get((path, reason), 0) + 1
+    for cb in list(_PATH_OBSERVERS):
+        cb(path, reason)
+    if path != "reference":
+        return
     FALLBACK_TOTAL[reason] = FALLBACK_TOTAL.get(reason, 0) + 1
-    if reason not in _FALLBACK_WARNED:
-        _FALLBACK_WARNED.add(reason)
-        logger.warning(
-            "fused local-track kernel fell back to the XLA reference "
-            "path (reason=%s) — this executable runs without the fused "
-            "fast path; counted in fused_kernel_fallback_total "
-            "(ROADMAP open item 2 closes the gap)", reason)
     for cb in list(_FALLBACK_OBSERVERS):
         cb(reason)
+    warn_key = (reason, shape)
+    if warn_key not in _FALLBACK_WARNED:
+        _FALLBACK_WARNED.add(warn_key)
+        logger.warning(
+            "fused local-track kernel fell back to the XLA reference "
+            "path (reason=%s, shape=%s) — this executable runs without "
+            "the fused fast path; counted in "
+            "fused_kernel_path_total{path=reference} (and the "
+            "deprecated fused_kernel_fallback_total)", reason, shape)
 
 # Largest feature dim whose weights fit the VMEM budget whole (see
 # module doc); larger dims use the channel-tiled kernel.
@@ -211,28 +289,127 @@ def local_track_segment_reference(
     )
 
 
+def local_track_segment_oh_reference(
+    params: Params, x: jax.Array, broadcast_seg: jax.Array,
+    seg_oh: jax.Array,
+    narrow_dilation: int = 1, wide_dilation: int = 5,
+) -> jax.Array:
+    """Plain-JAX ground truth of the SEGMENT kernel, phrased in terms
+    of the one-hot segment matrix `seg_oh` (B, L, S) — the form the
+    kernel consumes — instead of integer segment ids. Tap masks are
+    one-hot dot products (Σ_s oh[l]·oh[l+off], exact 0.0/1.0, so a
+    cross-segment contribution is an exact zero like `_segment_conv`'s)
+    and the own-segment global→local gather is the matmul
+    `seg_oh @ broadcast_seg` (a pad position's all-zero one-hot row
+    receives exact 0.0). Bit-compatible with gathering (B, L, C)
+    broadcast rows and calling `local_track_segment_reference` for
+    segment ids in 0..S (the packer contract). The fused kernel's
+    backward differentiates THIS composition (rematerialised, like the
+    dense kernel's backward differentiates local_track_reference)."""
+    from proteinbert_tpu.ops.layers import dense_apply, layer_norm_apply
+
+    oh = seg_oh.astype(x.dtype)
+    L = x.shape[1]
+
+    def conv(p, dilation):
+        kernel = p["kernel"].astype(x.dtype)
+        taps = kernel.shape[0]
+        total = (taps - 1) * dilation
+        lo = total // 2
+        xp = jnp.pad(x, ((0, 0), (lo, total - lo), (0, 0)))
+        ohp = jnp.pad(oh, ((0, 0), (lo, total - lo), (0, 0)))
+        acc = None
+        for t in range(taps):
+            off = t * dilation
+            xs = lax.slice_in_dim(xp, off, off + L, axis=1)
+            ohs = lax.slice_in_dim(ohp, off, off + L, axis=1)
+            mask = jnp.sum(oh * ohs, axis=-1, keepdims=True)
+            part = (xs * mask.astype(x.dtype)) @ kernel[t]
+            acc = part if acc is None else acc + part
+        # Same remat tag as _segment_conv/conv1d_apply; inert w/o remat.
+        return checkpoint_name(acc + p["bias"].astype(x.dtype), "conv_out")
+
+    narrow = _gelu(conv(params["narrow_conv"], narrow_dilation))
+    wide = _gelu(conv(params["wide_conv"], wide_dilation))
+    broadcast_pos = jnp.einsum("bls,bsc->blc", oh,
+                               broadcast_seg.astype(x.dtype))
+    h = layer_norm_apply(
+        params["local_ln1"], x + narrow + wide + broadcast_pos
+    )
+    return layer_norm_apply(
+        params["local_ln2"],
+        h + _gelu(dense_apply(params["local_dense"], h)),
+    )
+
+
+def gather_segment_broadcast(broadcast_seg: jax.Array,
+                             segment_ids: jax.Array) -> jax.Array:
+    """(B, S, C) per-segment broadcast + (B, L) segment ids → (B, L, C)
+    per-position broadcast, exact 0.0 at pad — the materialised gather
+    the fused segment kernel folds into its block (shared by the
+    model's non-pallas packed path and the reference fallback here)."""
+    idx = jnp.clip(segment_ids - 1, 0)[..., None]
+    broadcast_pos = jnp.take_along_axis(broadcast_seg, idx, axis=1)
+    return jnp.where((segment_ids > 0)[..., None], broadcast_pos,
+                     jnp.zeros((), broadcast_pos.dtype))
+
+
 def fused_local_track_segments(
-    params: Params, x: jax.Array, broadcast_pos: jax.Array,
+    params: Params, x: jax.Array, broadcast_seg: jax.Array,
     segment_ids: jax.Array,
     narrow_dilation: int = 1, wide_dilation: int = 5,
     interpret: bool = False,
 ) -> jax.Array:
-    """GUARD: the Pallas kernel has no segment-boundary support yet, so
-    a packed row under cfg.use_pallas takes the XLA reference path
-    (semantically identical, boundary-masked). When the kernel learns
-    boundaries this becomes the dispatch point — callers already route
-    every packed use_pallas call here (models/proteinbert.block_apply),
-    so the swap will be one-line.
+    """Segment-aware fused local track for PACKED rows — the dispatch
+    point that closes ROADMAP item 2: on supported shapes
+    (`pallas_segments_supported`) the Pallas kernel runs with
+    cross-segment boundary masks folded into its tap matmuls AND the
+    per-position global→local broadcast gathered from each position's
+    own segment INSIDE the block (a one-hot matmul on the MXU), so the
+    model never materialises the (B, L, C) broadcast tensor on the
+    fast path. Unsupported shapes (and the PBT_FORCE_REFERENCE_KERNEL
+    debug override) take the XLA reference path — semantically
+    identical, boundary-masked.
 
-    Every routing through this guard counts in
-    `FALLBACK_TOTAL["segments"]` (once per executable — the bump
-    happens at trace time) with a one-time warning, so the MFU gap
-    packed training AND ragged serving pay on this path shows up in
-    telemetry (`pbt diagnose`, /metrics) instead of folklore."""
-    del interpret  # reserved for the future kernel dispatch
-    _note_fallback("segments")
+    Args:
+      broadcast_seg: (B, S, C) PER-SEGMENT projected global vectors
+        (gelu(dense(global)) per segment) — NOT the per-position
+        (B, L, C) gather.
+      segment_ids: (B, L) int, 0 = pad, 1..S = packed protein index
+        (ids above S are treated as pad — the packer never emits them).
+
+    Every dispatch counts in `PATH_TOTAL[(path, reason)]` at trace time
+    (once per executable): ("pallas", "packed") on the fast path,
+    ("reference", "segments"|"forced") otherwise, with a one-time
+    warning per (reason, shape). Backward matches the unpacked fused
+    path's memory behavior: a custom VJP that recomputes the reference
+    composition (saving only params/x/broadcast/one-hot), with the
+    conv_out remat tag intact inside the recompute."""
+    B, L, C = x.shape
+    S = broadcast_seg.shape[1]
+    nk = params["narrow_conv"]["kernel"]
+    wk = params["wide_conv"]["kernel"]
+    shape_key = (B, L, C, S, str(jnp.dtype(x.dtype)))
+    if force_reference_requested():
+        reason = "forced"
+    elif pallas_segments_supported(
+            C, L, S, x.dtype, nk.shape[0], wk.shape[0],
+            wide_dilation, narrow_dilation):
+        reason = None
+    else:
+        reason = "segments"
+    if reason is None:
+        note_kernel_path("pallas", "packed", shape_key)
+        seg_oh = (segment_ids[..., None]
+                  == jnp.arange(1, S + 1, dtype=segment_ids.dtype)
+                  ).astype(x.dtype)
+        return _fused_segments(params, x, broadcast_seg, seg_oh,
+                               narrow_dilation, wide_dilation, interpret)
+    note_kernel_path("reference", reason, shape_key)
+    broadcast_pos = gather_segment_broadcast(broadcast_seg, segment_ids)
     return local_track_segment_reference(
-        params, x, broadcast_pos, segment_ids, narrow_dilation, wide_dilation
+        params, x, broadcast_pos, segment_ids, narrow_dilation,
+        wide_dilation
     )
 
 
@@ -650,6 +827,226 @@ def pallas_supported(
     row = (seq_len + 2 * halo) * C * itemsize
     temps = 3 * tile * C * 4
     return weights + row + temps <= _VMEM_BUDGET
+
+
+# ------------------------------------------------ segment-aware kernel
+# The packed fast path (ISSUE 10 tentpole). Same implicit-GEMM tap
+# decomposition as _fused_kernel, with two additions folded into the
+# same VMEM-resident block:
+#
+# - every tap's shifted operand is masked by SEGMENT-ID EQUALITY before
+#   its matmul: the one-hot segment matrix rides next to the input row
+#   as a (Lp, S) block, and tap t's mask is the lane reduction
+#   Σ_s oh[l]·oh[l + off] — exact 0.0/1.0 (multiplication by a zero
+#   mask, not a subtraction), the same semantics `_segment_conv` proves
+#   bit-level isolation with in tests/test_packing.py;
+# - the per-position global→local broadcast is gathered from each
+#   position's OWN segment inside the kernel as the one-hot matmul
+#   (TL, S) @ (S, C) on the MXU (the operator-fusion-for-inference
+#   move, PAPERS.md) — the model passes the tiny per-segment (B, S, C)
+#   tensor and never materialises the (B, L, C) gather on this path.
+#
+# Scope: C <= MAX_PALLAS_DIM with the whole weight set VMEM-resident
+# (the channel-tiled C=1024 variant has no segment form yet — those
+# shapes fall back with reason="segments").
+
+
+def _seg_tap_matmuls(window, oh_window, kernel, taps, dilation, halo,
+                     tile):
+    """Σ_t (window[..] · mask_t) @ kernel[t] with mask_t[l] =
+    Σ_s oh[l]·oh[l + (t-(K-1)/2)·d] (fp32 acc). `window` is
+    (tile + 2·halo, C); `oh_window` the matching (tile + 2·halo, S)
+    one-hot rows — all-zero at pad/halo, so masks embed the
+    center-is-real check for free."""
+    center = (taps - 1) // 2
+    oh_center = oh_window[halo:halo + tile]
+    acc = None
+    for t in range(taps):
+        off = halo + (t - center) * dilation
+        xs = window[off:off + tile]
+        same = jnp.sum(oh_center * oh_window[off:off + tile],
+                       axis=-1, keepdims=True)
+        part = lax.dot_general(
+            xs * same.astype(xs.dtype),
+            kernel[t],
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc = part if acc is None else acc + part
+    return acc
+
+
+def _fused_segment_kernel(
+    x_ref, oh_ref, bcast_ref,
+    nk_ref, nb_ref, wk_ref, wb_ref,
+    s1_ref, b1_ref, dk_ref, db_ref, s2_ref, b2_ref,
+    out_ref,
+    *, tile, halo, narrow_taps, wide_taps, narrow_dilation, wide_dilation,
+):
+    j = pl.program_id(1)
+    dtype = x_ref.dtype
+    window = x_ref[0, pl.ds(j * tile, tile + 2 * halo), :]
+    oh_window = oh_ref[0, pl.ds(j * tile, tile + 2 * halo), :]
+    x_center = window[halo:halo + tile].astype(jnp.float32)
+
+    narrow = _seg_tap_matmuls(window, oh_window, nk_ref[:], narrow_taps,
+                              narrow_dilation, halo, tile)
+    narrow = _gelu(narrow + nb_ref[0].astype(jnp.float32))
+    wide = _seg_tap_matmuls(window, oh_window, wk_ref[:], wide_taps,
+                            wide_dilation, halo, tile)
+    wide = _gelu(wide + wb_ref[0].astype(jnp.float32))
+
+    # Own-segment broadcast gather as a one-hot matmul: a pad
+    # position's all-zero one-hot row receives exact 0.0.
+    bcast_pos = lax.dot_general(
+        oh_window[halo:halo + tile], bcast_ref[0],
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    h = x_center + narrow + wide + bcast_pos
+    out_ref[0] = _finish_row(h, s1_ref, b1_ref, dk_ref, db_ref,
+                             s2_ref, b2_ref, dtype)
+
+
+def pallas_segments_supported(
+    local_dim: int, seq_len: int, max_segments: int,
+    dtype: str = "bfloat16",
+    narrow_taps: int = 9, wide_taps: int = 9,
+    wide_dilation: int = 5, narrow_dilation: int = 1,
+) -> bool:
+    """Whether the SEGMENT kernel handles this packed shape+dtype
+    within the VMEM budget (else fused_local_track_segments falls back
+    to the XLA reference path with reason="segments"). Versus
+    `pallas_supported`: only the weights-resident C <= MAX_PALLAS_DIM
+    regime (no channel-tiled segment variant), taps must be odd (the
+    symmetric-halo tap layout), and the budget additionally prices the
+    (Lp, S) one-hot row block (lane-padded to 128 on TPU) and the
+    (S, C) per-segment broadcast block."""
+    if (local_dim % _LANE or local_dim > MAX_PALLAS_DIM or seq_len < 8
+            or max_segments < 1):
+        return False
+    if narrow_taps % 2 == 0 or wide_taps % 2 == 0:
+        return False
+    itemsize = jnp.dtype(dtype).itemsize
+    C = local_dim
+    halo = max((narrow_taps - 1) // 2 * narrow_dilation,
+               (wide_taps - 1) // 2 * wide_dilation)
+    tile = _pick_tile(seq_len)
+    Lp = seq_len + 2 * halo
+    lanes = max(max_segments, _LANE)  # Mosaic pads the lane dim
+    weights = (narrow_taps + wide_taps + 1) * C * C * itemsize
+    row = Lp * C * itemsize
+    oh_row = Lp * lanes * itemsize
+    bcast = max_segments * C * itemsize
+    temps = 3 * tile * C * 4 + tile * lanes * 4
+    return weights + row + oh_row + bcast + temps <= _VMEM_BUDGET
+
+
+def _pallas_segments_forward(
+    params: Params, x: jax.Array, broadcast_seg: jax.Array,
+    seg_oh: jax.Array,
+    narrow_dilation: int, wide_dilation: int, interpret: bool,
+) -> jax.Array:
+    nk = params["narrow_conv"]["kernel"]
+    wk = params["wide_conv"]["kernel"]
+    narrow_taps, wide_taps = nk.shape[0], wk.shape[0]
+    halo = max((narrow_taps - 1) // 2 * narrow_dilation,
+               (wide_taps - 1) // 2 * wide_dilation)
+    B, L, C = x.shape
+    S = seg_oh.shape[-1]
+    dtype = x.dtype
+    x_padded = jnp.pad(x, ((0, 0), (halo, halo), (0, 0)))
+    oh_padded = jnp.pad(seg_oh.astype(dtype),
+                        ((0, 0), (halo, halo), (0, 0)))
+    Lp = L + 2 * halo
+    tile = _pick_tile(L)
+
+    def vec(p):  # (C,) fp32 vector → (1, C) activation-dtype VMEM block
+        return p.reshape(1, C)
+
+    ln1, ln2, dn = params["local_ln1"], params["local_ln2"], params["local_dense"]
+    inputs = (
+        x_padded, oh_padded, broadcast_seg.astype(dtype),
+        nk.astype(dtype), vec(params["narrow_conv"]["bias"]),
+        wk.astype(dtype), vec(params["wide_conv"]["bias"]),
+        vec(ln1["scale"]), vec(ln1["bias"]),
+        dn["kernel"].astype(dtype), vec(dn["bias"]),
+        vec(ln2["scale"]), vec(ln2["bias"]),
+    )
+    # Masks add one (TL, S) VPU reduction per tap; the broadcast gather
+    # adds one (TL, S)@(S, C) matmul — negligible next to the conv
+    # FLOPs, so the cost model stays the dense kernel's.
+    flops_conv = 2 * B * L * C * C * (narrow_taps + wide_taps + 1)
+    cost = pl.CostEstimate(
+        flops=flops_conv,
+        bytes_accessed=x.size * x.dtype.itemsize * 2,
+        transcendentals=3 * B * L * C,
+    )
+    grid = (B, L // tile)
+    row_spec = pl.BlockSpec((1, Lp, C), lambda b, j: (b, 0, 0),
+                            memory_space=pltpu.VMEM)
+    oh_spec = pl.BlockSpec((1, Lp, S), lambda b, j: (b, 0, 0),
+                           memory_space=pltpu.VMEM)
+    bcast_spec = pl.BlockSpec((1, S, C), lambda b, j: (b, 0, 0),
+                              memory_space=pltpu.VMEM)
+
+    def whole(a):
+        return pl.BlockSpec(a.shape, lambda b, j: (0,) * a.ndim,
+                            memory_space=pltpu.VMEM)
+
+    kernel = functools.partial(
+        _fused_segment_kernel, tile=tile, halo=halo,
+        narrow_taps=narrow_taps, wide_taps=wide_taps,
+        narrow_dilation=narrow_dilation, wide_dilation=wide_dilation,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[row_spec, oh_spec, bcast_spec]
+                 + [whole(a) for a in inputs[3:]],
+        out_specs=pl.BlockSpec((1, tile, C), lambda b, j: (b, j, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((B, L, C), dtype),
+        cost_estimate=cost,
+        interpret=interpret,
+    )(*inputs)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _fused_segments(
+    params: Params, x: jax.Array, broadcast_seg: jax.Array,
+    seg_oh: jax.Array,
+    narrow_dilation: int = 1, wide_dilation: int = 5,
+    interpret: bool = False,
+) -> jax.Array:
+    """Segment kernel under the same memory contract as
+    fused_local_track: Pallas forward, rematerialised backward (the
+    VJP recomputes local_track_segment_oh_reference — conv_out remat
+    tag intact — saving only params, x, broadcast_seg, seg_oh)."""
+    return _pallas_segments_forward(params, x, broadcast_seg, seg_oh,
+                                    narrow_dilation, wide_dilation,
+                                    interpret)
+
+
+def _fwd_segments(params, x, broadcast_seg, seg_oh,
+                  narrow_dilation, wide_dilation, interpret):
+    y = _pallas_segments_forward(params, x, broadcast_seg, seg_oh,
+                                 narrow_dilation, wide_dilation, interpret)
+    return y, (params, x, broadcast_seg, seg_oh)
+
+
+def _bwd_segments(narrow_dilation, wide_dilation, interpret, res, g):
+    params, x, broadcast_seg, seg_oh = res
+    _, vjp = jax.vjp(
+        lambda p, xx, bb, oo: local_track_segment_oh_reference(
+            p, xx, bb, oo, narrow_dilation, wide_dilation
+        ),
+        params, x, broadcast_seg, seg_oh,
+    )
+    return vjp(g)
+
+
+_fused_segments.defvjp(_fwd_segments, _bwd_segments)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))

@@ -128,44 +128,54 @@ def block_apply(
     broadcast = jax.nn.gelu(dense_apply(params["global_to_local"], global_))
     from proteinbert_tpu.kernels import (
         fused_local_track, fused_local_track_segments,
-        local_track_reference, local_track_segment_reference,
-        pallas_supported,
+        gather_segment_broadcast, local_track_reference,
+        local_track_segment_reference, note_kernel_path, pallas_supported,
     )
 
     track_params = {k: params[k] for k in ("narrow_conv", "wide_conv",
                                            "local_ln1", "local_dense",
                                            "local_ln2")}
     if packed:
-        # Gather each position's own segment's broadcast vector:
-        # (B, S, C) → (B, L, C), zero at pad so nothing row-wide leaks
-        # into the masked conv taps.
-        idx = jnp.clip(segment_ids - 1, 0)[..., None]
-        broadcast_pos = jnp.take_along_axis(broadcast, idx, axis=1)
-        broadcast_pos = jnp.where(
-            (segment_ids > 0)[..., None], broadcast_pos,
-            jnp.zeros((), broadcast_pos.dtype))
         if cfg.use_pallas:
-            # Guard (kernels/fused_block.py): the kernel has no
-            # boundary support yet — delegates to the reference path.
+            # Fused segment dispatch (kernels/fused_block.py, ISSUE 10):
+            # the Pallas fast path with boundary masks AND the own-
+            # segment broadcast gather folded into the kernel on
+            # supported shapes, the XLA reference otherwise — every
+            # dispatch counted in fused_kernel_path_total{path=,reason=}.
+            # The per-segment (B, S, C) broadcast goes in as-is; the
+            # (B, L, C) gather is only materialised on the fallback.
             local = fused_local_track_segments(
-                track_params, local, broadcast_pos, segment_ids,
+                track_params, local, broadcast, segment_ids,
                 1, cfg.wide_dilation, jax.default_backend() != "tpu",
             )
         else:
+            # Gather each position's own segment's broadcast vector:
+            # (B, S, C) → (B, L, C), zero at pad so nothing row-wide
+            # leaks into the masked conv taps.
             local = local_track_segment_reference(
-                track_params, local, broadcast_pos, segment_ids,
-                1, cfg.wide_dilation,
+                track_params, local,
+                gather_segment_broadcast(broadcast, segment_ids),
+                segment_ids, 1, cfg.wide_dilation,
             )
-    elif cfg.use_pallas and pallas_supported(
-        cfg.local_dim, local.shape[1], cfg.dtype,
-        cfg.narrow_kernel, cfg.wide_kernel, cfg.wide_dilation,
-    ):
-        # Fused Pallas kernel (kernels/fused_block.py); interpreted off-TPU
-        # so tests and CPU runs exercise the same code path.
-        local = fused_local_track(
-            track_params, local, broadcast, 1, cfg.wide_dilation,
-            jax.default_backend() != "tpu",
-        )
+    elif cfg.use_pallas:
+        shape_key = (local.shape[0], local.shape[1], cfg.local_dim,
+                     str(jnp.dtype(cfg.dtype)))
+        if pallas_supported(
+            cfg.local_dim, local.shape[1], cfg.dtype,
+            cfg.narrow_kernel, cfg.wide_kernel, cfg.wide_dilation,
+        ):
+            # Fused Pallas kernel (kernels/fused_block.py); interpreted
+            # off-TPU so tests and CPU runs exercise the same code path.
+            note_kernel_path("pallas", "dense", shape_key)
+            local = fused_local_track(
+                track_params, local, broadcast, 1, cfg.wide_dilation,
+                jax.default_backend() != "tpu",
+            )
+        else:
+            note_kernel_path("reference", "unsupported_shape", shape_key)
+            local = local_track_reference(
+                track_params, local, broadcast, 1, cfg.wide_dilation
+            )
     else:
         local = local_track_reference(
             track_params, local, broadcast, 1, cfg.wide_dilation

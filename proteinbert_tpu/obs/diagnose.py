@@ -272,12 +272,13 @@ def summarize_serve(records: List[Dict[str, Any]],
                                   if spr else None),
     }
 
-    # ---- executable zoo + fused-kernel fallback (ISSUE 9) ----
+    # ---- executable zoo + fused-kernel path coverage (ISSUE 9/10) ----
     # From the terminal stats snapshot: warm executable count (the
     # bucketed |buckets|x|classes|xkinds ladder vs ragged O(kinds)),
-    # cumulative warmup seconds, and how many executables were built on
-    # the fused kernel's XLA fallback path (ROADMAP open item 2's gap,
-    # made visible instead of folklore).
+    # cumulative warmup seconds, and the two-sided fused-kernel path
+    # counts — how many executables ran the Pallas fast path vs the XLA
+    # reference (coverage, not just misses; `fused_fallback` is the
+    # deprecated one-sided view kept for one release).
     end_stats = (end.get("stats") if end is not None
                  and isinstance(end.get("stats"), dict) else None)
     if end_stats is not None:
@@ -285,6 +286,7 @@ def summarize_serve(records: List[Dict[str, Any]],
             "serve_mode": end_stats.get("serve_mode"),
             "count": end_stats.get("executables"),
             "warmup_seconds": end_stats.get("warmup_seconds"),
+            "fused_path": end_stats.get("fused_path"),
             "fused_fallback": end_stats.get("fused_fallback"),
         }
 
@@ -372,10 +374,24 @@ def render_serve(summary: Dict[str, Any]) -> str:
             f"executables: {ex['count']} warm "
             f"(mode {ex.get('serve_mode')}, warmup "
             f"{ex.get('warmup_seconds')}s)")
-        fb = ex.get("fused_fallback") or {}
-        for reason, n in sorted(fb.items()):
-            lines.append(f"  fused-kernel fallback ({reason}): "
-                         f"{n} executable(s) on the XLA reference path")
+        fp = ex.get("fused_path") or {}
+        if fp:
+            pallas = sum(n for k, n in fp.items()
+                         if k.startswith("pallas/"))
+            ref = sum(n for k, n in fp.items()
+                      if k.startswith("reference/"))
+            lines.append(
+                f"  fused-kernel coverage: {pallas} executable(s) on "
+                f"the Pallas fast path, {ref} on the XLA reference")
+            for key, n in sorted(fp.items()):
+                lines.append(f"    {key}: {n}")
+        else:
+            # Pre-ISSUE-10 stats snapshots: one-sided fallback view.
+            fb = ex.get("fused_fallback") or {}
+            for reason, n in sorted(fb.items()):
+                lines.append(f"  fused-kernel fallback ({reason}): "
+                             f"{n} executable(s) on the XLA reference "
+                             "path")
     for br in summary["slo_breaches"]:
         lines.append(f"SLO BREACH: {br['objective']} burn "
                      f"{br['burn_rate']:.2f} ({br['bad']}/{br['total']} "

@@ -30,7 +30,9 @@ Routes (POST bodies and responses are JSON):
                                dispatch mode (`pbt serve --serve-mode`,
                                ISSUE 9); stats carries the executable-
                                zoo accounting (executables,
-                               warmup_seconds, fused_fallback)
+                               warmup_seconds, the two-sided
+                               fused_path coverage + deprecated
+                               fused_fallback)
   GET  /metrics              → Prometheus textfile (the registry's
                                exposition; empty when telemetry is off)
 

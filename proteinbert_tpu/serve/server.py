@@ -252,31 +252,40 @@ class Server:
         self._mirror_lock = threading.Lock()
         self.truncated_total = 0
         self.rejected_total = {r: 0 for r in self._rej_c}
-        # Fused-kernel fallback visibility (ISSUE 9 satellite): mirror
-        # kernels/fused_block fallback bumps (the packed rows this
-        # server dispatches take the XLA reference path under
-        # use_pallas — ROADMAP open item 2) into the registry so
-        # /metrics and stats() expose fused_kernel_fallback_total.
+        # Fused-kernel fast-path COVERAGE (ISSUE 10 satellite): mirror
+        # kernels/fused_block dispatch bumps — both the Pallas fast
+        # path and the XLA reference path — into the registry as
+        # fused_kernel_path_total{path=,reason=}, so /metrics, stats()
+        # and `pbt diagnose --serve` show how many compiled shapes run
+        # the fast path, not just the misses. Reference-path bumps also
+        # feed the DEPRECATED one-sided fused_kernel_fallback_total
+        # (kept emitting for one release, docs/observability.md).
         # Registered LAST — after every raising statement above — so a
         # failed construction (bad SLO spec, trunk-mismatched head)
         # cannot leak a process-global observer; drain()/abort()
         # unregister it.
         from proteinbert_tpu.kernels.fused_block import (
-            register_fallback_observer,
+            register_path_observer,
         )
 
-        self._fallback_c: Dict[str, Any] = {}
+        self._path_c: Dict[Any, Any] = {}
 
-        def _mirror_fallback(reason: str,
-                             _metrics=metrics, _c=self._fallback_c) -> None:
-            c = _c.get(reason)
+        def _mirror_path(path: str, reason: str,
+                         _metrics=metrics, _c=self._path_c) -> None:
+            c = _c.get((path, reason))
             if c is None:
-                c = _c[reason] = _metrics.counter(
-                    "fused_kernel_fallback_total", reason=reason)
+                c = _c[(path, reason)] = _metrics.counter(
+                    "fused_kernel_path_total", path=path, reason=reason)
             c.inc()
+            if path == "reference":
+                c2 = _c.get(("fallback", reason))
+                if c2 is None:
+                    c2 = _c[("fallback", reason)] = _metrics.counter(
+                        "fused_kernel_fallback_total", reason=reason)
+                c2.inc()
 
-        self._fallback_cb = _mirror_fallback
-        register_fallback_observer(self._fallback_cb)
+        self._path_cb = _mirror_path
+        register_path_observer(self._path_cb)
 
     def _bump(self, mirror: str, reason: Optional[str] = None) -> None:
         with self._mirror_lock:
@@ -378,17 +387,17 @@ class Server:
         done = self.scheduler.join(timeout)
         if not self._ended:
             self._ended = True
-            self._release_fallback_observer()
+            self._release_path_observer()
             self.tele.emit("serve_end", outcome="drained",
                            stats=self.stats())
         return done
 
-    def _release_fallback_observer(self) -> None:
+    def _release_path_observer(self) -> None:
         from proteinbert_tpu.kernels.fused_block import (
-            unregister_fallback_observer,
+            unregister_path_observer,
         )
 
-        unregister_fallback_observer(self._fallback_cb)
+        unregister_path_observer(self._path_cb)
 
     def abort(self) -> None:
         """Hard shutdown: fail all queued + pending work with
@@ -409,7 +418,7 @@ class Server:
         n = len(failed)
         if not self._ended:
             self._ended = True
-            self._release_fallback_observer()
+            self._release_path_observer()
             self.tele.emit("note", source="serve", kind="abort",
                            failed_requests=n)
             self.tele.emit("serve_end", outcome="aborted",
@@ -701,7 +710,9 @@ class Server:
                 "truncated": self.truncated_total,
                 "rejected": dict(self.rejected_total),
             }
-        from proteinbert_tpu.kernels.fused_block import FALLBACK_TOTAL
+        from proteinbert_tpu.kernels.fused_block import (
+            FALLBACK_TOTAL, PATH_TOTAL,
+        )
 
         qw = self.scheduler.queue_wait
         out = {
@@ -714,8 +725,14 @@ class Server:
             "executables": self.dispatcher.executable_count,
             "warmup_seconds": round(self.dispatcher.warmup_seconds_total,
                                     6),
-            # Process-wide fused-kernel fallback counts (trace-time,
-            # one per executable built on the XLA reference path).
+            # Process-wide fused-kernel path coverage (trace-time, one
+            # bump per executable): "path/reason" → executables built
+            # on that path. "pallas/*" is the fast path; "reference/*"
+            # the XLA composition (ISSUE 10 two-sided counter).
+            "fused_path": {f"{p}/{r}": n
+                           for (p, r), n in sorted(PATH_TOTAL.items())},
+            # DEPRECATED one-sided view (reference-path reasons only);
+            # kept for one release — read fused_path instead.
             "fused_fallback": dict(FALLBACK_TOTAL),
             "heads": len(self.dispatcher.heads),
             "batches": self.scheduler.batches_total,

@@ -7,11 +7,17 @@ devices, that the sharded packed train step is numerically identical to
 the single-device packed step — including under the ZeRO-1 zero-update
 path (whose shard_map in/out specs must digest the packed grads tree).
 
-Usage: python tests/multidevice_packed_child.py {dp|zero}
+Usage: python tests/multidevice_packed_child.py {dp|zero|zero_pallas}
 Prints one JSON line with the compared losses. Opt-in via the parent
 tests at the bottom of tests/test_packing.py (PBT_RUN_PACKED_MD=1, same
 gate style as the PBT_RUN_TIER64 pod tier; tools/run_tier1.sh
 --packed-md).
+
+`zero_pallas` (ISSUE 10): the same ZeRO-1 parity at a lane-aligned
+local_dim=128 with use_pallas=True, so the sharded packed step runs
+the segment-aware fused Pallas kernel (interpret mode on CPU) inside
+the zero-update's shard_map — asserting the fast path was actually
+taken AND that it matches the single-device reference.
 """
 
 import json
@@ -44,10 +50,16 @@ def _parity(scenario):
     from proteinbert_tpu.parallel.zero import make_zero_train_step
     from proteinbert_tpu.train import create_train_state, train_step
 
-    zero = scenario == "zero"
+    zero = scenario.startswith("zero")
+    pallas = scenario.endswith("_pallas")
+    model_kw = dict(MODEL)
+    if pallas:
+        # Lane-aligned dim so pallas_segments_supported holds — the
+        # fused packed fast path inside the zero-update shard_map.
+        model_kw.update(local_dim=128, use_pallas=True)
     mesh_cfg = MeshConfig(data=4, fsdp=2)
     cfg = PretrainConfig(
-        model=ModelConfig(**MODEL),
+        model=ModelConfig(**model_kw),
         data=DataConfig(seq_len=64, batch_size=8, packing=True,
                         pack_max_segments=4),
         optimizer=OptimizerConfig(learning_rate=1e-3, warmup_steps=10),
@@ -93,7 +105,15 @@ def _parity(scenario):
             - np.asarray(jax.device_get(g), np.float64))))
         max_err = max(max_err, err)
     assert max_err < 2e-5, (scenario, max_err)
+    if pallas:
+        from proteinbert_tpu.kernels import fused_block as fb
+
+        assert fb.PATH_TOTAL.get(("pallas", "packed"), 0) > 0, (
+            "pallas scenario never took the fused packed fast path")
+        assert fb.PATH_TOTAL.get(("reference", "segments"), 0) == 0, (
+            "reason=segments fallback on a supported shape")
     return {"mesh": dict(mesh.shape), "zero_update": zero,
+            "use_pallas": pallas,
             "ref_loss": ref_loss, "sharded_loss": got_loss,
             "max_param_err": max_err}
 

@@ -110,6 +110,27 @@ def test_pallas_supported_gating():
     assert pallas_supported(512, 2048 // 4)
 
 
+def test_pallas_segments_supported_gating():
+    from proteinbert_tpu.kernels import pallas_segments_supported
+
+    assert pallas_segments_supported(128, 256, 8, "float32")
+    assert pallas_segments_supported(512, 512, 8)       # base config, bf16
+    assert not pallas_segments_supported(96, 256, 8)    # non-lane-aligned C
+    # No channel-tiled segment variant yet: Large C=1024 falls back
+    # (reason="segments") even though the dense kernel supports it.
+    assert not pallas_segments_supported(1024, 512, 8)
+    assert not pallas_segments_supported(512, 512, 8, "float32")  # VMEM
+    assert not pallas_segments_supported(128, 4, 2)     # seq too short
+    assert not pallas_segments_supported(128, 256, 0)   # no segments
+    # Even tap counts break the symmetric-halo tap layout.
+    assert not pallas_segments_supported(128, 256, 8, "float32",
+                                         narrow_taps=8)
+    # The one-hot row block is priced in: the dense kernel fits this
+    # long-row bf16 shape, the segment kernel must still fit too (the
+    # oh block is lane-padded but small next to the weights).
+    assert pallas_segments_supported(256, 1024, 16)
+
+
 def test_train_step_with_pallas(key):
     """One jitted train step with the fused kernel end to end."""
     from proteinbert_tpu.configs import (

@@ -480,6 +480,177 @@ def test_packed_train_and_eval_step(packed_batch):
     assert "ranking_stats" in em
 
 
+# ------------------------------------ fused packed fast path (ISSUE 10)
+# The segment-aware Pallas kernel (interpret mode on CPU — the same
+# code Mosaic compiles on TPU) against the `_segment_conv` reference
+# oracle, across segment layouts. Cost discipline: ONE kernel shape
+# (B, L, C, S) = (2, 256, 128, 4) — L=256 gives tile 128, so a segment
+# boundary placed AT position 128 exercises the tile edge — and two
+# module-level jitted entries shared by every layout.
+
+from proteinbert_tpu.kernels import fused_block as fb  # noqa: E402
+
+FC, FS, FL = 128, 4, 256
+
+PCFG = ModelConfig(local_dim=FC, global_dim=64, key_dim=16, num_heads=4,
+                   num_blocks=1, num_annotations=A, dtype="float32",
+                   use_pallas=True)
+RCFG = ModelConfig(**{**PCFG.__dict__, "use_pallas": False})
+
+
+@pytest.fixture(scope="module")
+def fused_inputs():
+    kp, kx, kb = jax.random.split(jax.random.PRNGKey(3), 3)
+    block = proteinbert.block_init(kp, PCFG)
+    params = {k: block[k] for k in ("narrow_conv", "wide_conv",
+                                    "local_ln1", "local_dense",
+                                    "local_ln2")}
+    x = jax.random.normal(kx, (2, FL, FC), jnp.float32)
+    bc = jax.random.normal(kb, (2, FS, FC), jnp.float32)
+    return params, x, bc
+
+
+def _seg_rows(*rows):
+    """(n_rows, FL) segment ids from [(segment_id, span), ...] specs —
+    remaining positions stay 0 (pad)."""
+    seg = np.zeros((len(rows), FL), np.int32)
+    for i, spans in enumerate(rows):
+        pos = 0
+        for sid, ln in spans:
+            seg[i, pos:pos + ln] = sid
+            pos += ln
+    return jnp.asarray(seg)
+
+
+@jax.jit
+def _fused(params, x, bc, seg):
+    return fb.fused_local_track_segments(params, x, bc, seg, 1, 5, True)
+
+
+@jax.jit
+def _ref(params, x, bc, seg):
+    return fb.local_track_segment_reference(
+        params, x, fb.gather_segment_broadcast(bc, seg), seg, 1, 5)
+
+
+LAYOUTS = {
+    "single_segment_full_row": [[(1, FL)], [(1, FL)]],
+    "max_segments": [[(1, 64), (2, 64), (3, 64), (4, 50)],
+                     [(1, 30), (2, 30), (3, 30), (4, 30)]],
+    "empty_tail_rows": [[(1, 100), (2, 60)], []],  # row 1 ALL pad
+    "boundary_at_tile_edge": [[(1, 128), (2, 100)],
+                              [(1, 128), (2, 128)]],
+}
+
+
+@pytest.mark.parametrize("layout", sorted(LAYOUTS))
+def test_packed_fused_vs_reference_identity(fused_inputs, layout):
+    """ISSUE 10 acceptance: fused-vs-reference identity at the
+    documented jitted tolerance across segment layouts, with ZERO
+    reason=segments fallbacks on this supported shape."""
+    params, x, bc = fused_inputs
+    assert fb.pallas_segments_supported(FC, FL, FS, "float32")
+    seg = _seg_rows(*LAYOUTS[layout])
+    before = fb.PATH_TOTAL.get(("reference", "segments"), 0)
+    got = _fused(params, x, bc, seg)
+    want = _ref(params, x, bc, seg)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+    assert fb.PATH_TOTAL.get(("reference", "segments"), 0) == before
+
+
+def test_packed_fused_gradient_parity(fused_inputs):
+    """The custom VJP (rematerialised oh-reference backward) against
+    autodiff through the reference composition — same tolerances as
+    the dense kernel's gradient test (fp32 residual accumulation is
+    the only forward-path difference)."""
+    params, x, bc = fused_inputs
+    seg = _seg_rows([(1, 100), (2, 80)], [(1, FL)])
+
+    def loss_fused(p, xx, bb):
+        return jnp.sum(
+            fb.fused_local_track_segments(p, xx, bb, seg, 1, 5, True) ** 2)
+
+    def loss_ref(p, xx, bb):
+        return jnp.sum(fb.local_track_segment_reference(
+            p, xx, fb.gather_segment_broadcast(bb, seg), seg, 1, 5) ** 2)
+
+    g_fused = jax.grad(loss_fused, argnums=(0, 1, 2))(params, x, bc)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(params, x, bc)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=2e-4),
+        g_fused, g_ref)
+
+
+def test_force_reference_env_override(fused_inputs, monkeypatch):
+    """PBT_FORCE_REFERENCE_KERNEL (documented debug override) routes
+    the dispatch onto the reference path — bit-identical to calling
+    the reference directly, counted as reason=forced."""
+    params, x, bc = fused_inputs
+    seg = _seg_rows([(1, 200)], [(1, FL)])
+    # "=0"/"false" must NOT force (parsed like every other PBT_* flag).
+    monkeypatch.setenv(fb.FORCE_REFERENCE_ENV, "0")
+    assert not fb.force_reference_requested()
+    monkeypatch.setenv(fb.FORCE_REFERENCE_ENV, "false")
+    assert not fb.force_reference_requested()
+    monkeypatch.setenv(fb.FORCE_REFERENCE_ENV, "1")
+    assert fb.force_reference_requested()
+    before = fb.PATH_TOTAL.get(("reference", "forced"), 0)
+    got = fb.fused_local_track_segments(params, x, bc, seg, 1, 5, True)
+    assert fb.PATH_TOTAL.get(("reference", "forced"), 0) == before + 1
+    want = fb.local_track_segment_reference(
+        params, x, fb.gather_segment_broadcast(bc, seg), seg, 1, 5)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_packed_model_fused_matches_reference(packed_batch):
+    """Model-level wiring (encode → block_apply → fused dispatch): the
+    packed forward under use_pallas matches the reference config at
+    the jitted tolerance AND actually takes the fast path — the
+    (B, S, C) per-segment broadcast goes into the kernel, never the
+    materialised (B, L, C) gather."""
+    params = proteinbert.init(jax.random.PRNGKey(4), PCFG)
+    tokens = jnp.asarray(packed_batch["tokens"])
+    seg = jnp.asarray(packed_batch["segment_ids"])
+    ann = jnp.asarray(packed_batch["annotations"])
+    before = fb.PATH_TOTAL.get(("pallas", "packed"), 0)
+    ll_f, gl_f = proteinbert.apply(params, tokens, ann, PCFG,
+                                   segment_ids=seg)
+    assert fb.PATH_TOTAL.get(("pallas", "packed"), 0) > before
+    ll_r, gl_r = proteinbert.apply(params, tokens, ann, RCFG,
+                                   segment_ids=seg)
+    np.testing.assert_allclose(np.asarray(ll_f), np.asarray(ll_r),
+                               atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(gl_f), np.asarray(gl_r),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_packed_train_step_through_fused_kernel(packed_batch):
+    """Training wiring: the jitted packed train step under use_pallas
+    (custom-VJP backward) runs, moves params, and lands on the fast
+    path — the plain-DP leg of the tentpole (the ZeRO-1 leg is the
+    opt-in zero_pallas child below)."""
+    from proteinbert_tpu.train import create_train_state
+    from proteinbert_tpu.train.train_state import train_step
+
+    cfg = PretrainConfig(
+        model=PCFG,
+        data=DataConfig(seq_len=SEQ_LEN, batch_size=2, packing=True,
+                        pack_max_segments=MAX_SEG),
+        optimizer=OptimizerConfig(warmup_steps=5),
+        train=TrainConfig(max_steps=2))
+    state = create_train_state(jax.random.PRNGKey(0), cfg)
+    p0 = jax.tree.leaves(state.params)[0].copy()
+    before = fb.PATH_TOTAL.get(("pallas", "packed"), 0)
+    state, m = train_step(state, packed_batch, cfg)
+    assert fb.PATH_TOTAL.get(("pallas", "packed"), 0) > before
+    state, m = train_step(state, packed_batch, cfg)  # step 1: warmed LR
+    assert np.isfinite(float(m["loss"])) and float(m["grad_norm"]) > 0
+    assert not np.allclose(np.asarray(jax.tree.leaves(state.params)[0]),
+                           np.asarray(p0))
+
+
 # --------------------------------------- opt-in multi-device parity tier
 # Same gate style as the PBT_RUN_TIER64 pod tier: slow-marked (tier-1's
 # -m 'not slow' never collects it) AND env-gated, spawning a fresh
@@ -501,7 +672,7 @@ _md = pytest.mark.skipif(
 
 @pytest.mark.slow
 @_md
-@pytest.mark.parametrize("scenario", ["dp", "zero"])
+@pytest.mark.parametrize("scenario", ["dp", "zero", "zero_pallas"])
 def test_multidevice_packed_parity_child(scenario):
     import json
 

@@ -441,41 +441,78 @@ class TestRaggedTelemetry:
         srv.drain(timeout=10)
 
 
-class TestFusedFallbackCounter:
-    def test_counter_observer_and_one_time_warning(self, caplog):
+def _tiny_track_params(C=4):
+    import jax.numpy as jnp
+
+    return {
+        "narrow_conv": {"kernel": jnp.zeros((3, C, C)),
+                        "bias": jnp.zeros(C)},
+        "wide_conv": {"kernel": jnp.zeros((3, C, C)),
+                      "bias": jnp.zeros(C)},
+        "local_ln1": {"scale": jnp.ones(C), "bias": jnp.zeros(C)},
+        "local_dense": {"kernel": jnp.eye(C), "bias": jnp.zeros(C)},
+        "local_ln2": {"scale": jnp.ones(C), "bias": jnp.zeros(C)},
+    }
+
+
+class TestFusedPathCounter:
+    """ISSUE 10 satellite: the two-sided fused_kernel_path counter and
+    the per-(reason, shape) one-time warning (the per-process latch
+    misled a server that built a reference executable for a NEW shape
+    after a fused one)."""
+
+    def test_two_sided_counter_and_shape_keyed_warning(self):
         import jax.numpy as jnp
 
         from proteinbert_tpu.kernels import fused_block as fb
 
-        before = fb.FALLBACK_TOTAL.get("segments", 0)
-        seen = []
-        fb.register_fallback_observer(seen.append)
-        try:
-            params = {
-                "narrow_conv": {"kernel": jnp.zeros((3, 4, 4)),
-                                "bias": jnp.zeros(4)},
-                "wide_conv": {"kernel": jnp.zeros((3, 4, 4)),
-                              "bias": jnp.zeros(4)},
-                "local_ln1": {"scale": jnp.ones(4), "bias": jnp.zeros(4)},
-                "local_dense": {"kernel": jnp.eye(4),
-                                "bias": jnp.zeros(4)},
-                "local_ln2": {"scale": jnp.ones(4), "bias": jnp.zeros(4)},
-            }
-            x = jnp.zeros((1, 8, 4))
-            seg = jnp.ones((1, 8), jnp.int32)
-            with caplog.at_level(logging.WARNING,
-                                 logger=fb.logger.name):
-                fb.fused_local_track_segments(params, x, x, seg)
-                fb.fused_local_track_segments(params, x, x, seg)
-        finally:
-            fb.unregister_fallback_observer(seen.append)
-        assert fb.FALLBACK_TOTAL["segments"] == before + 2
-        assert seen == ["segments", "segments"]
-        warnings = [r for r in caplog.records
-                    if "fused_kernel_fallback_total" in r.getMessage()]
-        assert len(warnings) <= 1  # one-time (0 if an earlier test won)
+        params = _tiny_track_params()
+        seen_path, seen_legacy, records = [], [], []
 
-    def test_server_mirrors_fallback_into_registry(self, trunk):
+        def path_cb(p, r):
+            seen_path.append((p, r))
+
+        # Handler attached straight to the kernel logger: caplog relies
+        # on propagation to root, which an earlier start_log() test may
+        # have reconfigured.
+        handler = logging.Handler()
+        handler.emit = records.append
+        fb.logger.addHandler(handler)
+        fb.register_path_observer(path_cb)
+        fb.register_fallback_observer(seen_legacy.append)
+        key = ("reference", "segments")
+        before = fb.PATH_TOTAL.get(key, 0)
+        before_legacy = fb.FALLBACK_TOTAL.get("segments", 0)
+        # Reset the warn latch for exactly the shapes this test uses so
+        # the count below is deterministic whatever ran earlier.
+        shapes = [(1, 24, 4, 2, "float32"), (1, 40, 4, 2, "float32")]
+        for sh in shapes:
+            fb._FALLBACK_WARNED.discard(("segments", sh))
+        try:
+            x24 = jnp.zeros((1, 24, 4))
+            x40 = jnp.zeros((1, 40, 4))
+            bc = jnp.zeros((1, 2, 4))  # per-SEGMENT (B, S, C)
+            seg24 = jnp.ones((1, 24), jnp.int32)
+            seg40 = jnp.ones((1, 40), jnp.int32)
+            # C=4 is not lane-aligned → reference, reason=segments.
+            fb.fused_local_track_segments(params, x24, bc, seg24)
+            fb.fused_local_track_segments(params, x24, bc, seg24)
+            fb.fused_local_track_segments(params, x40, bc, seg40)
+        finally:
+            fb.logger.removeHandler(handler)
+            fb.unregister_path_observer(path_cb)
+            fb.unregister_fallback_observer(seen_legacy.append)
+        assert fb.PATH_TOTAL[key] == before + 3
+        # Deprecated one-sided mirror keeps emitting for one release.
+        assert fb.FALLBACK_TOTAL["segments"] == before_legacy + 3
+        assert seen_path == [key] * 3
+        assert seen_legacy == ["segments"] * 3
+        warnings = [r for r in records
+                    if "XLA reference" in r.getMessage()]
+        # Same shape twice → ONE warning; the new shape → its own.
+        assert len(warnings) == 2
+
+    def test_server_mirrors_path_into_registry(self, trunk):
         from proteinbert_tpu.kernels import fused_block as fb
         from proteinbert_tpu.obs import Telemetry
 
@@ -484,14 +521,52 @@ class TestFusedFallbackCounter:
         srv = Server(params, cfg, max_batch=2, max_wait_s=60.0,
                      cache_size=0, warm_kinds=(), serve_mode="ragged",
                      telemetry=tele)
-        fb._note_fallback("segments")
-        c = tele.metrics.counter("fused_kernel_fallback_total",
-                                 reason="segments")
-        assert c.value == 1
-        assert srv.stats()["fused_fallback"]["segments"] >= 1
+        fb.note_kernel_path("reference", "segments", ("test-shape",))
+        fb.note_kernel_path("pallas", "packed", ("test-shape",))
+        c_ref = tele.metrics.counter("fused_kernel_path_total",
+                                     path="reference", reason="segments")
+        c_pal = tele.metrics.counter("fused_kernel_path_total",
+                                     path="pallas", reason="packed")
+        c_old = tele.metrics.counter("fused_kernel_fallback_total",
+                                     reason="segments")
+        assert c_ref.value == 1 and c_pal.value == 1
+        assert c_old.value == 1  # deprecated mirror, one release
+        stats = srv.stats()
+        assert stats["fused_path"]["reference/segments"] >= 1
+        assert stats["fused_path"]["pallas/packed"] >= 1
+        assert stats["fused_fallback"]["segments"] >= 1
         srv.drain(timeout=10)
-        fb._note_fallback("segments")  # after drain: observer released
-        assert c.value == 1
+        fb.note_kernel_path("pallas", "packed")  # observer released
+        assert c_pal.value == 1
+
+    def test_ragged_packed_takes_pallas_path(self):
+        """THE ragged-serve fast-path smoke (ISSUE 10 acceptance): on a
+        shape the segment kernel supports, the packed executable the
+        ragged dispatcher builds must land on the Pallas path — zero
+        reason=segments fallbacks."""
+        from proteinbert_tpu.kernels import fused_block as fb
+
+        pcfg = PretrainConfig(
+            model=ModelConfig(local_dim=128, global_dim=32, key_dim=8,
+                              num_heads=2, num_blocks=1,
+                              num_annotations=32, dtype="float32",
+                              use_pallas=True),
+            data=DataConfig(seq_len=SEQ_LEN, batch_size=2,
+                            buckets=BUCKETS),
+            optimizer=OptimizerConfig(warmup_steps=5),
+            train=TrainConfig(seed=0, max_steps=1),
+            checkpoint=CheckpointConfig(),
+        )
+        assert fb.pallas_segments_supported(128, SEQ_LEN, 4, "float32")
+        params = create_train_state(jax.random.PRNGKey(0), pcfg).params
+        disp = RaggedDispatcher(params, pcfg, rows_per_batch=2,
+                                max_segments=4)
+        before = dict(fb.PATH_TOTAL)
+        assert disp.warmup(("embed",)) == 1
+        delta = {k: fb.PATH_TOTAL.get(k, 0) - before.get(k, 0)
+                 for k in fb.PATH_TOTAL}
+        assert delta.get(("pallas", "packed"), 0) >= 1
+        assert delta.get(("reference", "segments"), 0) == 0
 
 
 class TestRaggedDispatcherContracts:

@@ -149,6 +149,10 @@ _EVENT_METRICS = (
     ("serve_capture", "served_requests_per_sec", "serve_requests_per_sec"),
     ("serve_capture", "speedup_x", "serve_speedup_x"),
     ("pack_capture", "effective_speedup_x", "pack_effective_speedup_x"),
+    # Packed fused fast path (ISSUE 10): fused-vs-reference forward
+    # wall-clock on the packed A/B arm (interpret-mode plumbing number
+    # on CPU, the real kernel on TPU — platform-split like the rest).
+    ("pack_fused_capture", "fused_speedup_x", "pack_fused_speedup_x"),
     # Multi-tenant heads (ISSUE 8): mixed-head throughput + the WORST
     # normalized downstream-eval score across heads — finetune-quality
     # regressions gate through the same sentinel as perf.

@@ -83,6 +83,24 @@ timeout -k 10 420 env JAX_PLATFORMS=cpu \
 rcr=$?
 [ "$rc" -eq 0 ] && rc=$rcr
 
+# Packed fused fast-path smoke (ISSUE 10 satellite): a tiny packed
+# batch through the segment-aware Pallas kernel at a lane-aligned dim
+# (the bench --pack fused A/B arm). GATED: fused-vs-reference parity
+# within the documented 1e-5 jitted tolerance, supported shapes take
+# the Pallas path with ZERO reason=segments fallbacks, and the
+# PBT_FORCE_REFERENCE_KERNEL debug override (documented in
+# docs/performance.md) still routes a fresh trace onto the reference
+# path. Wall-clock is reported, not gated (interpret mode on CPU).
+echo "=== packed fused smoke (fused-vs-reference A/B, CPU) ==="
+timeout -k 10 420 env JAX_PLATFORMS=cpu \
+  PBT_PACK_BENCH_SEQ_LEN=128 PBT_PACK_BENCH_BATCH=2 \
+  PBT_PACK_BENCH_DIM=32 PBT_PACK_BENCH_STEPS=2 \
+  PBT_PACK_BENCH_MEDIAN_LEN=40 PBT_PACK_BENCH_FUSED_DIM=128 \
+  PBT_PACK_BENCH_FUSED_REPS=2 \
+  python "$(dirname "$0")/../bench.py" --pack
+rcf=$?
+[ "$rc" -eq 0 ] && rc=$rcf
+
 # Multi-tenant heads smoke (ISSUE 8 satellite): the platform loop end
 # to end — tiny finetune → register into a head registry → serve one
 # mixed-head micro-batch through the shared trunk → downstream eval.

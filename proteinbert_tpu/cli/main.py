@@ -1097,6 +1097,15 @@ def cmd_serve(args) -> int:
     from proteinbert_tpu.serve.http import make_http_server
     from proteinbert_tpu.train.resilience import GracefulShutdown
 
+    if args.compile_cache_dir:
+        # Must be armed before the first compile (the trunk load below
+        # jits): restarted/new replicas deserialize warm executables
+        # instead of re-paying per-kind warmup (fleet boot path).
+        from proteinbert_tpu.utils.compat import configure_compile_cache
+
+        configure_compile_cache(args.compile_cache_dir)
+        log(f"persistent compilation cache: {args.compile_cache_dir}")
+
     params, cfg = _load_inference_trunk(args)
 
     mesh = None
@@ -1203,6 +1212,11 @@ def cmd_serve(args) -> int:
             f"{len(server.dispatcher.batch_classes)} batch class(es): "
             f"buckets={list(server.dispatcher.buckets)}")
     server.start()
+    # Warm-boot accounting (mirrored in serve_warmup_seconds_total):
+    # with --compile-cache-dir, a restarted replica's number here is
+    # cache-load time, not compile time — the fleet's fast-boot claim.
+    log(f"warmup: {server.dispatcher.warmup_seconds_total:.2f}s over "
+        f"{server.dispatcher.executable_count} warm executable(s)")
     httpd = make_http_server(server, args.host, args.port)
     port = httpd.server_address[1]
     if args.port_file:
@@ -1251,6 +1265,202 @@ def cmd_serve(args) -> int:
             + (f", {st['breaches_total']} breach(es)"
                if st["breaches_total"] else "") + ")")
     return 0
+
+
+def cmd_reshard(args) -> int:
+    """Mesh-agnostic checkpoint resharding (ISSUE 11 tentpole): restore
+    a run directory's checkpoint onto a NEW mesh layout and save it into
+    a fresh run directory whose config.json records the new topology —
+    a 4×2 run resumes on 1 chip or a 64-chip pod and back. Round-trip
+    byte parity is verified by default; the redistribution's collective
+    schedule wire bytes are counted from the compiled HLO
+    (parallel/reshard.py) and land on the `reshard` event."""
+    from proteinbert_tpu.parallel.reshard import (
+        parse_mesh_spec, reshard_checkpoint,
+    )
+
+    cfg = _pretrain_run_config(args.src, args.preset, args.pretrained_set)
+    target = None
+    if args.target_mesh:
+        try:
+            target = parse_mesh_spec(args.target_mesh)
+        except ValueError as e:
+            raise SystemExit(f"--target-mesh: {e}")
+    tele = None
+    if args.events_jsonl:
+        from proteinbert_tpu.obs import Telemetry
+
+        tele = Telemetry(events_path=args.events_jsonl)
+    try:
+        summary = reshard_checkpoint(
+            args.src, args.output, cfg=cfg, target_mesh_cfg=target,
+            zero_update=args.zero_update, step=args.step,
+            telemetry=tele, verify=not args.no_verify)
+    except (FileNotFoundError, ValueError, RuntimeError) as e:
+        raise SystemExit(f"reshard failed: {e}")
+    finally:
+        if tele is not None:
+            _export_metrics(tele)
+            tele.close()
+    print(json.dumps(summary))
+    log(f"resharded {args.src} step {summary['step']} → {args.output} "
+        f"(mesh {summary['target_mesh']}, {summary['schedule']} "
+        f"schedule, {summary['wire_bytes'].get('total', 0)} wire bytes"
+        + (", parity verified" if summary["parity"] else "") + ")")
+    return 0
+
+
+def cmd_fleet(args) -> int:
+    """Fault-tolerant serve fleet (ISSUE 11 tentpole): N `pbt serve`
+    replica subprocesses behind the FleetRouter (serve/fleet.py) —
+    health-checked via /healthz + SLO burn, idempotent-retry with
+    capped backoff and a retry budget, typed load shedding, drain/
+    re-admit via POST /fleet/{drain,admit}, and a shared content-
+    addressed result cache so failover does not re-pay warm
+    embeddings. Replace a replica by draining it, restarting the
+    process (warm via --compile-cache-dir), and re-admitting
+    (docs/serving.md, fleet runbook)."""
+    import signal
+    import subprocess
+    import tempfile
+    import threading
+    import time as _time
+
+    from proteinbert_tpu.serve.fleet import (
+        FleetRouter, make_fleet_http_server,
+    )
+    from proteinbert_tpu.train.resilience import GracefulShutdown
+
+    workdir = tempfile.mkdtemp(prefix="pbt_fleet_")
+    base = [sys.executable, "-m", "proteinbert_tpu"]
+    if args.platform:
+        base += ["--platform", args.platform]
+    base += ["serve", "--pretrained", args.pretrained,
+             "--preset", args.preset, "--host", "127.0.0.1", "--port", "0",
+             "--serve-mode", args.serve_mode,
+             "--max-batch", str(args.max_batch),
+             "--max-wait-ms", str(args.max_wait_ms),
+             "--queue-depth", str(args.queue_depth),
+             "--cache-size", str(args.cache_size),
+             "--on-long", args.on_long]
+    for ov in args.pretrained_set or []:
+        base += ["--pretrained-set", ov]
+    for spec in args.slo or []:
+        base += ["--slo", spec]
+    if args.deadline_ms is not None:
+        base += ["--deadline-ms", str(args.deadline_ms)]
+    if args.compile_cache_dir:
+        base += ["--compile-cache-dir", args.compile_cache_dir]
+
+    tele = None
+    if args.events_jsonl:
+        from proteinbert_tpu.obs import Telemetry
+
+        tele = Telemetry(events_path=args.events_jsonl)
+        tele.flight.install_excepthook()
+
+    procs = []
+    logs = []
+    port_files = []
+
+    def _shutdown_replicas():
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)  # replica-side drain
+        for p in procs:
+            try:
+                p.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for lf in logs:
+            lf.close()
+
+    # procs/logs grow incrementally, so _shutdown_replicas cleans up a
+    # PARTIAL spawn too (e.g. Popen k failing after k-1 started).
+    try:
+        for i in range(args.replicas):
+            pf = os.path.join(workdir, f"replica{i}.port")
+            lf = open(os.path.join(workdir, f"replica{i}.log"), "ab")
+            logs.append(lf)
+            cmd = list(base) + ["--port-file", pf]
+            if args.events_jsonl:
+                cmd += ["--events-jsonl",
+                        os.path.join(workdir, f"replica{i}.events.jsonl")]
+            procs.append(subprocess.Popen(cmd, stdout=lf, stderr=lf))
+            port_files.append(pf)
+    except BaseException:
+        _shutdown_replicas()
+        raise
+    log(f"spawned {args.replicas} replica(s); logs in {workdir}")
+
+    urls = []
+    deadline = _time.monotonic() + args.boot_timeout_s
+    try:
+        for i, pf in enumerate(port_files):
+            while not os.path.exists(pf) or not open(pf).read().strip():
+                if procs[i].poll() is not None:
+                    raise SystemExit(
+                        f"replica {i} died during boot; see "
+                        f"{workdir}/replica{i}.log")
+                if _time.monotonic() > deadline:
+                    raise SystemExit(
+                        f"replica {i} did not boot within "
+                        f"{args.boot_timeout_s}s; see {workdir}")
+                _time.sleep(0.2)
+            urls.append((f"r{i}",
+                         f"http://127.0.0.1:{open(pf).read().strip()}"))
+    except BaseException:
+        _shutdown_replicas()
+        raise
+
+    try:
+        router = FleetRouter(
+            urls, telemetry=tele,
+            health_interval_s=args.health_interval_ms / 1000.0,
+            max_retries=args.max_retries,
+            retry_budget_ratio=args.retry_budget_ratio,
+            cache_size=args.fleet_cache_size,
+        ).start()
+        # Bind can fail (EADDRINUSE on the fixed default port) — the
+        # replicas must not be orphaned by a router that never served.
+        httpd = make_fleet_http_server(router, args.host, args.port)
+    except BaseException:
+        _shutdown_replicas()
+        raise
+    http_thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    try:
+        # Anything from here on (port-file write included — disk full,
+        # parent dir vanished) fails into the finally below, which
+        # tears the whole fleet down; no path leaves replicas orphaned.
+        port = httpd.server_address[1]
+        if args.port_file:
+            with open(args.port_file, "w") as f:
+                f.write(str(port))
+        log(f"fleet router on http://{args.host}:{port} over "
+            f"{len(urls)} replica(s): "
+            + ", ".join(f"{n}={u}" for n, u in urls))
+        with GracefulShutdown() as stop:
+            http_thread.start()
+            while not stop.requested:
+                _time.sleep(0.05)
+                if any(p.poll() is not None for p in procs) \
+                        and args.exit_on_replica_death:
+                    log("a replica process exited; shutting the fleet "
+                        "down (--exit-on-replica-death)")
+                    break
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        router.drain()
+        _shutdown_replicas()
+        if tele is not None:
+            _export_metrics(tele)
+            tele.close()
+    stats = router.stats()
+    log(f"fleet drained: {stats['accepted']} accepted, "
+        f"{stats['sealed']} sealed, outcomes {stats['outcomes']}, "
+        f"{stats['retries_spent']} retries")
+    return 0 if stats["accepted"] == stats["sealed"] else 1
 
 
 # ------------------------------------------------------------------ parser
@@ -1586,7 +1796,13 @@ def build_parser() -> argparse.ArgumentParser:
                          "reject with 400")
     sv.add_argument("--mesh", action="store_true",
                     help="shard served batches over the device mesh "
-                         "batch dim")
+                         "batch dim (both serve modes: bucketed micro-"
+                         "batches and ragged packed rows)")
+    sv.add_argument("--compile-cache-dir", type=creatable_path,
+                    help="persistent XLA compilation cache: restarted/"
+                         "new replicas deserialize warm executables "
+                         "instead of re-paying per-kind warmup "
+                         "(docs/serving.md, fleet section)")
     sv.add_argument("--max-requests", type=int,
                     help="exit after this many requests (smoke tests)")
     sv.add_argument("--events-jsonl", type=creatable_path,
@@ -1620,6 +1836,95 @@ def build_parser() -> argparse.ArgumentParser:
                          "Heads can also be added/removed live via "
                          "POST /v1/heads/{add,remove}")
     sv.set_defaults(fn=cmd_serve)
+
+    rs = sub.add_parser("reshard",
+                        help="restore a checkpoint onto a new mesh "
+                             "layout and re-save it (mesh-agnostic "
+                             "resharding, docs/distributed.md)")
+    rs.add_argument("--src", required=True,
+                    help="source run directory (checkpoints + "
+                         "config.json)")
+    rs.add_argument("--output", type=creatable_path, required=True,
+                    help="run directory to create at the target layout")
+    rs.add_argument("--target-mesh",
+                    help="target topology: '4x2' (data x fsdp), "
+                         "'8x1x1x1' (data x fsdp x model x seq), '1' "
+                         "(single device), or 'data=4,fsdp=2'; "
+                         "default: the source config's mesh")
+    rs.add_argument("--step", type=int,
+                    help="checkpoint step to reshard (default: latest; "
+                         "explicit steps are strict — no torn-tail "
+                         "fallback)")
+    rs.add_argument("--zero-update", action=argparse.BooleanOptionalAction,
+                    default=None,
+                    help="lay the optimizer state out ZeRO-1-sharded "
+                         "on the target (--no-zero-update forces the "
+                         "replicated layout; default: the source "
+                         "config's parallel.zero_update)")
+    rs.add_argument("--no-verify", action="store_true",
+                    help="skip the round-trip byte-parity check "
+                         "(verification re-reads the written "
+                         "checkpoint)")
+    rs.add_argument("--preset", default="tiny",
+                    choices=["tiny", "base", "long", "large"])
+    rs.add_argument("--pretrained-set", action="append",
+                    metavar="PATH=VALUE",
+                    help="config override the source run was made with "
+                         "(when it lacks a config.json)")
+    rs.add_argument("--events-jsonl", type=creatable_path,
+                    help="append the reshard event (+ wire-bytes "
+                         "metrics) to this JSONL stream")
+    rs.set_defaults(fn=cmd_reshard)
+
+    fl = sub.add_parser("fleet",
+                        help="N serve replicas behind a self-healing "
+                             "router (health checks, retries, load "
+                             "shedding, shared result cache)")
+    fl.add_argument("--pretrained", required=True,
+                    help="pretrain checkpoint dir for the trunk")
+    fl.add_argument("--preset", default="tiny",
+                    choices=["tiny", "base", "long", "large"])
+    fl.add_argument("--pretrained-set", action="append",
+                    metavar="PATH=VALUE")
+    fl.add_argument("--replicas", type=int, default=2,
+                    help="serve replica subprocesses to spawn")
+    fl.add_argument("--host", default="127.0.0.1")
+    fl.add_argument("--port", type=int, default=8475,
+                    help="router port; 0 = ephemeral (read it back via "
+                         "--port-file)")
+    fl.add_argument("--port-file", type=creatable_path)
+    fl.add_argument("--serve-mode", default="bucketed",
+                    choices=["bucketed", "ragged"])
+    fl.add_argument("--max-batch", type=int, default=8)
+    fl.add_argument("--max-wait-ms", type=float, default=10.0)
+    fl.add_argument("--queue-depth", type=int, default=64)
+    fl.add_argument("--cache-size", type=int, default=1024,
+                    help="per-replica result-cache entries")
+    fl.add_argument("--fleet-cache-size", type=int, default=2048,
+                    help="router-level shared result-cache entries "
+                         "(0 disables)")
+    fl.add_argument("--deadline-ms", type=float)
+    fl.add_argument("--on-long", default="truncate",
+                    choices=["truncate", "reject"])
+    fl.add_argument("--slo", action="append", metavar="SPEC",
+                    help="passed through to every replica; burn rates "
+                         "feed the router's degraded state")
+    fl.add_argument("--compile-cache-dir", type=creatable_path,
+                    help="shared persistent compilation cache so "
+                         "replacement replicas boot warm")
+    fl.add_argument("--health-interval-ms", type=float, default=500.0)
+    fl.add_argument("--max-retries", type=int, default=2)
+    fl.add_argument("--retry-budget-ratio", type=float, default=0.2)
+    fl.add_argument("--boot-timeout-s", type=float, default=300.0)
+    fl.add_argument("--exit-on-replica-death", action="store_true",
+                    help="shut the fleet down when any replica process "
+                         "exits (default: keep serving on the "
+                         "survivors — the self-healing mode)")
+    fl.add_argument("--events-jsonl", type=creatable_path,
+                    help="append fleet_* router events here (each "
+                         "replica writes its own stream beside its "
+                         "log)")
+    fl.set_defaults(fn=cmd_fleet)
 
     return p
 

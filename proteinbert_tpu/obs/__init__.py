@@ -38,7 +38,8 @@ import time
 from typing import Any, Dict, Optional
 
 from proteinbert_tpu.obs.events import (
-    CKPT_PHASES, EVENT_FIELDS, OUTCOMES, SCHEMA_VERSION,
+    CKPT_PHASES, EVENT_FIELDS, FLEET_REPLICA_STATES,
+    FLEET_REQUEST_OUTCOMES, OUTCOMES, SCHEMA_VERSION,
     SERVE_OUTCOMES, SERVE_REJECT_REASONS, SERVE_REQUEST_OUTCOMES,
     EventLog,
     build_record, make_example, make_record, read_events, sanitize,
@@ -156,6 +157,7 @@ __all__ = [
     "make_example", "sanitize",
     "SCHEMA_VERSION", "EVENT_FIELDS", "CKPT_PHASES", "OUTCOMES",
     "SERVE_OUTCOMES", "SERVE_REJECT_REASONS", "SERVE_REQUEST_OUTCOMES",
+    "FLEET_REPLICA_STATES", "FLEET_REQUEST_OUTCOMES",
     "MetricsRegistry", "QuantileWindow",
     "SLObjective", "SLOEvaluator", "ExemplarHistogram", "ProfileTrigger",
     "parse_slo", "parse_slos",

@@ -97,6 +97,32 @@ EVENT_FIELDS: Dict[str, Dict[str, Any]] = {
     # trajectory sentinel fits so finetune-quality regressions gate
     # like perf does. Extra fields: kind, name.
     "head_eval": {"head_id": str, "metrics": dict},
+    # ---- elastic topology (ISSUE 11) ----
+    # One checkpoint resharded onto a new mesh layout
+    # (parallel/reshard.py, `pbt reshard`). `target_mesh` is the axis
+    # dict the state was restored onto ({} = unsharded single device);
+    # `wire_bytes` is the collective schedule's per-collective output
+    # bytes from the HLO byte-counter (zero.collective_bytes_from_hlo),
+    # or {"total": 0} with schedule="host_staged" when source and
+    # target device sets differ and the move goes through the host.
+    # Extra fields: source_mesh, zero_update, schedule, parity, src,
+    # dst.
+    "reshard": {"step": int, "target_mesh": dict, "wire_bytes": dict},
+    # ---- serve fleet (ISSUE 11): router in front of N replicas ----
+    # Router manifest (replica URLs, retry/health policy) — the fleet
+    # counterpart of serve_start.
+    "fleet_start": {"config": dict, "pid": int},
+    # One replica state transition: state in FLEET_REPLICA_STATES.
+    # Extra fields: url, reason, consecutive_failures, burn_rate.
+    "fleet_replica": {"replica": str, "state": str},
+    # One terminal routed request: outcome in FLEET_REQUEST_OUTCOMES
+    # (every request the router ACCEPTS seals in exactly one of these —
+    # the fleet-level funnel the drill harness audits). Typed optional
+    # fields: replica, retries, status.
+    "fleet_request": {"outcome": str, "path": str},
+    # Terminal router record; outcome in SERVE_OUTCOMES, stats is
+    # FleetRouter.stats().
+    "fleet_end": {"outcome": str, "stats": dict},
 }
 
 CKPT_PHASES = ("dispatch", "landed", "save")
@@ -110,6 +136,18 @@ SERVE_REJECT_REASONS = ("queue_full", "deadline", "closed", "too_long",
 # aborted was killed by a hard shutdown.
 SERVE_REQUEST_OUTCOMES = ("ok", "cache_hit", "error", "expired",
                           "evicted", "rejected", "aborted")
+# Fleet replica health states (serve/fleet.py): up (routable),
+# degraded (SLO burn > threshold — deprioritized), dead (health checks
+# failing), draining (operator drain: no new work, in-flight finishes),
+# admitted (re-admitted after drain or recovery from dead).
+FLEET_REPLICA_STATES = ("up", "degraded", "dead", "draining", "admitted")
+# Terminal fleet-routed request outcomes: ok (first replica answered),
+# cache_hit (the shared result cache short-circuited), retried_ok (a
+# retry on another replica answered after a failure), shed (load shed —
+# a typed 429/503 passthrough or router-side no-capacity 503), failed
+# (a non-retryable error reached the client).
+FLEET_REQUEST_OUTCOMES = ("ok", "cache_hit", "retried_ok", "shed",
+                          "failed")
 
 
 def sanitize(value: Any) -> Any:
@@ -275,6 +313,43 @@ def validate_record(rec: Any) -> None:
         if isinstance(br, bool) or not math.isfinite(br) or br < 0:
             raise ValueError(f"slo_breach.burn_rate must be a "
                              f"non-negative finite number, got {br!r}")
+    if event == "reshard":
+        for name, v in rec["wire_bytes"].items():
+            if isinstance(v, bool) or not isinstance(v, int) or v < 0:
+                raise ValueError(
+                    f"reshard.wire_bytes[{name!r}] must be a "
+                    f"non-negative int, got {v!r}")
+        for k in rec["target_mesh"]:
+            if not isinstance(k, str):
+                raise ValueError(
+                    f"reshard.target_mesh keys must be axis names, "
+                    f"got {k!r}")
+    if event == "fleet_replica" and rec["state"] not in FLEET_REPLICA_STATES:
+        raise ValueError(f"fleet_replica.state {rec['state']!r} not in "
+                         f"{FLEET_REPLICA_STATES}")
+    if event == "fleet_request":
+        if rec["outcome"] not in FLEET_REQUEST_OUTCOMES:
+            raise ValueError(f"fleet_request.outcome {rec['outcome']!r} "
+                             f"not in {FLEET_REQUEST_OUTCOMES}")
+        retries = rec.get("retries")
+        if retries is not None and (not isinstance(retries, int)
+                                    or isinstance(retries, bool)
+                                    or retries < 0):
+            raise ValueError(f"fleet_request.retries must be a "
+                             f"non-negative int, got {retries!r}")
+        status = rec.get("status")
+        if status is not None and (not isinstance(status, int)
+                                   or isinstance(status, bool)
+                                   or not 100 <= status <= 599):
+            raise ValueError(f"fleet_request.status must be an HTTP "
+                             f"status code, got {status!r}")
+        rep = rec.get("replica")
+        if rep is not None and not isinstance(rep, str):
+            raise ValueError(f"fleet_request.replica must be a string, "
+                             f"got {rep!r}")
+    if event == "fleet_end" and rec["outcome"] not in SERVE_OUTCOMES:
+        raise ValueError(f"fleet_end.outcome {rec['outcome']!r} not in "
+                         f"{SERVE_OUTCOMES}")
 
 
 def make_example(event: str) -> Dict[str, Any]:
@@ -304,6 +379,13 @@ def make_example(event: str) -> Dict[str, Any]:
         "head_eval": {"head_id": "a1b2c3d4e5f60708",
                       "metrics": {"per_residue_accuracy": 0.9,
                                   "score": 0.9}},
+        "reshard": {"step": 1, "target_mesh": {"data": 4, "fsdp": 2},
+                    "wire_bytes": {"all-gather": 1024, "total": 1024}},
+        "fleet_start": {"config": {"replicas": 3}, "pid": 1},
+        "fleet_replica": {"replica": "r0", "state": "up"},
+        "fleet_request": {"outcome": "ok", "path": "/v1/embed",
+                          "replica": "r0", "retries": 0, "status": 200},
+        "fleet_end": {"outcome": "drained", "stats": {"accepted": 0}},
     }
     return make_record(event, seq=0, t=0.0, **payloads[event])
 

@@ -7,6 +7,10 @@ from proteinbert_tpu.parallel.halo import (
     halo_exchange, conv1d_halo, seq_parallel_conv1d,
 )
 from proteinbert_tpu.parallel.multihost import maybe_initialize_distributed
+from proteinbert_tpu.parallel.reshard import (
+    mesh_from_config, parse_mesh_spec, reshard_checkpoint, reshard_state,
+    reshard_schedule_bytes, states_byte_identical,
+)
 from proteinbert_tpu.parallel.seq_parallel import (
     make_seq_parallel_train_step, seq_parallel_apply, sharded_global_attention,
 )
@@ -22,4 +26,6 @@ __all__ = [
     "make_seq_parallel_train_step", "seq_parallel_apply",
     "sharded_global_attention", "maybe_initialize_distributed",
     "make_zero_train_step", "zero_extent", "zero_gradient_update",
+    "mesh_from_config", "parse_mesh_spec", "reshard_checkpoint",
+    "reshard_state", "reshard_schedule_bytes", "states_byte_identical",
 ]

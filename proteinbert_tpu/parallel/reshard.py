@@ -1,0 +1,384 @@
+"""Mesh-agnostic checkpoint resharding (ISSUE 11 tentpole, ROADMAP 2).
+
+A checkpoint used to be implicitly married to the mesh shape that wrote
+it: the trainer builds its restore template with the CURRENT run's
+sharding rules, and nothing in the repo exercised — let alone
+guaranteed — that a 4×2 run's state lands correctly on a 1-chip or
+64-chip layout. This module makes topology an operational knob:
+
+- **restore half**: orbax's StandardRestore places each leaf according
+  to the restore TEMPLATE's shardings, not the writer's — so restoring
+  any checkpoint onto any mesh is "build the template under the target
+  mesh's `sharding.state_sharding` rules and restore". That covers the
+  whole TrainState (params, ZeRO-1-sharded Adam mu/nu under
+  `zero_update=True`, PRNG key, step) plus served trunks/heads (which
+  restore through the same Checkpointer/inference path with a
+  target-layout template).
+- **schedule half**: a LIVE redistribution between two layouts of the
+  same device set is one `with_sharding_constraint` — XLA lowers it to
+  the portable collective schedule of the array-redistribution paper
+  (PAPERS.md: all-gather / all-to-all / collective-permute composites).
+  `reshard_schedule_bytes` AOT-compiles exactly that program and counts
+  its wire bytes with the existing HLO byte-counter
+  (`parallel.zero.collective_bytes_from_hlo`), so reshard traffic is
+  byte-accounted the same way ZeRO's collectives are — and a later
+  quantized variant (EQuARX line) A/Bs against these numbers. When the
+  source and target device sets differ (e.g. 4×2 → a single chip), the
+  move necessarily stages through the host and the schedule is
+  reported as `host_staged` with zero collective bytes, not guessed.
+
+`reshard_checkpoint` composes both into the `pbt reshard` CLI verb:
+restore a run directory's latest (or given) step onto a target mesh,
+save it into a fresh run directory whose config.json records the new
+topology (so `pbt pretrain --checkpoint-dir` resumes there natively),
+and emit a schema-versioned `reshard` event carrying the wire-byte
+breakdown. Byte-identity across the round trip is asserted by
+tests/test_reshard.py over a 1×1 ↔ 4×2 ↔ 8×1 grid, plain and ZeRO-1,
+and by the tier-1 reshard smoke (tools/reshard_smoke.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from proteinbert_tpu.configs import MeshConfig
+
+logger = logging.getLogger(__name__)
+
+
+# ------------------------------------------------------------- mesh specs
+
+def parse_mesh_spec(spec: str) -> MeshConfig:
+    """Parse a CLI mesh spec into a MeshConfig.
+
+    Accepted forms: `"4x2"` (data×fsdp), `"4x2x1x1"`
+    (data×fsdp×model×seq), `"1"` (single device — no mesh), or
+    key=value pairs `"data=4,fsdp=2"`. Axis order follows
+    MeshConfig.axis_names.
+    """
+    spec = spec.strip().lower()
+    if not spec:
+        raise ValueError("empty mesh spec")
+    def extent(raw) -> int:
+        n = int(raw)
+        if n < 1:
+            # A zero/negative axis would silently degrade to the
+            # single-device layout (num_devices 0 -> "no mesh") and
+            # rewrite config.json with a nonsense topology — reject.
+            raise ValueError(f"mesh axis extent must be >= 1, got {n}")
+        return n
+
+    if "=" in spec:
+        axes: Dict[str, int] = {}
+        for part in spec.split(","):
+            if "=" not in part:
+                raise ValueError(f"bad mesh spec fragment {part!r} "
+                                 "(expected axis=extent)")
+            k, v = part.split("=", 1)
+            k = k.strip()
+            if k not in MeshConfig().axis_names:
+                raise ValueError(f"unknown mesh axis {k!r}; have "
+                                 f"{MeshConfig().axis_names}")
+            axes[k] = extent(v)
+        return MeshConfig(**axes)
+    dims = [extent(d) for d in spec.split("x")]
+    if len(dims) > 4:
+        raise ValueError(f"mesh spec {spec!r} has {len(dims)} axes; "
+                         "at most data x fsdp x model x seq")
+    dims += [1] * (4 - len(dims))
+    return MeshConfig(data=dims[0], fsdp=dims[1], model=dims[2],
+                      seq=dims[3])
+
+
+def mesh_from_config(mesh_cfg: MeshConfig,
+                     devices=None) -> Optional[Mesh]:
+    """The Mesh a MeshConfig describes, or None for the single-device
+    (unsharded) layout — the convention the trainer and CLI use."""
+    if mesh_cfg.num_devices <= 1:
+        return None
+    from proteinbert_tpu.parallel.mesh import make_mesh
+
+    if devices is None:
+        devices = jax.devices()[: mesh_cfg.num_devices]
+    return make_mesh(mesh_cfg, devices)
+
+
+# ------------------------------------------------------- layout templates
+
+def target_template(cfg, mesh: Optional[Mesh],
+                    zero_update: bool = False) -> Any:
+    """A concrete TrainState laid out for `mesh` under the sharding
+    rules — the restore template whose shardings tell orbax where every
+    shard of an arbitrary checkpoint goes. mesh=None → unsharded."""
+    from proteinbert_tpu.parallel.sharding import shard_train_state
+    from proteinbert_tpu.train.train_state import create_train_state
+
+    state = create_train_state(jax.random.PRNGKey(cfg.train.seed), cfg)
+    if mesh is not None:
+        state = shard_train_state(state, mesh, zero_update=zero_update)
+    return state
+
+
+def state_shardings_for(mesh: Optional[Mesh], abstract_state: Any,
+                        zero_update: bool = False) -> Optional[Any]:
+    """NamedSharding tree for `mesh` (None → None: unsharded)."""
+    if mesh is None:
+        return None
+    from proteinbert_tpu.parallel.sharding import state_sharding
+
+    return state_sharding(mesh, abstract_state, zero_update=zero_update)
+
+
+def abstract_target_template(cfg, mesh: Optional[Mesh],
+                             zero_update: bool = False) -> Any:
+    """`target_template` without the allocation: ShapeDtypeStructs
+    carrying the target layout's shardings. The restore path only
+    needs shapes/dtypes/shardings, and a concrete template would cost
+    a full extra copy of params + Adam moments in device memory right
+    where memory is tightest (restoring a pod checkpoint on one chip).
+    mesh=None pins every leaf to the default device explicitly — an
+    UNSHARDED struct would let orbax fall back to the checkpoint's
+    recorded (possibly absent-device) shardings."""
+    from jax.sharding import SingleDeviceSharding
+    from proteinbert_tpu.train.train_state import create_train_state
+
+    abstract = jax.eval_shape(
+        lambda: create_train_state(jax.random.PRNGKey(cfg.train.seed),
+                                   cfg))
+    if mesh is None:
+        single = SingleDeviceSharding(jax.devices()[0])
+        return jax.tree.map(
+            lambda leaf: jax.ShapeDtypeStruct(leaf.shape, leaf.dtype,
+                                              sharding=single), abstract)
+    shardings = state_shardings_for(mesh, abstract,
+                                    zero_update=zero_update)
+    return jax.tree.map(
+        lambda leaf, sh: jax.ShapeDtypeStruct(leaf.shape, leaf.dtype,
+                                              sharding=sh),
+        abstract, shardings)
+
+
+# ------------------------------------------------------- live resharding
+
+def reshard_state(state: Any, mesh: Optional[Mesh],
+                  zero_update: bool = False) -> Any:
+    """Redistribute a concrete TrainState onto `mesh` per the sharding
+    rules (None = single-device). `jax.device_put` performs the move:
+    same-device-set layout changes run the on-device collective
+    schedule; cross-device-set moves stage through the host."""
+    if mesh is None:
+        return jax.device_put(state, jax.devices()[0])
+    shardings = state_shardings_for(mesh, jax.eval_shape(lambda: state),
+                                    zero_update=zero_update)
+    return jax.device_put(state, shardings)
+
+
+def _mesh_devices(mesh: Optional[Mesh]) -> Tuple:
+    if mesh is None:
+        return (jax.devices()[0],)
+    return tuple(mesh.devices.flat)
+
+
+def reshard_schedule_bytes(
+    cfg,
+    source_mesh: Optional[Mesh],
+    target_mesh: Optional[Mesh],
+    source_zero: bool = False,
+    target_zero: bool = False,
+) -> Tuple[Dict[str, int], str]:
+    """Wire bytes of the source→target redistribution's collective
+    schedule, from the compiled HLO alone (no state is allocated or
+    moved). Returns (collective_bytes_from_hlo breakdown, schedule
+    kind): `"collective"` when source and target share one device set —
+    the AOT-compiled `with_sharding_constraint` program IS the portable
+    redistribution schedule — or `"host_staged"` with zero bytes when
+    the device sets differ and the move cannot stay on the fabric.
+    `"identity"` when the layouts are the same (nothing moves)."""
+    from proteinbert_tpu.parallel.zero import collective_bytes_from_hlo
+    from proteinbert_tpu.train.train_state import create_train_state
+
+    empty = {"total": 0}
+    if set(_mesh_devices(source_mesh)) != set(_mesh_devices(target_mesh)):
+        return empty, "host_staged"
+
+    abstract = jax.eval_shape(
+        lambda: create_train_state(jax.random.PRNGKey(0), cfg))
+    src_sh = state_shardings_for(source_mesh, abstract,
+                                 zero_update=source_zero)
+    dst_sh = state_shardings_for(target_mesh, abstract,
+                                 zero_update=target_zero)
+    if source_mesh is None and target_mesh is None:
+        return empty, "identity"
+
+    if dst_sh is None:
+        # Same single device on both sides (num_devices == 1 meshes).
+        return empty, "identity"
+
+    def move(tree):
+        return jax.lax.with_sharding_constraint(tree, dst_sh)
+
+    args = jax.tree.map(
+        lambda leaf, sh: jax.ShapeDtypeStruct(leaf.shape, leaf.dtype,
+                                              sharding=sh),
+        abstract, src_sh) if src_sh is not None else abstract
+    hlo = jax.jit(move).lower(args).compile().as_text()
+    out = collective_bytes_from_hlo(hlo)
+    return out, "collective" if out.get("total") else "identity"
+
+
+# ------------------------------------------------------------- parity
+
+def tree_digest(state: Any) -> Dict[str, bytes]:
+    """Canonical per-leaf byte image of a pytree, keyed by tree path —
+    layout-independent (device_get assembles the global array), so two
+    layouts of the same state compare EQUAL iff byte-identical."""
+    out: Dict[str, bytes] = {}
+
+    def add(path, leaf):
+        out[jax.tree_util.keystr(path)] = np.asarray(
+            jax.device_get(leaf)).tobytes()
+
+    jax.tree_util.tree_map_with_path(add, state)
+    return out
+
+
+def states_byte_identical(a: Any, b: Any) -> bool:
+    return tree_digest(a) == tree_digest(b)
+
+
+# ------------------------------------------------------ checkpoint verb
+
+def reshard_checkpoint(
+    src: str,
+    dst: str,
+    cfg=None,
+    target_mesh_cfg: Optional[MeshConfig] = None,
+    zero_update: Optional[bool] = None,
+    step: Optional[int] = None,
+    telemetry=None,
+    verify: bool = True,
+) -> Dict[str, Any]:
+    """Restore `src`'s checkpoint onto the target mesh layout and save
+    it into run directory `dst` (config.json updated to the new
+    topology, so training/serving resume there natively).
+
+    - `cfg`: the source run's config; default: `src/config.json`.
+    - `target_mesh_cfg`: target topology; default: cfg.mesh (a layout-
+      preserving copy).
+    - `zero_update`: lay the optimizer state out ZeRO-1-sharded on the
+      target (default: the source config's parallel.zero_update).
+    - `verify`: re-restore from `dst` and byte-compare against the
+      state just written (the round-trip parity gate).
+
+    Returns a summary dict (step, meshes, wire_bytes, schedule, parity)
+    and emits one `reshard` event when telemetry is enabled.
+    """
+    from proteinbert_tpu.configs import load_config, save_config
+    from proteinbert_tpu.obs import as_telemetry
+    from proteinbert_tpu.train.checkpoint import Checkpointer
+
+    tele = as_telemetry(telemetry)
+    if cfg is None:
+        path = os.path.join(src, "config.json")
+        if not os.path.isfile(path):
+            raise FileNotFoundError(
+                f"{src} has no config.json; pass cfg= (CLI: "
+                "--preset/--set describing the source run)")
+        cfg = load_config(path)
+    if target_mesh_cfg is None:
+        target_mesh_cfg = cfg.mesh
+    if zero_update is None:
+        zero_update = cfg.parallel.zero_update
+    if target_mesh_cfg.num_devices > jax.device_count():
+        raise ValueError(
+            f"target mesh {target_mesh_cfg.shape} wants "
+            f"{target_mesh_cfg.num_devices} devices, have "
+            f"{jax.device_count()}")
+
+    # The SOURCE mesh exists only for wire-byte accounting; restoring
+    # never needs the writer's devices. On a host too small to build it
+    # (the headline shrink case: a 4×2 checkpoint restored on one
+    # chip), skip the schedule compile and report host_staged — which
+    # is also the truth: the source layout's devices are not present.
+    source_available = cfg.mesh.num_devices <= jax.device_count()
+    source_mesh = mesh_from_config(cfg.mesh) if source_available else None
+    target_mesh = mesh_from_config(target_mesh_cfg)
+
+    template = abstract_target_template(cfg, target_mesh,
+                                        zero_update=zero_update)
+    src_ck = Checkpointer(src, async_save=False)
+    src_ck.on_note = lambda **f: tele.emit("note", **f)
+    try:
+        state, data_state = src_ck.restore(template, step=step)
+    finally:
+        src_ck.close()
+    if state is None:
+        raise FileNotFoundError(f"no checkpoint found in {src}")
+    restored_step = int(jax.device_get(state.step))
+
+    if source_available:
+        wire_bytes, schedule = reshard_schedule_bytes(
+            cfg, source_mesh, target_mesh,
+            source_zero=cfg.parallel.zero_update, target_zero=zero_update)
+    else:
+        wire_bytes, schedule = {"total": 0}, "host_staged"
+    for kind, n in wire_bytes.items():
+        tele.metrics.gauge("reshard_wire_bytes", kind=kind).set(n)
+
+    new_cfg = cfg.replace(
+        mesh=target_mesh_cfg,
+        parallel=dataclasses.replace(cfg.parallel,
+                                     zero_update=bool(zero_update)))
+    dst_ck = Checkpointer(dst, async_save=False)
+    try:
+        saved = dst_ck.save(restored_step, state, data_state)
+        if not saved:
+            raise RuntimeError(
+                f"{dst} already holds a checkpoint at step >= "
+                f"{restored_step}; pick an empty/older output directory")
+        parity = None
+        if verify:
+            back, _ = dst_ck.restore(template, step=restored_step,
+                                     fallback=False)
+            parity = states_byte_identical(state, back)
+            if not parity:
+                raise RuntimeError(
+                    "round-trip parity FAILED: the state restored from "
+                    f"{dst} is not byte-identical to the resharded "
+                    "state just written")
+    finally:
+        dst_ck.close()
+    save_config(new_cfg, os.path.join(os.path.abspath(dst), "config.json"))
+
+    summary = {
+        "step": restored_step,
+        "source_mesh": {k: int(v) for k, v in
+                        zip(cfg.mesh.axis_names, cfg.mesh.shape)},
+        "target_mesh": {k: int(v) for k, v in
+                        zip(target_mesh_cfg.axis_names,
+                            target_mesh_cfg.shape)},
+        "zero_update": bool(zero_update),
+        "schedule": schedule,
+        "wire_bytes": wire_bytes,
+        "parity": parity,
+    }
+    tele.emit("reshard", step=restored_step,
+              target_mesh=summary["target_mesh"],
+              wire_bytes=wire_bytes,
+              source_mesh=summary["source_mesh"],
+              zero_update=bool(zero_update), schedule=schedule,
+              parity=parity, src=os.path.abspath(src),
+              dst=os.path.abspath(dst))
+    logger.info(
+        "resharded %s step %d: %s -> %s (%s schedule, %d collective "
+        "bytes%s)", src, restored_step, summary["source_mesh"],
+        summary["target_mesh"], schedule, wire_bytes.get("total", 0),
+        ", parity verified" if parity else "")
+    return summary

@@ -54,9 +54,17 @@ def serve_batch_sharding(mesh: Mesh) -> Dict[str, NamedSharding]:
     carry 'seq' — served batches are sliced to ragged bucket lengths
     that need not divide the seq extent, and a single forward pass has
     no optimizer state to amortize a halo exchange against; batch-dim
-    data parallelism is the whole win."""
+    data parallelism is the whole win.
+
+    Ragged PACKED batches (serve/dispatch.RaggedDispatcher under a
+    mesh) use the same rules: the per-position segment map shards
+    exactly like the tokens it annotates, and the per-segment
+    (rows, S, A) annotation tensor keeps batch-only sharding (trailing
+    spec axes replicate, so the 2D bucketed (rows, A) shape uses the
+    same entry)."""
     return {
         "tokens": NamedSharding(mesh, P(("data", "fsdp"), None)),
+        "segment_ids": NamedSharding(mesh, P(("data", "fsdp"), None)),
         "annotations": NamedSharding(mesh, P(("data", "fsdp"), None)),
     }
 

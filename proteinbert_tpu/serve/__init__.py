@@ -47,6 +47,14 @@ jitted ≤1e-5 tolerance (docs/serving.md, "Ragged batching").
 Benchmarked by `bench.py --serve` (throughput + latency percentiles vs
 the one-request-at-a-time offline baseline); documented in
 docs/serving.md.
+
+Above single servers sits the FLEET layer (ISSUE 11, `serve/fleet.py`
+/ `pbt fleet`): N replicas behind a `FleetRouter` — /healthz +
+SLO-burn health states, idempotent retries with capped backoff and a
+fleet-wide retry budget, typed load shedding on top of the 429/503
+contract, operator drain/re-admit, a shared content-addressed result
+cache, and exactly-once request sealing audited by the fault-injection
+drill (`tools/fleet_drill.py`).
 """
 
 from proteinbert_tpu.serve.cache import EmbeddingCache, content_key
@@ -62,6 +70,9 @@ from proteinbert_tpu.serve.errors import (
     TrunkMismatchError,
     UnknownHeadError,
 )
+from proteinbert_tpu.serve.fleet import (
+    FaultInjector, FleetRouter, make_fleet_http_server,
+)
 from proteinbert_tpu.serve.queue import Request, RequestQueue
 from proteinbert_tpu.serve.scheduler import (
     MicroBatchScheduler, PackedBatchScheduler,
@@ -72,6 +83,9 @@ from proteinbert_tpu.serve.trace import RequestTrace
 
 __all__ = [
     "Server",
+    "FleetRouter",
+    "FaultInjector",
+    "make_fleet_http_server",
     "SERVE_MODES",
     "BucketDispatcher",
     "RaggedDispatcher",

@@ -137,6 +137,7 @@ class BucketDispatcher:
         self.mesh = mesh
         self._shardings = None
         if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
             from proteinbert_tpu.parallel.sharding import serve_batch_sharding
 
             bad = [c for c in self.batch_classes if c % divisor]
@@ -147,6 +148,15 @@ class BucketDispatcher:
                     "over the batch dim, so every compiled class must "
                     "split evenly across the replicas")
             self._shardings = serve_batch_sharding(mesh)
+            # Replicate the trunk over the mesh devices. Orbax-restored
+            # params arrive COMMITTED to one device, and a jitted call
+            # mixing them with batch-dim-sharded inputs is an
+            # "incompatible devices" error — so `pbt serve --mesh` from
+            # any real run dir needs the explicit replicated placement
+            # (batch-dim data parallelism is the serving layout; fresh
+            # uncommitted params, as tests build, were merely lucky).
+            self.params = jax.device_put(
+                self.params, NamedSharding(mesh, PartitionSpec()))
         self._compile_hist = (metrics.histogram("serve_compile_seconds")
                               if metrics is not None else None)
         # Executable-zoo accounting (ISSUE 9 satellite): how many warm
@@ -232,10 +242,19 @@ class BucketDispatcher:
         PER-HEAD INCREMENTAL warmup cost, returned in seconds and
         recorded in `warmup_report["heads"]`. The trunk is never
         recompiled (asserted by tests/test_heads.py)."""
+        if self.mesh is not None:
+            # Same committed-params hazard as the trunk (see __init__):
+            # registry-loaded head params arrive committed to one
+            # device and must be replicated to join mesh-sharded
+            # trunk outputs in the jitted tail.
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            placed = jax.device_put(
+                head.params, NamedSharding(self.mesh, PartitionSpec()))
+        else:
+            placed = jax.device_put(head.params)
         head = LoadedHead(head_id=head.head_id, name=head.name,
-                          task=head.task,
-                          params=jax.device_put(head.params),
-                          meta=head.meta)
+                          task=head.task, params=placed, meta=head.meta)
         with self._heads_lock:
             self.heads[head.head_id] = head
         return self.warm_head(head) if warm else 0.0
@@ -555,25 +574,37 @@ class RaggedDispatcher(BucketDispatcher):
         mesh=None,
         metrics=None,
     ):
-        if mesh is not None:
-            raise ValueError(
-                "ragged serving does not shard over a mesh yet — use "
-                "serve_mode='bucketed' for multi-chip serving "
-                "(docs/serving.md, ragged batching)")
         if rows_per_batch < 1:
             raise ValueError(f"rows_per_batch must be >= 1, "
                              f"got {rows_per_batch}")
         if max_segments < 1:
             raise ValueError(f"max_segments must be >= 1, "
                              f"got {max_segments}")
+        # Mesh support (ISSUE 11 satellite, PR 8 residual): packed rows
+        # shard over the joint ('data','fsdp') batch axis exactly like
+        # bucketed micro-batches (serve_batch_sharding — segment_ids
+        # shard like the tokens they annotate). The single batch class
+        # (rows_per_batch,) must split evenly across the replicas; the
+        # parent ctor enforces that and builds self._shardings.
         super().__init__(params, cfg, buckets=buckets,
                          max_batch=rows_per_batch,
-                         batch_classes=(rows_per_batch,), mesh=None,
+                         batch_classes=(rows_per_batch,), mesh=mesh,
                          metrics=metrics)
         self.rows_per_batch = int(rows_per_batch)
         self.max_segments = int(max_segments)
 
     # ----------------------------------------------------------- execution
+
+    def _place_packed(self, tokens: np.ndarray, segment_ids: np.ndarray,
+                      annotations: np.ndarray):
+        """Host packed batch → device arrays, batch-dim-sharded over the
+        mesh when one was passed (serve_batch_sharding)."""
+        if self._shardings is None:
+            return (jnp.asarray(tokens), jnp.asarray(segment_ids),
+                    jnp.asarray(annotations))
+        return (jax.device_put(tokens, self._shardings["tokens"]),
+                jax.device_put(segment_ids, self._shardings["segment_ids"]),
+                jax.device_put(annotations, self._shardings["annotations"]))
 
     def _packed_fn(self, kind: str):
         if kind == "embed":
@@ -634,9 +665,7 @@ class RaggedDispatcher(BucketDispatcher):
             timings["pad_fraction"] = round(1.0 - real / (R * L), 6)
             timings["segments"] = len(riders)
             timings["segments_per_row"] = round(len(riders) / R, 4)
-        tb = jnp.asarray(tokens)
-        sb = jnp.asarray(segment_ids)
-        ab = jnp.asarray(annotations)
+        tb, sb, ab = self._place_packed(tokens, segment_ids, annotations)
         if timed:
             t1 = time.perf_counter()
             timings["prep_s"] = round(t1 - t0, 9)
@@ -724,7 +753,7 @@ class RaggedDispatcher(BucketDispatcher):
             heads = list(self.heads.values())
         R, L = self.rows_per_batch, self.cfg.data.seq_len
         tokens, seg, ann, _ = self._dummy_packed()
-        tb, sb, ab = jnp.asarray(tokens), jnp.asarray(seg), jnp.asarray(ann)
+        tb, sb, ab = self._place_packed(tokens, seg, ann)
         with self._warm_lock:
             new = ("trunk", L, R) not in self._warm
         t0 = time.perf_counter()

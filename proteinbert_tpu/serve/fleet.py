@@ -1,0 +1,763 @@
+"""Self-healing serve fleet: a tiny router in front of N replicas.
+
+One `pbt serve` process is a single point of failure — a crash, a bad
+host, or a draining deploy takes the whole endpoint down. This module
+turns "a server" into "a service" (ROADMAP item 2): N serve replicas
+(each an ordinary `pbt serve` HTTP endpoint, in-process or subprocess)
+behind a `FleetRouter` that
+
+- **health-checks** every replica via its existing `/healthz`
+  (liveness + the SLO burn rates PR 6 put in `stats()["slo"]`): a
+  replica whose checks fail `fail_threshold` times in a row goes
+  `dead`; one whose worst burn rate exceeds `degrade_burn` goes
+  `degraded` (kept as a last resort, never preferred); a dead replica
+  that answers `readmit_threshold` consecutive checks is re-admitted.
+  A torn health response (unparseable JSON — a replica dying
+  mid-write) counts as a failure, never as health.
+- **retries idempotent requests** — every `/v1/*` inference POST is a
+  pure function of its body — on a dead/degraded replica: connection
+  failures and 503s (a replica draining/closing) retry on the next
+  replica with capped exponential backoff, bounded by BOTH a
+  per-request `max_retries` and a fleet-wide retry BUDGET
+  (`floor + ratio·accepted`), so a brown-out cannot amplify traffic
+  into a retry storm.
+- **sheds load on top of the existing 429/504 contract** instead of
+  queue-collapsing: a replica's 429 (queue_full) and 504 (deadline)
+  are typed backpressure and pass through UNRETRIED — re-driving them
+  would amplify exactly the load that caused them — and when no
+  admitting replica exists the router answers its own typed 503
+  (`no_capacity`, Retry-After) rather than queueing.
+- **drains and re-admits replicas** without dropping accepted work:
+  `drain` only stops NEW routing — requests already forwarded finish
+  on the replica (its own drain semantics guarantee that), and
+  `admit` restores routing.
+- **shares a content-addressed result cache** (`serve/cache.py` keyed
+  exactly like the replica-local caches) so a failover does not re-pay
+  warm embeddings: a repeat of any previously answered request is
+  served router-side even while the replica that computed it is dead.
+
+Exactly-once sealing: every request the router ACCEPTS terminates in
+exactly one `FLEET_REQUEST_OUTCOMES` outcome (ok / cache_hit /
+retried_ok / shed / failed), counted in `fleet_requests_total{outcome=}`
+and emitted as a `fleet_request` event — the fleet-level funnel the
+drill harness (`tools/fleet_drill.py`) audits against the per-replica
+PR 6 trace funnel. `FaultInjector` hooks let the drill kill replicas
+mid-request, inject latency spikes, and tear health responses without
+patching router internals.
+
+Stdlib-only transport (http.server + urllib), same as serve/http.py.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import logging
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from proteinbert_tpu.serve.cache import EmbeddingCache, content_key
+
+logger = logging.getLogger(__name__)
+
+# Inference routes the router forwards (and caches). All are idempotent:
+# the response is a pure function of the request body.
+ROUTE_KINDS = {
+    "/v1/embed": "embed",
+    "/v1/predict_go": "predict_go",
+    "/v1/predict_residues": "predict_residues",
+    "/v1/predict_task": "predict_task",
+}
+
+# 503 = the replica is closing/draining (ServerClosedError) — the work
+# never started, safe and right to retry elsewhere. 429/504 are typed
+# backpressure/QoS rejections: retrying would amplify the very load
+# that caused them (shed, pass through).
+RETRYABLE_STATUSES = frozenset({503})
+SHED_STATUSES = frozenset({429, 504})
+
+_MAX_BODY = 32 * 1024 * 1024
+
+
+class FaultInjector:
+    """Drill/test hooks threaded through the router: per-replica
+    injected forward latency, simulated connection kills, and torn
+    health responses. Thread-safe; every default is 'no fault', so a
+    router built without one pays a None check only."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._latency: Dict[str, float] = {}
+        self._dead: set = set()
+        self._torn_health: set = set()
+
+    def set_latency(self, replica: str, seconds: float) -> None:
+        with self._lock:
+            if seconds > 0:
+                self._latency[replica] = float(seconds)
+            else:
+                self._latency.pop(replica, None)
+
+    def kill(self, replica: str) -> None:
+        """Simulate a dead replica: every forward to it raises a
+        connection error at the router (the real-kill path — actually
+        closing the replica's socket — is the drill's job)."""
+        with self._lock:
+            self._dead.add(replica)
+
+    def revive(self, replica: str) -> None:
+        with self._lock:
+            self._dead.discard(replica)
+
+    def tear_health(self, replica: str, torn: bool = True) -> None:
+        with self._lock:
+            if torn:
+                self._torn_health.add(replica)
+            else:
+                self._torn_health.discard(replica)
+
+    def forward_latency(self, replica: str) -> float:
+        with self._lock:
+            return self._latency.get(replica, 0.0)
+
+    def is_dead(self, replica: str) -> bool:
+        with self._lock:
+            return replica in self._dead
+
+    def health_is_torn(self, replica: str) -> bool:
+        with self._lock:
+            return replica in self._torn_health
+
+
+class Replica:
+    """Router-side view of one serve replica (state guarded by the
+    router's lock)."""
+
+    def __init__(self, name: str, url: str):
+        self.name = name
+        self.url = url.rstrip("/")
+        self.state = "up"  # optimistic until the first health tick
+        self.consecutive_failures = 0
+        self.consecutive_successes = 0
+        self.inflight = 0
+        self.burn_rate = 0.0
+        self.requests_total = 0
+        self.failures_total = 0
+        self.last_health: Optional[Dict[str, Any]] = None
+
+    def routable(self) -> bool:
+        return self.state in ("up", "degraded")
+
+    def status(self) -> Dict[str, Any]:
+        return {"name": self.name, "url": self.url, "state": self.state,
+                "inflight": self.inflight,
+                "consecutive_failures": self.consecutive_failures,
+                "burn_rate": round(self.burn_rate, 4),
+                "requests_total": self.requests_total,
+                "failures_total": self.failures_total}
+
+
+class FleetRouter:
+    """Route, retry, shed, heal — see module docstring."""
+
+    def __init__(
+        self,
+        replicas: Sequence,
+        *,
+        telemetry=None,
+        clock=time.monotonic,
+        sleep=time.sleep,
+        health_interval_s: float = 0.5,
+        health_timeout_s: float = 2.0,
+        fail_threshold: int = 3,
+        readmit_threshold: int = 2,
+        degrade_burn: float = 1.0,
+        max_retries: int = 2,
+        backoff_base_s: float = 0.05,
+        backoff_cap_s: float = 1.0,
+        retry_budget_ratio: float = 0.2,
+        retry_budget_floor: int = 8,
+        request_timeout_s: float = 30.0,
+        cache_size: int = 2048,
+        fault_injector: Optional[FaultInjector] = None,
+    ):
+        from proteinbert_tpu.obs import as_telemetry
+
+        self.replicas: List[Replica] = []
+        for i, r in enumerate(replicas):
+            if isinstance(r, Replica):
+                self.replicas.append(r)
+            elif isinstance(r, str):
+                self.replicas.append(Replica(f"r{i}", r))
+            else:
+                name, url = r
+                self.replicas.append(Replica(name, url))
+        if not self.replicas:
+            raise ValueError("a fleet needs at least one replica")
+        names = [r.name for r in self.replicas]
+        if len(set(names)) != len(names):
+            raise ValueError(f"replica names must be unique, got {names}")
+        self.tele = as_telemetry(telemetry)
+        self.clock = clock
+        self._sleep = sleep
+        self.health_interval_s = health_interval_s
+        self.health_timeout_s = health_timeout_s
+        self.fail_threshold = fail_threshold
+        self.readmit_threshold = readmit_threshold
+        self.degrade_burn = degrade_burn
+        self.max_retries = max_retries
+        self.backoff_base_s = backoff_base_s
+        self.backoff_cap_s = backoff_cap_s
+        self.retry_budget_ratio = retry_budget_ratio
+        self.retry_budget_floor = retry_budget_floor
+        self.request_timeout_s = request_timeout_s
+        self.injector = fault_injector
+        self.cache = EmbeddingCache(cache_size, metrics=self.tele.metrics)
+        self._lock = threading.Lock()
+        self._rr = itertools.count()
+        # Exactly-once seal accounting: accepted == sealed at drain is
+        # the router-level invariant the drill asserts.
+        self.accepted_total = 0
+        self.sealed_total = 0
+        self.retries_spent = 0
+        self.outcomes: Dict[str, int] = {}
+        metrics = self.tele.metrics
+        from proteinbert_tpu.obs.events import FLEET_REQUEST_OUTCOMES
+
+        self._outcome_c = {o: metrics.counter("fleet_requests_total",
+                                              outcome=o)
+                           for o in FLEET_REQUEST_OUTCOMES}
+        self._retry_c = metrics.counter("fleet_retries_total")
+        self._shed_c = metrics.counter("fleet_shed_total")
+        self._up_g = {r.name: metrics.gauge("fleet_replica_up",
+                                            replica=r.name)
+                      for r in self.replicas}
+        self._admitting_g = metrics.gauge("fleet_replicas_admitting")
+        self._health_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._ended = False
+        self._req_ids = itertools.count(1)
+        self._id_prefix = f"f{os.getpid():x}-"
+
+    # ----------------------------------------------------------- lifecycle
+
+    def start(self) -> "FleetRouter":
+        self.tele.emit("fleet_start", pid=os.getpid(), config={
+            "replicas": {r.name: r.url for r in self.replicas},
+            "health_interval_s": self.health_interval_s,
+            "fail_threshold": self.fail_threshold,
+            "readmit_threshold": self.readmit_threshold,
+            "degrade_burn": self.degrade_burn,
+            "max_retries": self.max_retries,
+            "backoff_base_s": self.backoff_base_s,
+            "backoff_cap_s": self.backoff_cap_s,
+            "retry_budget_ratio": self.retry_budget_ratio,
+            "retry_budget_floor": self.retry_budget_floor,
+            "cache_size": self.cache.capacity,
+        })
+        self._gauge_admitting()
+        if self.health_interval_s > 0:
+            self._health_thread = threading.Thread(
+                target=self._health_loop, name="fleet-health", daemon=True)
+            self._health_thread.start()
+        return self
+
+    def drain(self) -> None:
+        """Stop the health loop and emit the terminal record. The HTTP
+        front end is the caller's to shut down (CLI/drill order:
+        httpd.shutdown() → router.drain()), so no new request can race
+        the terminal stats."""
+        self._stop.set()
+        if self._health_thread is not None:
+            self._health_thread.join(timeout=5.0)
+        if not self._ended:
+            self._ended = True
+            self.tele.emit("fleet_end", outcome="drained",
+                           stats=self.stats())
+
+    # -------------------------------------------------------- health loop
+
+    def _health_loop(self) -> None:
+        while not self._stop.wait(self.health_interval_s):
+            try:
+                self.health_tick()
+            except Exception:  # noqa: BLE001 — a dead health loop is a
+                # SILENT router regression (states frozen, crashed
+                # replicas kept in rotation); log and keep ticking.
+                logger.exception("fleet health tick failed; retrying "
+                                 "next interval")
+
+    def health_tick(self) -> None:
+        """One health sweep over all replicas (public so tests and the
+        drill can drive it deterministically without the thread)."""
+        for rep in self.replicas:
+            payload = self._fetch_health(rep)
+            self._apply_health(rep, payload)
+        self._gauge_admitting()
+
+    def _fetch_health(self, rep: Replica) -> Optional[Dict[str, Any]]:
+        if self.injector is not None and (
+                self.injector.health_is_torn(rep.name)
+                or self.injector.is_dead(rep.name)):
+            return None
+        try:
+            with urllib.request.urlopen(rep.url + "/healthz",
+                                        timeout=self.health_timeout_s) as r:
+                raw = r.read()
+            payload = json.loads(raw)
+            if not isinstance(payload, dict) or not payload.get("ok"):
+                return None  # torn/garbled body == failed check
+            return payload
+        except (urllib.error.URLError, OSError, ValueError):
+            return None
+
+    def _apply_health(self, rep: Replica,
+                      payload: Optional[Dict[str, Any]]) -> None:
+        with self._lock:
+            if payload is None:
+                rep.consecutive_successes = 0
+                rep.consecutive_failures += 1
+                if (rep.state not in ("dead", "draining")
+                        and rep.consecutive_failures >= self.fail_threshold):
+                    self._transition(rep, "dead",
+                                     reason="health_checks_failed")
+                return
+            rep.last_health = payload
+            rep.consecutive_failures = 0
+            rep.consecutive_successes += 1
+            # Defensive parse: a replica of a different version (or a
+            # garbled body that still parsed) must degrade to "no burn
+            # signal", never crash the health pass.
+            slo = ((payload.get("stats") or {}).get("slo")) or {}
+            burns = [0.0]
+            if isinstance(slo, dict):
+                for s in slo.values():
+                    if isinstance(s, dict):
+                        try:
+                            burns.append(float(s.get("burn_rate") or 0.0))
+                        except (TypeError, ValueError):
+                            pass
+            rep.burn_rate = max(burns)
+            if rep.state == "draining":
+                return  # operator intent wins over health
+            if rep.state == "dead":
+                if rep.consecutive_successes >= self.readmit_threshold:
+                    self._transition(rep, "up", event_state="admitted",
+                                     reason="health_recovered")
+                return
+            if rep.burn_rate > self.degrade_burn:
+                if rep.state != "degraded":
+                    self._transition(rep, "degraded", reason="slo_burn")
+            elif rep.state != "up":
+                self._transition(rep, "up", reason="burn_recovered")
+
+    def _transition(self, rep: Replica, state: str,
+                    event_state: Optional[str] = None,
+                    reason: str = "") -> None:
+        """Lock held by callers. `event_state` lets a dead→up recovery
+        report as 'admitted' while storing the routable 'up'."""
+        rep.state = state
+        self._up_g[rep.name].set(1.0 if rep.routable() else 0.0)
+        self.tele.emit("fleet_replica", replica=rep.name,
+                       state=event_state or state, url=rep.url,
+                       reason=reason,
+                       consecutive_failures=rep.consecutive_failures,
+                       burn_rate=round(rep.burn_rate, 4))
+
+    def _gauge_admitting(self) -> None:
+        with self._lock:
+            n = sum(1 for r in self.replicas if r.routable())
+        self._admitting_g.set(n)
+
+    # ------------------------------------------------------ control plane
+
+    def _by_name(self, name: str) -> Replica:
+        for r in self.replicas:
+            if r.name == name:
+                return r
+        raise KeyError(f"no replica {name!r}; have "
+                       f"{[r.name for r in self.replicas]}")
+
+    def drain_replica(self, name: str) -> None:
+        """Operator drain: stop routing NEW work to `name`. Requests
+        already forwarded keep running on the replica (its own drain
+        semantics seal them); nothing accepted is dropped."""
+        rep = self._by_name(name)
+        with self._lock:
+            if rep.state != "draining":
+                self._transition(rep, "draining", reason="operator")
+        self._gauge_admitting()
+
+    def admit_replica(self, name: str) -> None:
+        """Re-admit a drained (or dead) replica into the rotation."""
+        rep = self._by_name(name)
+        with self._lock:
+            rep.consecutive_failures = 0
+            rep.consecutive_successes = 0
+            self._transition(rep, "up", event_state="admitted",
+                             reason="operator")
+        self._gauge_admitting()
+
+    def replica_status(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [r.status() for r in self.replicas]
+
+    # ----------------------------------------------------------- routing
+
+    def _pick(self, exclude: set) -> Optional[Replica]:
+        """Least-inflight admitting replica, 'up' preferred over
+        'degraded', round-robin tiebreak. None when nothing routable."""
+        with self._lock:
+            for states in (("up",), ("degraded",)):
+                cands = [r for r in self.replicas
+                         if r.state in states and r.name not in exclude]
+                if cands:
+                    k = next(self._rr)
+                    cands.sort(key=lambda r: (r.inflight, r.name))
+                    low = cands[0].inflight
+                    lowest = [r for r in cands if r.inflight == low]
+                    return lowest[k % len(lowest)]
+        return None
+
+    def _has_candidate(self, tried: set) -> bool:
+        with self._lock:
+            return any(r.routable() and r.name not in tried
+                       for r in self.replicas)
+
+    def _try_spend_retry(self, retries_so_far: int) -> bool:
+        """Atomically check the per-request cap AND the fleet-wide
+        budget and, when allowed, spend one retry. Check-and-spend is
+        ONE lock hold: a separate check would let K concurrent
+        brown-out requests all observe headroom and collectively
+        overshoot the budget by K-1 — during exactly the storm the
+        budget exists to bound."""
+        if retries_so_far >= self.max_retries:
+            return False
+        with self._lock:
+            allowed = (self.retry_budget_floor
+                       + self.retry_budget_ratio * self.accepted_total)
+            if self.retries_spent >= allowed:
+                return False
+            self.retries_spent += 1
+        self._retry_c.inc()
+        return True
+
+    def _forward(self, rep: Replica, path: str,
+                 raw_body: bytes) -> Tuple[int, bytes]:
+        """One upstream POST; raises ConnectionError-family on transport
+        failure, returns (status, body) otherwise (4xx/5xx included)."""
+        if self.injector is not None:
+            lat = self.injector.forward_latency(rep.name)
+            if lat > 0:
+                self._sleep(lat)
+            if self.injector.is_dead(rep.name):
+                raise ConnectionError(
+                    f"injected kill of replica {rep.name}")
+        req = urllib.request.Request(
+            rep.url + path, data=raw_body,
+            headers={"Content-Type": "application/json"}, method="POST")
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=self.request_timeout_s) as resp:
+                return resp.status, resp.read()
+        except urllib.error.HTTPError as e:
+            # Non-2xx WITH a response: the replica answered — a typed
+            # rejection or error, not a transport failure.
+            return e.code, e.read()
+        # urllib.error.URLError / OSError / timeout propagate: transport
+        # failure, the retry path's business.
+
+    def _cache_key(self, kind: str, body: Any) -> Optional[str]:
+        """Content address of one inference request (None = uncacheable
+        body — the replica will 400 it). Excludes deadline_ms (QoS, not
+        content); includes head_id and top_k (they change the result)."""
+        if self.cache.capacity == 0 or not isinstance(body, dict):
+            return None
+        seq = body.get("seq")
+        if not isinstance(seq, str) or not seq:
+            return None
+        ann = body.get("annotations")
+        scope = kind
+        if body.get("head_id") is not None:
+            scope += f":{body['head_id']}"
+        if body.get("top_k") is not None:
+            scope += f":top{body['top_k']}"
+        try:
+            return content_key(scope, seq, ann)
+        except (TypeError, ValueError):
+            return None
+
+    def route(self, path: str, raw_body: bytes) -> Tuple[int, bytes,
+                                                         Dict[str, str]]:
+        """Route one accepted inference request; returns (status, body,
+        extra headers). EVERY call seals exactly once — the try/finally
+        backstop turns an unexpected escape into a sealed `failed`
+        rather than a lost request."""
+        kind = ROUTE_KINDS[path]
+        rid = f"{self._id_prefix}{next(self._req_ids):x}"
+        with self._lock:
+            self.accepted_total += 1
+        sealed = {"done": False}
+
+        def seal(outcome: str, status: int, replica: Optional[str],
+                 retries: int) -> None:
+            if sealed["done"]:
+                return
+            sealed["done"] = True
+            with self._lock:
+                self.sealed_total += 1
+                self.outcomes[outcome] = self.outcomes.get(outcome, 0) + 1
+            self._outcome_c[outcome].inc()
+            if outcome == "shed":
+                self._shed_c.inc()
+            self.tele.emit("fleet_request", outcome=outcome, path=path,
+                           replica=replica, retries=retries,
+                           status=status, request_id=rid)
+
+        try:
+            return self._route_sealed(kind, path, raw_body, rid, seal)
+        finally:
+            if not sealed["done"]:  # belt-and-braces: never lose one
+                seal("failed", 500, None, 0)
+
+    def _route_sealed(self, kind: str, path: str, raw_body: bytes,
+                      rid: str, seal) -> Tuple[int, bytes, Dict[str, str]]:
+        headers = {"X-PBT-Fleet-Request-Id": rid}
+        try:
+            body = json.loads(raw_body) if raw_body else None
+        except ValueError:
+            body = None
+        key = self._cache_key(kind, body)
+        if key is not None:
+            hit = self.cache.get(key)
+            if hit is not None:
+                seal("cache_hit", 200, None, 0)
+                headers["X-PBT-Fleet-Cache"] = "hit"
+                return 200, hit, headers
+
+        retries = 0
+        tried: set = set()
+        transport_failed_any = False
+        while True:
+            rep = self._pick(tried)
+            if rep is None:
+                if transport_failed_any:
+                    # A candidate existed when the retry was spent but
+                    # died before the pick: this is an outage reaching
+                    # the client, not load shedding — label it so.
+                    seal("failed", 502, None, retries)
+                    return 502, json.dumps(
+                        {"error": "every admitting replica became "
+                                  "unreachable",
+                         "type": "replica_unavailable"}).encode(), headers
+                # Nothing admitting at arrival: typed shed, never a hang.
+                seal("shed", 503, None, retries)
+                headers["Retry-After"] = "1"
+                return 503, json.dumps(
+                    {"error": "no admitting replica in the fleet",
+                     "type": "no_capacity"}).encode(), headers
+            with self._lock:
+                rep.inflight += 1
+                rep.requests_total += 1
+            try:
+                status, resp = self._forward(rep, path, raw_body)
+                transport_failure = False
+            except (urllib.error.URLError, OSError) as e:
+                status, resp = 502, json.dumps(
+                    {"error": f"replica {rep.name} unreachable: {e}",
+                     "type": "replica_unavailable"}).encode()
+                transport_failure = True
+            finally:
+                with self._lock:
+                    rep.inflight -= 1
+
+            if transport_failure or status in RETRYABLE_STATUSES:
+                transport_failed_any = transport_failed_any \
+                    or transport_failure
+                with self._lock:
+                    rep.failures_total += 1
+                    if transport_failure:
+                        rep.consecutive_failures += 1
+                        if (rep.state not in ("dead", "draining")
+                                and rep.consecutive_failures
+                                >= self.fail_threshold):
+                            self._transition(rep, "dead",
+                                             reason="forward_failed")
+                tried.add(rep.name)
+                # Spend a retry only when an untried candidate exists —
+                # a token burned on a guaranteed no_capacity would
+                # deplete the budget without buying a dispatch.
+                if self._has_candidate(tried) \
+                        and self._try_spend_retry(retries):
+                    self._sleep(min(self.backoff_cap_s,
+                                    self.backoff_base_s * (2 ** retries)))
+                    retries += 1
+                    continue
+                # Budget/cap/candidates exhausted: a replica 503 stays
+                # a typed shed; a transport failure surfaces as 502.
+                outcome = "failed" if transport_failure else "shed"
+                seal(outcome, status, rep.name, retries)
+                return status, resp, headers
+
+            headers["X-PBT-Fleet-Replica"] = rep.name
+            if status in SHED_STATUSES:
+                seal("shed", status, rep.name, retries)
+                return status, resp, headers
+            if status == 200:
+                if key is not None:
+                    self.cache.put(key, resp)
+                seal("retried_ok" if retries else "ok", status,
+                     rep.name, retries)
+                return status, resp, headers
+            # Replica answered with a non-retryable error (400/404/500):
+            # pass it through, sealed as failed.
+            seal("failed", status, rep.name, retries)
+            return status, resp, headers
+
+    # ------------------------------------------------------------- stats
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            out = {
+                "accepted": self.accepted_total,
+                "sealed": self.sealed_total,
+                "outcomes": dict(self.outcomes),
+                "retries_spent": self.retries_spent,
+                "replicas": [r.status() for r in self.replicas],
+            }
+        out["cache"] = self.cache.stats()
+        return out
+
+
+# ------------------------------------------------------------ HTTP front
+
+def make_fleet_handler(router: FleetRouter):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+        # Socket read timeout: bounds how long an idle keep-alive
+        # connection holds its handler thread, which in turn bounds how
+        # long server_close() blocks joining handlers at drain (the
+        # front runs NON-daemon threads so in-flight requests seal
+        # BEFORE fleet_end — make_fleet_http_server).
+        timeout = 10
+
+        def log_message(self, fmt, *args):  # telemetry covers it
+            pass
+
+        def _reply(self, status: int, payload,
+                   extra: Optional[Dict[str, str]] = None) -> None:
+            body = (payload if isinstance(payload, bytes)
+                    else json.dumps(payload).encode())
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in (extra or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _read_body(self) -> bytes:
+            length = int(self.headers.get("Content-Length", 0))
+            if not 0 <= length <= _MAX_BODY:
+                raise ValueError(f"bad Content-Length {length}")
+            return self.rfile.read(length)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                reps = router.replica_status()
+                ok = any(r["state"] in ("up", "degraded") for r in reps)
+                self._reply(200 if ok else 503,
+                            {"ok": ok, "role": "fleet-router",
+                             "replicas": reps})
+            elif self.path == "/fleet/status":
+                self._reply(200, {"replicas": router.replica_status(),
+                                  "stats": router.stats()})
+            elif self.path == "/metrics":
+                text = router.tele.metrics.prometheus_text() \
+                    if getattr(router.tele, "metrics", None) is not None \
+                    else ""
+                body = text.encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            else:
+                self._reply(404, {"error": f"no such route {self.path}"})
+
+        def _control(self, raw: bytes, drain: bool) -> None:
+            try:
+                body = json.loads(raw)
+            except ValueError as e:
+                self._reply(400, {"error": f"bad request: {e}",
+                                  "type": "bad_request"})
+                return
+            name = body.get("replica") if isinstance(body, dict) else None
+            if not isinstance(name, str):
+                self._reply(400, {"error": "'replica' must be a string",
+                                  "type": "bad_request"})
+                return
+            try:
+                if drain:
+                    router.drain_replica(name)
+                else:
+                    router.admit_replica(name)
+            except KeyError as e:
+                self._reply(404, {"error": str(e),
+                                  "type": "unknown_replica"})
+            else:
+                self._reply(200, {"ok": True,
+                                  "replicas": router.replica_status()})
+
+        def do_POST(self):
+            # Read the body BEFORE any reply: this handler speaks
+            # HTTP/1.1 keep-alive, and answering an unknown route or a
+            # bad request while the body bytes sit unread on the socket
+            # desyncs the connection — the NEXT request would be parsed
+            # starting at the leftover bytes.
+            try:
+                raw = self._read_body()
+            except ValueError as e:
+                self.close_connection = True  # body left unread
+                self._reply(400, {"error": f"bad request: {e}",
+                                  "type": "bad_request"})
+                return
+            if self.path == "/fleet/drain":
+                self._control(raw, drain=True)
+                return
+            if self.path == "/fleet/admit":
+                self._control(raw, drain=False)
+                return
+            if self.path not in ROUTE_KINDS:
+                self._reply(404, {"error": f"no such route {self.path}"})
+                return
+            status, body, extra = router.route(self.path, raw)
+            self._reply(status, body, extra)
+
+    return Handler
+
+
+def make_fleet_http_server(router: FleetRouter, host: str = "127.0.0.1",
+                           port: int = 0) -> ThreadingHTTPServer:
+    """Bind the router's HTTP front (port 0 = ephemeral; read
+    `.server_address[1]`); callers run `.serve_forever()` and own
+    shutdown ordering (httpd.shutdown() + server_close() BEFORE
+    router.drain()).
+
+    Handler threads are NON-daemon with block_on_close: server_close()
+    joins every in-flight handler, so a request mid-route() seals
+    BEFORE router.drain() emits the terminal fleet_end stats — daemon
+    threads (the single-replica endpoint's choice) would let a seal
+    land after the terminal record and make accepted != sealed flicker
+    at shutdown. The Handler's socket timeout bounds the join: an idle
+    keep-alive connection releases its thread within `timeout` s."""
+    httpd = ThreadingHTTPServer((host, port), make_fleet_handler(router))
+    httpd.daemon_threads = False
+    httpd.block_on_close = True
+    return httpd

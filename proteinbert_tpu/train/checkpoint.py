@@ -45,6 +45,10 @@ class Checkpointer:
         # Telemetry.emit("ckpt_stage", ...); errors in the hook are
         # logged, never allowed to fail a save.
         self.on_event = None
+        # Optional restore-side hook: callable(**fields), pointed at
+        # Telemetry.emit("note", ...) — reports a torn-final-checkpoint
+        # fallback (restore() docstring); errors logged, never raised.
+        self.on_note = None
         # Staged (overlapped) save slot: at most ONE in flight — the
         # double-buffer is {the device-side snapshot} + {the host copy
         # the stager fetches into}; a second boundary arriving while a
@@ -196,29 +200,81 @@ class Checkpointer:
     def all_steps(self):
         return list(self._mngr.all_steps())
 
-    def restore(self, state_like: Any, step: Optional[int] = None):
+    def restore(self, state_like: Any, step: Optional[int] = None,
+                fallback: bool = True):
         """Restore (state, data_state) at `step` (default: latest).
 
         `state_like` is a concrete or abstract TrainState pytree used as
         the restore target — its shardings tell orbax where each shard
-        goes (single-host or multi-host).
+        goes (single-host, multi-host, or an entirely DIFFERENT mesh
+        layout than the one that wrote the checkpoint: orbax reshards
+        from disk against the template's shardings, which is the restore
+        half of mesh-agnostic resharding, parallel/reshard.py).
+
+        Torn-tail tolerance (`fallback=True`, default, applies only when
+        `step` is None): when the NEWEST checkpoint is torn or missing —
+        a crash mid-write of the final step, the read-side mirror of the
+        write-side torn-snapshot guarantees — restore falls back to the
+        previous retained step instead of raising, reporting the skip
+        through `on_note` (wired to a `note` telemetry event by the
+        trainer/CLI). Exactly ONE step is ever skipped: a crash can
+        tear at most the in-flight write, so a failure at the fallback
+        step too is a REAL error (wrong restore template, corrupted
+        store) and raises as itself instead of being smeared into more
+        "torn checkpoint" notes. An explicitly requested `step` stays
+        strict, and a single-step directory re-raises the original
+        error.
         """
-        if step is None:
-            step = self.latest_step()
-        if step is None:
+        explicit = step is not None
+        steps = ([step] if explicit
+                 else sorted(self.all_steps(), reverse=True))
+        if not steps:
             return None, None
         abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, state_like)
-        args = {"state": ocp.args.StandardRestore(abstract)}
-        # 'data' is optional at save time; requesting an absent item raises.
-        if "data" in (self._mngr.item_metadata(step) or {}):
-            args["data"] = ocp.args.JsonRestore()
-        restored = self._mngr.restore(step, args=ocp.args.Composite(**args))
         # Donation-safety canonicalization — never return orbax's
         # arrays directly (copy_pytree's docstring has the jax-0.4.37
         # warm-cache segfault repro this guards against).
         from proteinbert_tpu.train.train_state import copy_pytree
 
-        return copy_pytree(restored["state"]), restored.get("data")
+        for i, s in enumerate(steps):
+            try:
+                args = {"state": ocp.args.StandardRestore(abstract)}
+                # 'data' is optional at save time; requesting an absent
+                # item raises.
+                if "data" in (self._mngr.item_metadata(s) or {}):
+                    args["data"] = ocp.args.JsonRestore()
+                restored = self._mngr.restore(
+                    s, args=ocp.args.Composite(**args))
+                return copy_pytree(restored["state"]), restored.get("data")
+            except (FileNotFoundError, ValueError, KeyError,
+                    TypeError) as exc:
+                # The types orbax surfaces a torn step dir as, depending
+                # on which file is missing — and ONLY those: a transient
+                # failure restoring an intact step (device OOM, a flaky
+                # filesystem read) must raise, not silently roll the run
+                # back a checkpoint interval.
+                if explicit or not fallback or i > 0 or len(steps) == 1:
+                    raise
+                logger.warning(
+                    "checkpoint at step %d in %s is unreadable (%s: %s) "
+                    "— falling back to the previous retained step",
+                    s, self.directory, type(exc).__name__, exc)
+                self._note_restore_fallback(s, exc)
+        raise AssertionError("unreachable: the loop returns or raises")
+
+    def _note_restore_fallback(self, bad_step: int, exc: Exception) -> None:
+        """Report one skipped-torn-step event through `on_note`
+        (callable(**fields) — the trainer/CLI points it at
+        Telemetry.emit('note', ...)); never allowed to fail a restore."""
+        cb = getattr(self, "on_note", None)
+        if cb is None:
+            return
+        try:
+            cb(source="checkpoint", kind="restore_fallback",
+               bad_step=int(bad_step), error=f"{type(exc).__name__}: {exc}")
+        except Exception:
+            logger.exception("checkpoint on_note hook failed — restore "
+                             "path unaffected")
 
     def latest_step(self) -> Optional[int]:
         return self._mngr.latest_step()

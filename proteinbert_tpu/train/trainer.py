@@ -157,6 +157,11 @@ def pretrain(
             state = shard_train_state(state, mesh,
                                       zero_update=cfg.parallel.zero_update)
         if checkpointer is not None and checkpointer.latest_step() is not None:
+            if tele.enabled:
+                # A torn final checkpoint salvages to the previous step
+                # with a note event (restore() docstring) — wired BEFORE
+                # the restore so the fallback is on the run's record.
+                checkpointer.on_note = lambda **f: tele.emit("note", **f)
             state, data_state = checkpointer.restore(state)
             batches_consumed = int((data_state or {}).get("batches_consumed", 0))
             es = (data_state or {}).get("eval_stream") or {}

@@ -91,3 +91,24 @@ def shard_map(f, mesh, in_specs, out_specs, check_vma=None):
 
         kw = {} if check_vma is None else {"check_rep": check_vma}
     return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
+
+
+def configure_compile_cache(directory: str) -> None:
+    """Arm the persistent XLA compilation cache rooted at `directory`
+    (`pbt serve --compile-cache-dir`, fleet replicas): restarted or
+    newly spawned replicas deserialize their warm executables instead
+    of re-paying the per-kind compile, so a replacement replica boots
+    in cache-load time, not warmup time (the saving is visible in the
+    `serve_warmup_seconds_total` gauge across boots —
+    tests/test_fleet.py asserts the second boot is faster).
+
+    Min-compile-time is forced to 0 so EVERY serve executable caches
+    (serving shapes are small; the default threshold would skip them).
+    Must run before the first compile of the process — the CLI calls it
+    before the trunk loads."""
+    import jax
+
+    directory = os.path.abspath(directory)
+    os.makedirs(directory, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", directory)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)

@@ -569,12 +569,81 @@ class TestFusedPathCounter:
         assert delta.get(("reference", "segments"), 0) == 0
 
 
-class TestRaggedDispatcherContracts:
-    def test_mesh_rejected_with_clear_error(self, trunk):
+class TestRaggedMesh:
+    """PR 8 residual closed (ISSUE 11 satellite): ragged packed batches
+    shard over the mesh batch dim via serve_batch_sharding — parity
+    against the unsharded ragged dispatcher within the jitted ≤1e-5
+    tolerance, and indivisible row counts still rejected clearly."""
+
+    def test_ragged_mesh_parity_vs_unsharded(self, trunk, seqs):
+        from proteinbert_tpu.parallel import mesh_for_devices
+
+        mesh = mesh_for_devices(2)
+        b, _ = _serve(trunk, "ragged", "embed", seqs)
+        r, rs = _serve(trunk, "ragged", "embed", seqs, mesh=mesh)
+        for x, y in zip(b, r):
+            np.testing.assert_allclose(x["global"], y["global"],
+                                       atol=1e-5, rtol=1e-5)
+            np.testing.assert_allclose(x["local_mean"], y["local_mean"],
+                                       atol=1e-5, rtol=1e-5)
+        assert rs["executables"] == 1  # sharding adds no executables
+
+    def test_ragged_mesh_sharded_placement(self, trunk):
+        from proteinbert_tpu.parallel import mesh_for_devices
+
         params, cfg = trunk
-        mesh = object()
-        with pytest.raises(ValueError, match="ragged serving"):
-            RaggedDispatcher(params, cfg, mesh=mesh)
+        mesh = mesh_for_devices(2)
+        d = RaggedDispatcher(params, cfg, rows_per_batch=2, mesh=mesh)
+        assert d._shardings is not None
+        assert set(d._shardings) >= {"tokens", "segment_ids",
+                                     "annotations"}
+        tokens, seg, ann, _ = d._dummy_packed()
+        tb, sb, ab = d._place_packed(tokens, seg, ann)
+        for arr in (tb, sb, ab):
+            assert len(arr.sharding.device_set) == 2
+
+    def test_ragged_mesh_indivisible_rows_rejected(self, trunk):
+        from proteinbert_tpu.parallel import mesh_for_devices
+
+        params, cfg = trunk
+        mesh = mesh_for_devices(2)
+        with pytest.raises(ValueError, match="not divisible"):
+            RaggedDispatcher(params, cfg, rows_per_batch=3, mesh=mesh)
+
+    def test_mesh_serves_committed_params(self, trunk):
+        """Regression: orbax-restored trunks arrive COMMITTED to one
+        device, and a jitted call mixing them with batch-dim-sharded
+        inputs is an 'incompatible devices' error — the dispatcher must
+        replicate the trunk over the mesh (both modes; fresh
+        uncommitted test params used to mask this)."""
+        from proteinbert_tpu.parallel import mesh_for_devices
+        from proteinbert_tpu.serve import BucketDispatcher
+
+        params, cfg = trunk
+        committed = jax.device_put(params, jax.devices()[0])
+        mesh = mesh_for_devices(2)
+        d = RaggedDispatcher(committed, cfg, rows_per_batch=2, mesh=mesh)
+        tokens, seg, ann, riders = d._dummy_packed()
+        out = d.run_packed("embed", tokens, seg, ann, riders)
+        assert out[0]["global"].shape == (cfg.model.global_dim,)
+        b = BucketDispatcher(committed, cfg, max_batch=2, mesh=mesh)
+        res = b.run("embed", np.zeros((2, BUCKETS[0]), np.int32))
+        assert res["global"].shape == (2, cfg.model.global_dim)
+        # Registry-loaded HEADS arrive committed too — add_head must
+        # replicate them the same way (predict_task tails mix head
+        # params with mesh-sharded trunk outputs).
+        task = TaskConfig(kind="sequence_classification", num_outputs=3)
+        hp = jax.device_put(
+            ft_model.head_init(jax.random.PRNGKey(5), MODEL, task),
+            jax.devices()[0])
+        b.add_head(LoadedHead("hx", "hx", task, hp, {}))
+        rows = np.zeros((2, BUCKETS[0]), np.int32)
+        outs = b.run("predict_task", rows,
+                     heads=[b.get_head("hx")] * 2)
+        assert outs[0].shape == (3,)
+
+
+class TestRaggedDispatcherContracts:
 
     def test_bucketed_api_refuses_packed_dispatcher(self, trunk):
         params, cfg = trunk

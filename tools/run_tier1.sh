@@ -101,6 +101,29 @@ timeout -k 10 420 env JAX_PLATFORMS=cpu \
 rcf=$?
 [ "$rc" -eq 0 ] && rc=$rcf
 
+# Reshard smoke (ISSUE 11): save a tiny ZeRO-1 train state on a 4x2
+# CPU-virtual mesh, reshard 4x2 -> 8x1 -> 1 -> 4x2 through the real
+# reshard verb. GATED: byte-identical round-trip parity (params + Adam
+# moments), collective wire bytes counted on the same-device-set leg
+# (honest host_staged on the cross-set legs), schema-valid `reshard`
+# events.
+echo "=== reshard smoke (mesh-agnostic checkpoint resharding, CPU) ==="
+timeout -k 10 300 python "$(dirname "$0")/reshard_smoke.py"
+rcre=$?
+[ "$rc" -eq 0 ] && rc=$rcre
+
+# Fleet drill smoke (ISSUE 11): 3 in-process serve replicas behind the
+# FleetRouter, one KILLED mid-request under concurrent load (latency
+# spike first so requests are genuinely in flight), torn health on
+# another. GATED: every accepted request seals exactly once (served or
+# typed-rejected, none lost), failover observed (retried_ok >= 1, dead
+# + re-admitted on the record), router/replica events schema-valid.
+echo "=== fleet drill smoke (kill one of three replicas under load) ==="
+timeout -k 10 420 python "$(dirname "$0")/fleet_drill.py" --json \
+  --replicas 3 --requests 48 --clients 8
+rcfd=$?
+[ "$rc" -eq 0 ] && rc=$rcfd
+
 # Multi-tenant heads smoke (ISSUE 8 satellite): the platform loop end
 # to end — tiny finetune → register into a head registry → serve one
 # mixed-head micro-batch through the shared trunk → downstream eval.

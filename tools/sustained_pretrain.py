@@ -156,7 +156,12 @@ def main():
     proc, logf = launch(cmd, log_path)
     killed_at = None
     while proc.poll() is None:
-        time.sleep(2)
+        # Tight poll: with a warm persistent compile cache the mini run
+        # crosses kill_at → completion in well under a second, and a
+        # coarse (2 s) poll then lands the SIGTERM in interpreter
+        # teardown — AFTER GracefulShutdown restored default handlers —
+        # killing the drill with rc -15 instead of drilling anything.
+        time.sleep(0.1)
         step = last_step(jsonl)
         if step >= S["kill_at"]:
             print(f"[drill] step {step} >= {S['kill_at']}: SIGTERM",

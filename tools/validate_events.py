@@ -92,6 +92,31 @@ def self_test() -> int:
         {"v": 1, "event": "serve_request", "seq": 0, "t": 0.0,
          "kind": "embed", "outcome": "ok", "request_id": "r1",
          "stages": {}, "mode": "packed"},  # not a serve mode
+        # elastic topology (ISSUE 11): reshard + fleet events.
+        {"v": 1, "event": "reshard", "seq": 0, "t": 0.0,
+         "step": 1, "target_mesh": {"data": 4}},  # missing wire_bytes
+        {"v": 1, "event": "reshard", "seq": 0, "t": 0.0,
+         "step": 1, "target_mesh": {"data": 4},
+         "wire_bytes": {"total": -8}},  # bytes must be >= 0
+        {"v": 1, "event": "reshard", "seq": 0, "t": 0.0,
+         "step": 1, "target_mesh": {"data": 4},
+         "wire_bytes": {"total": 1.5}},  # bytes are ints, not floats
+        {"v": 1, "event": "fleet_replica", "seq": 0, "t": 0.0,
+         "replica": "r0", "state": "limping"},  # unknown state
+        {"v": 1, "event": "fleet_request", "seq": 0, "t": 0.0,
+         "outcome": "vanished", "path": "/v1/embed"},  # unknown outcome
+        {"v": 1, "event": "fleet_request", "seq": 0, "t": 0.0,
+         "outcome": "ok", "path": "/v1/embed",
+         "retries": -1},  # retries must be >= 0
+        {"v": 1, "event": "fleet_request", "seq": 0, "t": 0.0,
+         "outcome": "ok", "path": "/v1/embed",
+         "status": 42},  # not an HTTP status code
+        {"v": 1, "event": "fleet_request", "seq": 0, "t": 0.0,
+         "outcome": "ok"},  # missing path
+        {"v": 1, "event": "fleet_end", "seq": 0, "t": 0.0,
+         "outcome": "collapsed", "stats": {}},  # outcome is drained|aborted
+        {"v": 1, "event": "fleet_start", "seq": 0, "t": 0.0,
+         "config": {}},  # missing pid
     ]
     for rec in bad:
         try:

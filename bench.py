@@ -1248,6 +1248,145 @@ def _mirror_ragged_note(record):
         print(f"bench events stream unavailable: {e}", file=sys.stderr)
 
 
+def _serve_quant_ab(Server, params, cfg, seqs, max_batch, max_wait_s,
+                    n_clients, failures):
+    """Phase 5 (ISSUE 12): the SAME request population through a fp32
+    bucketed server and a quant=int8 server (weight-only int8
+    executables, fp32 parity shadow sampling EVERY batch so the live
+    `serve_quant_parity_max` machinery is exercised end to end).
+
+    GATED: every request served on both arms; per-request output
+    deviation between the arms within PBT_SERVE_BENCH_QUANT_TOL
+    (default 0.15 — weight quantization is a lossy compression, so
+    the gate is the documented bound, not the jitted 1e-5); the
+    dispatcher's own sampled parity agrees with the externally
+    measured one; the quantized trunk's resident weight bytes <= 0.40x
+    fp32 (the HBM-footprint claim at these tiny dims; large dims do
+    better). REPORTED: per-arm throughput and warmup — wall-clock on a
+    shared box is evidence, not a gate."""
+    import threading
+
+    from proteinbert_tpu.obs import Telemetry
+
+    rounds = int(os.environ.get("PBT_SERVE_BENCH_QUANT_ROUNDS", 2))
+    tol = float(os.environ.get("PBT_SERVE_BENCH_QUANT_TOL", 0.15))
+    arms = {}
+    outputs = {}
+    for arm in ("fp32", "int8"):
+        kw = ({"quant": "int8", "quant_parity_every": 1}
+              if arm == "int8" else {})
+        srv = Server(params, cfg, max_batch=max_batch,
+                     max_wait_s=max_wait_s, queue_depth=4 * len(seqs),
+                     cache_size=0, warm_kinds=("embed",),
+                     telemetry=Telemetry(), trace_sample_rate=None,
+                     **kw)
+        t0 = time.perf_counter()
+        srv.start()
+        warm_s = time.perf_counter() - t0
+        results = {}
+
+        def client(worker):
+            for i in range(worker, len(seqs), n_clients):
+                try:
+                    results[i] = srv.embed(seqs[i], timeout=120)
+                except Exception as e:  # noqa: BLE001
+                    failures.append(f"quant A/B ({arm}) request {i}: "
+                                    f"{type(e).__name__}: {e}")
+
+        t0 = time.perf_counter()
+        for _ in range(rounds):
+            threads = [threading.Thread(target=client, args=(w,))
+                       for w in range(n_clients)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        dt = time.perf_counter() - t0
+        if len(results) != len(seqs):
+            failures.append(f"quant A/B ({arm}) lost requests: "
+                            f"{len(results)}/{len(seqs)}")
+        outputs[arm] = results
+        stats = srv.stats()
+        arms[arm] = {
+            "requests_per_sec": round(rounds * len(seqs) / dt, 2),
+            "warmup_s": round(warm_s, 3),
+            "executables": stats["executables"],
+            "quant": stats["quant"],
+        }
+        srv.drain(timeout=60)
+    parity_max = 0.0
+    for i in outputs["fp32"]:
+        if i not in outputs["int8"]:
+            continue
+        for k in outputs["fp32"][i]:
+            parity_max = max(parity_max, float(np.max(np.abs(
+                outputs["fp32"][i][k] - outputs["int8"][i][k]))))
+    if parity_max > tol:
+        failures.append(f"quant arm drifted past the documented bound: "
+                        f"per-request parity max {parity_max:.5f} > "
+                        f"{tol}")
+    q = arms["int8"]["quant"] or {}
+    sampled_max = q.get("parity_max", 0.0)
+    if not q.get("parity_samples"):
+        failures.append("quantized arm recorded no live parity samples "
+                        "(quant_parity_every machinery broken)")
+    elif sampled_max > tol:
+        failures.append(f"dispatcher-sampled quant parity "
+                        f"{sampled_max:.5f} > {tol}")
+    elif abs(sampled_max - parity_max) > 0.25 * max(parity_max, 1e-6) \
+            + 1e-4:
+        # The AGREEMENT gate: with parity_every=1 every live batch is
+        # shadowed, so the dispatcher's own max over requests must
+        # track the externally measured cross-server max (slack covers
+        # jitted shape-dependent reassociation between the two servers'
+        # batch formations). A shadow that measures nothing (e.g.
+        # comparing an arm against itself → 0.0) fails HERE instead of
+        # passing both independent bounds.
+        failures.append(
+            f"dispatcher-sampled parity {sampled_max:.6f} does not "
+            f"track the externally measured {parity_max:.6f} — the "
+            f"live parity shadow is not measuring real deviation")
+    ratio = q.get("weight_bytes_ratio", 1.0)
+    if ratio > 0.40:
+        failures.append(f"quantized trunk weight bytes ratio {ratio} "
+                        "> 0.40x fp32 — the HBM-footprint claim broke")
+    return {
+        "fp32": arms["fp32"],
+        "int8": arms["int8"],
+        "quant_speedup_x": round(
+            arms["int8"]["requests_per_sec"]
+            / max(arms["fp32"]["requests_per_sec"], 1e-9), 3),
+        "parity": {"max_abs": round(parity_max, 9), "tolerance": tol,
+                   "sampled": q.get("parity_samples", 0),
+                   "sampled_max": q.get("parity_max")},
+        "weight_bytes_ratio": ratio,
+    }
+
+
+def _mirror_quant_note(record):
+    """Best-effort mirror of the quantized-arm A/B capture onto the
+    shared bench event stream (the sentinel fits
+    serve_quant_requests_per_sec / serve_quant_parity_max from it)."""
+    try:
+        from proteinbert_tpu.obs.events import EventLog
+
+        ab = record["quant_ab"]
+        ev = EventLog(os.path.join(os.path.dirname(LAST_GOOD_PATH),
+                                   "bench_events.jsonl"))
+        ev.emit("note", source="bench", kind="serve_quant_capture",
+                platform=record["platform"], seq_len=record["seq_len"],
+                n_requests=record["n_requests"],
+                quant_requests_per_sec=ab["int8"]["requests_per_sec"],
+                fp32_requests_per_sec=ab["fp32"]["requests_per_sec"],
+                quant_speedup_x=ab["quant_speedup_x"],
+                parity_max=ab["parity"]["max_abs"],
+                weight_bytes_ratio=ab["weight_bytes_ratio"],
+                failures=len(record["failures"]))
+        ev.close()
+    except Exception as e:
+        print(f"bench events stream unavailable: {e}", file=sys.stderr)
+
+
 def run_serve(length_mix=None):
     """`bench.py --serve`: sustained-load online serving vs the
     one-request-at-a-time offline baseline — one JSON line, CPU-
@@ -1328,12 +1467,12 @@ def run_serve(length_mix=None):
     from proteinbert_tpu.train import create_train_state
 
     phases_env = os.environ.get("PBT_SERVE_BENCH_PHASES", "all").strip()
-    wanted = ({"core", "ragged"} if phases_env == "all"
+    wanted = ({"core", "ragged", "quant"} if phases_env == "all"
               else {p for p in phases_env.split(",") if p})
-    bad = wanted - {"core", "ragged"}
+    bad = wanted - {"core", "ragged", "quant"}
     if bad or not wanted:
         raise SystemExit(f"PBT_SERVE_BENCH_PHASES must name phases from "
-                         f"core,ragged or 'all'; got {phases_env!r}")
+                         f"core,ragged,quant or 'all'; got {phases_env!r}")
 
     seq_len = int(os.environ.get("PBT_SERVE_BENCH_SEQ_LEN", 512))
     dim = int(os.environ.get("PBT_SERVE_BENCH_DIM", 64))
@@ -1379,22 +1518,29 @@ def run_serve(length_mix=None):
     seqs = ["".join(rng.choice(alphabet, size=int(L))) for L in lengths]
 
     if "core" not in wanted:
-        # Ragged-only run (the tier-1 ragged smoke stage): skip the
-        # baseline/tracing/overflow phases and gate just the ragged
+        # Off-core run (the tier-1 ragged/quant smoke stages): skip the
+        # baseline/tracing/overflow phases and gate just the selected
         # A/B contracts.
         failures = []
-        ragged_ab = _serve_ragged_ab(Server, params, cfg, seqs, max_batch,
-                                     max_wait_s, n_clients, failures)
         record = {
-            "metric": "serve_ragged",
+            "metric": ("serve_ragged" if "ragged" in wanted
+                       else "serve_quant"),
             "platform": jax.devices()[0].platform,
             "seq_len": seq_len, "model_dim": dim, "median_len": median,
             "length_sigma": mix_sigma, "buckets": list(buckets),
             "max_batch": max_batch, "n_requests": n_requests,
-            "ragged_ab": ragged_ab,
             "failures": failures,
         }
-        _mirror_ragged_note(record)
+        if "ragged" in wanted:
+            record["ragged_ab"] = _serve_ragged_ab(
+                Server, params, cfg, seqs, max_batch, max_wait_s,
+                n_clients, failures)
+            _mirror_ragged_note(record)
+        if "quant" in wanted:
+            record["quant_ab"] = _serve_quant_ab(
+                Server, params, cfg, seqs, max_batch, max_wait_s,
+                n_clients, failures)
+            _mirror_quant_note(record)
         print(json.dumps(record))
         if failures:
             for f in failures:
@@ -1713,6 +1859,11 @@ def run_serve(length_mix=None):
                                   max_wait_s, n_clients, failures)
                  if "ragged" in wanted else None)
 
+    # ---- phase 5: quantized executable arm A/B (ISSUE 12) -------------
+    quant_ab = (_serve_quant_ab(Server, params, cfg, seqs, max_batch,
+                                max_wait_s, n_clients, failures)
+                if "quant" in wanted else None)
+
     record = {
         "metric": "serve_load",
         "platform": jax.devices()[0].platform,
@@ -1728,10 +1879,13 @@ def run_serve(length_mix=None):
         "parity_per_bucket": parity,
         "overflow": overflow,
         "ragged_ab": ragged_ab,
+        "quant_ab": quant_ab,
         "failures": failures,
     }
     if ragged_ab is not None:
         _mirror_ragged_note(record)
+    if quant_ab is not None:
+        _mirror_quant_note(record)
     try:  # mirror onto the shared bench event stream (best-effort)
         from proteinbert_tpu.obs.events import EventLog
 
@@ -1886,6 +2040,38 @@ def run_heads():
             head.task.num_outputs, seq_len, 8),
         telemetry=tele)
     eval_score_min = min(m["score"] for m in eval_results.values())
+
+    # ---- phase 2b: downstream eval through the QUANTIZED trunk --------
+    # The int8 serving arm's numerics exactly (ISSUE 12): dequantize∘
+    # quantize is precisely what the quantized executables compute from
+    # their int8 weights, so evaluating the heads on that trunk scores
+    # the quantized arm's downstream quality without spinning a server.
+    # GATED: the worst quantized score must stay within
+    # PBT_HEADS_BENCH_QUANT_SCORE_DELTA (default 0.1) of the fp32
+    # worst — the `heads_eval_score_min` sentinel's green-light for the
+    # quantized arm (ROADMAP item 1 acceptance; the
+    # heads_eval_score_min_quant series tracks it across rounds).
+    from proteinbert_tpu.parallel.quant import (
+        dequantize_params, quantize_params,
+    )
+
+    quant_trunk = dequantize_params(quantize_params(params))
+    eval_results_quant = evaluate_heads(
+        quant_trunk, model, heads,
+        lambda head: make_task_batches(
+            32, np.random.default_rng(99), head.task.kind,
+            head.task.num_outputs, seq_len, 8),
+        telemetry=tele)
+    eval_score_min_quant = min(
+        m["score"] for m in eval_results_quant.values())
+    quant_score_delta = float(os.environ.get(
+        "PBT_HEADS_BENCH_QUANT_SCORE_DELTA", 0.1))
+    if eval_score_min_quant < eval_score_min - quant_score_delta:
+        failures.append(
+            f"quantized-trunk downstream eval degraded past the "
+            f"documented delta: min score {eval_score_min_quant:.4f} "
+            f"vs fp32 {eval_score_min:.4f} "
+            f"(allowed -{quant_score_delta})")
 
     # ---- phase 3: mixed vs head-partitioned serving -------------------
     lengths = np.clip(rng.lognormal(mean=np.log(seq_len // 6), sigma=0.4,
@@ -2084,8 +2270,10 @@ def run_heads():
     if n_reg != len(tasks):
         failures.append(f"expected {len(tasks)} head_registered "
                         f"events, got {n_reg}")
-    if n_ev != len(tasks):
-        failures.append(f"expected {len(tasks)} head_eval events, "
+    # Two eval passes per head: the fp32 harness and the quantized-
+    # trunk arm (phase 2b).
+    if n_ev != 2 * len(tasks):
+        failures.append(f"expected {2 * len(tasks)} head_eval events, "
                         f"got {n_ev}")
 
     record = {
@@ -2097,6 +2285,9 @@ def run_heads():
         "head_ids": head_ids,
         "eval": {h.head_id: eval_results[h.head_id] for h in heads},
         "eval_score_min": round(eval_score_min, 6),
+        "eval_quant": {h.head_id: eval_results_quant[h.head_id]
+                       for h in heads},
+        "eval_score_min_quant": round(eval_score_min_quant, 6),
         "serving": serving,
         "parity": {"rows": len(group), "heads_mixed": heads_in_batch,
                    "bit_identical_vs_sequential": parity_ok,
@@ -2119,6 +2310,7 @@ def run_heads():
                     "partitioned_requests_per_sec"],
                 mixed_speedup_x=serving["mixed_speedup_x"],
                 eval_score_min=record["eval_score_min"],
+                eval_score_min_quant=record["eval_score_min_quant"],
                 failures=len(failures))
         ev.close()
     except Exception as e:
@@ -2167,9 +2359,12 @@ def run_comm():
         PretrainConfig, TrainConfig,
     )
     from proteinbert_tpu.parallel import batch_sharding, make_mesh
+    from proteinbert_tpu.parallel.quant import make_quant_zero_train_step
     from proteinbert_tpu.parallel.sharding import state_sharding
     from proteinbert_tpu.parallel.zero import (
-        collective_bytes_from_hlo, make_zero_train_step, per_chip_state_bytes,
+        collective_bytes_from_hlo, collective_wire_bytes_from_hlo,
+        grad_reduce_wire_bytes, make_zero_train_step,
+        per_chip_state_bytes,
     )
     from proteinbert_tpu.train import create_train_state
     from proteinbert_tpu.train import train_state as ts
@@ -2202,23 +2397,36 @@ def run_comm():
             sharding=bsh["annotations"]),
     }
 
+    # Mode table: replicated (no zero), zero (implicit fp32 reduce-
+    # scatter), zero_rs_fp32 (the EXPLICIT reduce-scatter at fp32
+    # payload — the like-for-like baseline the quantized wire is
+    # measured against: identical program, only the payload dtype
+    # differs), zero_bf16 / zero_int8 (quantized payloads).
+    _GRD = {"zero_bf16": "bf16", "zero_int8": "int8"}
+
     def analyze(mode):
         zero = mode != "replicated"
+        grd = _GRD.get(mode, "fp32")
         cfg = base_cfg.replace(parallel=ParallelConfig(
-            zero_update=zero,
-            grad_reduce_dtype="bf16" if mode == "zero_bf16" else "fp32"))
+            zero_update=zero, grad_reduce_dtype=grd))
         sh = state_sharding(mesh, abstract, zero_update=zero)
         st = jax.tree.map(
             lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
             abstract, sh)
-        if zero:
+        if mode == "zero_rs_fp32":
+            step = make_quant_zero_train_step(mesh, cfg, payload="fp32")
+            lowered = step.lower(st, batch_abs)
+        elif zero:
             lowered = make_zero_train_step(mesh, cfg).lower(st, batch_abs)
         else:
             lowered = ts.train_step.lower(st, batch_abs, cfg)
         compiled = lowered.compile()
+        hlo = compiled.as_text()
+        wire = collective_wire_bytes_from_hlo(hlo, n_devices)
         row = {"mode": mode,
-               "collective_bytes": collective_bytes_from_hlo(
-                   compiled.as_text()),
+               "collective_bytes": collective_bytes_from_hlo(hlo),
+               "wire_bytes": wire,
+               "grad_reduce_wire_bytes": grad_reduce_wire_bytes(wire),
                "state_bytes_per_chip": per_chip_state_bytes(
                    mesh, abstract, zero_update=zero)}
         try:  # not every backend reports memory stats
@@ -2232,8 +2440,20 @@ def run_comm():
             row["hbm"] = None
         return row
 
-    rows = [analyze(m) for m in ("replicated", "zero", "zero_bf16")]
-    rep, zero = rows[0], rows[1]
+    modes = ("replicated", "zero", "zero_rs_fp32", "zero_bf16",
+             "zero_int8")
+    rows = [analyze(m) for m in modes]
+    by_mode = {r["mode"]: r for r in rows}
+    rep, zero = by_mode["replicated"], by_mode["zero"]
+    # The quantization ratios compare the SAME explicit reduce-scatter
+    # program at int8/bf16 payload vs fp32 payload — wire bytes of the
+    # gradient-reduction collectives, counted from compiled HLO
+    # (outputs + replica_groups), never inferred from source dtypes.
+    fp32_rs = max(by_mode["zero_rs_fp32"]["grad_reduce_wire_bytes"], 1)
+    int8_ratio = round(
+        by_mode["zero_int8"]["grad_reduce_wire_bytes"] / fp32_rs, 4)
+    bf16_ratio = round(
+        by_mode["zero_bf16"]["grad_reduce_wire_bytes"] / fp32_rs, 4)
     record = {
         "metric": "zero_update_comm",
         "platform": "cpu-virtual",
@@ -2246,8 +2466,34 @@ def run_comm():
         "collective_bytes_ratio": round(
             zero["collective_bytes"]["total"]
             / max(rep["collective_bytes"]["total"], 1), 3),
+        "int8_grad_wire_ratio": int8_ratio,
+        "bf16_grad_wire_ratio": bf16_ratio,
     }
+    try:  # mirror onto the shared bench event stream (best-effort)
+        from proteinbert_tpu.obs.events import EventLog
+
+        ev = EventLog(os.path.join(os.path.dirname(LAST_GOOD_PATH),
+                                   "bench_events.jsonl"))
+        ev.emit("note", source="bench", kind="comm_quant",
+                platform=record["platform"], model_dim=dim,
+                mesh=record["mesh"],
+                int8_grad_wire_ratio=int8_ratio,
+                bf16_grad_wire_ratio=bf16_ratio,
+                int8_grad_wire_bytes=by_mode["zero_int8"][
+                    "grad_reduce_wire_bytes"],
+                fp32_grad_wire_bytes=fp32_rs)
+        ev.close()
+    except Exception as e:
+        print(f"bench events stream unavailable: {e}", file=sys.stderr)
     print(json.dumps(record))
+    # GATED (ROADMAP item 1 acceptance): the int8 reduce-scatter must
+    # move <= 0.30x the fp32 wire bytes. bf16 is reported, not gated
+    # (its ~0.5x is arithmetic, but the gate names int8).
+    if int8_ratio > 0.30:
+        print(f"COMM QUANT FAILURE: int8 grad-reduction wire ratio "
+              f"{int8_ratio} > 0.30 vs the fp32 reduce-scatter",
+              file=sys.stderr)
+        sys.exit(1)
 
 
 def variant_matches(pat, variant):

@@ -1108,6 +1108,18 @@ def cmd_serve(args) -> int:
 
     params, cfg = _load_inference_trunk(args)
 
+    # Resolve the effective quant arm (flag > run config) up front so
+    # an impossible combination is a clean operator-facing exit, not a
+    # construction traceback from deep inside the dispatcher.
+    effective_quant = args.quant or getattr(
+        getattr(cfg, "serve", None), "quant", "fp32")
+    if args.serve_mode == "ragged" and effective_quant == "int8_act":
+        raise SystemExit(
+            "--quant int8_act is a bucketed-mode option: the packed "
+            "executables have no activation fake-quant variant — use "
+            "--quant int8 for weight-only quantized ragged serving "
+            "(docs/serving.md, int8 arm)")
+
     mesh = None
     if args.mesh:
         from proteinbert_tpu.parallel import make_mesh
@@ -1195,7 +1207,18 @@ def cmd_serve(args) -> int:
         heads=head_ids,
         serve_mode=args.serve_mode,
         pack_max_segments=args.pack_max_segments,
+        quant=args.quant,
+        quant_parity_every=args.quant_parity_every,
     )
+    if server.quant != "fp32":
+        qr = server.dispatcher.quant_report
+        log(f"quantized executable arm: {server.quant} — trunk weights "
+            f"{qr['weight_bytes_quant']} bytes vs "
+            f"{qr['weight_bytes_fp32']} fp32 "
+            f"({qr['weight_bytes_ratio']:.2f}x)"
+            + (f", fp32 parity shadow every "
+               f"{server.dispatcher.quant_parity_every} batch(es)"
+               if server.dispatcher.quant_parity_every else ""))
     if head_ids:
         # Trunk-compat was enforced per head at load (TrunkMismatchError
         # would have exited above); one micro-batch now mixes requests
@@ -1835,6 +1858,24 @@ def build_parser() -> argparse.ArgumentParser:
                          "or 'all' (default: all); requires --registry. "
                          "Heads can also be added/removed live via "
                          "POST /v1/heads/{add,remove}")
+    sv.add_argument("--quant", default=None,
+                    choices=["fp32", "int8", "int8_act"],
+                    help="executable arm (docs/serving.md, int8 arm): "
+                         "int8 = symmetric per-channel int8 WEIGHTS, "
+                         "dequantized in-executable (~4x smaller "
+                         "resident trunk); int8_act adds dynamic int8 "
+                         "fake-quant of the trunk's output activations "
+                         "(bucketed mode only). Default: the run "
+                         "config's serve.quant (fp32 unless set)")
+    sv.add_argument("--quant-parity-every", type=int, default=None,
+                    metavar="N",
+                    help="with a quantized arm: every Nth batch also "
+                         "runs the fp32 executables and records the "
+                         "worst per-request deviation "
+                         "(serve_quant_parity_max gauge, "
+                         "stats()['quant'], serve_batch events). "
+                         "0 disables. Default: the run config's "
+                         "serve.quant_parity_every")
     sv.set_defaults(fn=cmd_serve)
 
     rs = sub.add_parser("reshard",

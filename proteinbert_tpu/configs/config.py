@@ -200,24 +200,54 @@ class ParallelConfig:
       parallel/sharding.py (zero-aware state_sharding); the update
       itself in parallel/zero.py. No-op without a mesh or when
       data*fsdp == 1.
-    grad_reduce_dtype: dtype the gradient tree is ROUNDED to at the
-      zero-update boundary — "fp32" (exact) or "bf16" (the numerics of
-      an EQuARX-style compressed reduction, arXiv:2506.17615: the
-      optimizer math runs fp32 on bf16-rounded gradients; the clip norm
-      is measured pre-rounding; measured bound in tests/test_zero.py).
-      IMPORTANT: under the implicit-SPMD step the cast is applied to
-      the ALREADY-REDUCED logical gradients — no compiler may hoist it
-      ahead of the fp32 reduction — so today this knob changes numerics
-      only, NOT wire bytes (bench.py --comm records identical
-      collective bytes; docs/distributed.md). True on-the-wire
-      compression needs the reduction to consume per-replica bf16
-      partials, i.e. grads computed inside the shard_map — a future
-      step once a pure-DP explicit path exists. Only consulted by the
-      zero_update path.
+    grad_reduce_dtype: payload dtype of the ZeRO-1 gradient reduction
+      — "fp32" (exact, the implicit-SPMD reduce-scatter), or "bf16" /
+      "int8": the QUANTIZED reduce-scatter (parallel/quant.py,
+      EQuARX-style, arXiv:2506.17615). The quantized step computes
+      per-replica partial gradients inside an explicit data-parallel
+      shard_map and reduces them over quantized payloads — bf16
+      (stochastic rounding, 2x fewer wire bytes) or int8 (per-chunk
+      symmetric scale + stochastic rounding seeded from the step key:
+      deterministic and multi-host lockstep, ~4x fewer wire bytes) —
+      with the optimizer math fp32 on the dequantized shards and the
+      clip norm measured on the dequantized sum. Wire bytes are
+      verified from compiled HLO (bench.py --comm,
+      zero.collective_wire_bytes_from_hlo); parity bounds are measured
+      in tests/test_quant.py and documented in docs/distributed.md.
+      Quantized payloads need a data/fsdp-only mesh (model>1 or seq>1
+      raises the typed QuantConfigError — the explicit replica
+      shard_map cannot shard those axes), a global batch divisible by
+      data*fsdp, and are rejected by the explicit seq-parallel Pallas
+      step (int8; its bf16 stays the PR-2 cast-only numerics-only
+      reduction). Only consulted by the zero_update path.
     """
 
     zero_update: bool = False
-    grad_reduce_dtype: str = "fp32"         # "fp32" | "bf16"
+    grad_reduce_dtype: str = "fp32"         # "fp32" | "bf16" | "int8"
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Online-serving knobs that belong to the MODEL's run config (the
+    CLI owns transport knobs like ports and queue depths; these ride
+    config.json so `pbt serve --pretrained RUN_DIR` picks them up).
+
+    quant: which executable arm the dispatcher builds (parallel/
+      quant.py) — "fp32" (ordinary), "int8" (symmetric per-channel
+      int8 WEIGHTS quantized at load time, dequantized inside the
+      executable: ~4x smaller resident trunk — the HBM headroom two
+      resident trunks need), or "int8_act" (int8 weights + opt-in
+      dynamic int8 fake-quant of the trunk's output activations).
+      Overridable per serve process via `pbt serve --quant`.
+    quant_parity_every: with a quantized arm, every Nth dispatched
+      batch ALSO runs the fp32 executables on the same inputs and
+      records the per-request max-abs output deviation
+      (`serve_quant_parity_max` gauge, stats()["quant"], serve_batch
+      events) — live parity evidence at 1/N the cost. 0 disables.
+    """
+
+    quant: str = "fp32"                     # "fp32" | "int8" | "int8_act"
+    quant_parity_every: int = 0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -336,6 +366,7 @@ class PretrainConfig:
     parallel: ParallelConfig = dataclasses.field(default_factory=ParallelConfig)
     checkpoint: CheckpointConfig = dataclasses.field(default_factory=CheckpointConfig)
     train: TrainConfig = dataclasses.field(default_factory=TrainConfig)
+    serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
 
     def replace(self, **kw) -> "PretrainConfig":
         return dataclasses.replace(self, **kw)

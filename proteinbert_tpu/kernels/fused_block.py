@@ -117,12 +117,6 @@ Params = Dict[str, jax.Array]
 PATH_TOTAL: Dict[Tuple[str, str], int] = {}
 _PATH_OBSERVERS: List[Callable[[str, str], None]] = []
 
-# DEPRECATED (kept emitting for one release, docs/observability.md):
-# the pre-ISSUE-10 one-sided mirror — reference-path bumps only, keyed
-# by reason. Consumers should move to PATH_TOTAL /
-# fused_kernel_path_total.
-FALLBACK_TOTAL: Dict[str, int] = {}
-_FALLBACK_OBSERVERS: List[Callable[[str], None]] = []
 # One-time warning bookkeeping, keyed by (reason, call-site shape): a
 # server that builds a reference executable for a NEW shape after a
 # fused one must still warn (a process-wide once latch misled there —
@@ -154,17 +148,6 @@ def unregister_path_observer(cb: Callable[[str, str], None]) -> None:
         _PATH_OBSERVERS.remove(cb)
 
 
-def register_fallback_observer(cb: Callable[[str], None]) -> None:
-    """DEPRECATED: `cb(reason)` fires on reference-path bumps only.
-    Use `register_path_observer` for two-sided coverage."""
-    _FALLBACK_OBSERVERS.append(cb)
-
-
-def unregister_fallback_observer(cb: Callable[[str], None]) -> None:
-    if cb in _FALLBACK_OBSERVERS:
-        _FALLBACK_OBSERVERS.remove(cb)
-
-
 def note_kernel_path(path: str, reason: str,
                      shape: Optional[tuple] = None) -> None:
     """Record one kernel dispatch decision (trace time = once per
@@ -178,9 +161,6 @@ def note_kernel_path(path: str, reason: str,
         cb(path, reason)
     if path != "reference":
         return
-    FALLBACK_TOTAL[reason] = FALLBACK_TOTAL.get(reason, 0) + 1
-    for cb in list(_FALLBACK_OBSERVERS):
-        cb(reason)
     warn_key = (reason, shape)
     if warn_key not in _FALLBACK_WARNED:
         _FALLBACK_WARNED.add(warn_key)
@@ -188,8 +168,7 @@ def note_kernel_path(path: str, reason: str,
             "fused local-track kernel fell back to the XLA reference "
             "path (reason=%s, shape=%s) — this executable runs without "
             "the fused fast path; counted in "
-            "fused_kernel_path_total{path=reference} (and the "
-            "deprecated fused_kernel_fallback_total)", reason, shape)
+            "fused_kernel_path_total{path=reference}", reason, shape)
 
 # Largest feature dim whose weights fit the VMEM budget whole (see
 # module doc); larger dims use the channel-tiled kernel.

@@ -277,8 +277,10 @@ def summarize_serve(records: List[Dict[str, Any]],
     # bucketed |buckets|x|classes|xkinds ladder vs ragged O(kinds)),
     # cumulative warmup seconds, and the two-sided fused-kernel path
     # counts — how many executables ran the Pallas fast path vs the XLA
-    # reference (coverage, not just misses; `fused_fallback` is the
-    # deprecated one-sided view kept for one release).
+    # reference (coverage, not just misses). `fused_fallback` only
+    # appears in HISTORICAL stats snapshots (the deprecated one-sided
+    # counter was removed in ISSUE 12); it is read here so old event
+    # streams still diagnose, never emitted anymore.
     end_stats = (end.get("stats") if end is not None
                  and isinstance(end.get("stats"), dict) else None)
     if end_stats is not None:

@@ -197,6 +197,27 @@ def build_record(event: str, seq: int, t: float,
 
 
 _SERVE_MODES = ("bucketed", "ragged")
+# Quantized serving arms (ISSUE 12, parallel/quant.SERVE_QUANT_MODES;
+# duplicated here because this module must stay stdlib-only). "fp32"
+# is never emitted (the field is absent on the fp32 arm) but accepted.
+_SERVE_QUANT_MODES = ("fp32", "int8", "int8_act")
+
+
+def _validate_quant_fields(event: str, rec: Dict[str, Any]) -> None:
+    """Optional quantized-arm fields shared by serve_batch and
+    serve_request (ISSUE 12): `quant` (which executable arm served)
+    and, on parity-sampled batches, `quant_parity_max` (worst abs
+    deviation vs the fp32 shadow). Typed when present."""
+    q = rec.get("quant")
+    if q is not None and q not in _SERVE_QUANT_MODES:
+        raise ValueError(f"{event}.quant {q!r} not in "
+                         f"{_SERVE_QUANT_MODES}")
+    pm = rec.get("quant_parity_max")
+    if pm is not None and (isinstance(pm, bool)
+                           or not isinstance(pm, (int, float))
+                           or not math.isfinite(pm) or pm < 0):
+        raise ValueError(f"{event}.quant_parity_max must be a "
+                         f"non-negative finite number, got {pm!r}")
 
 
 def _validate_packed_fields(event: str, rec: Dict[str, Any]) -> None:
@@ -282,8 +303,10 @@ def validate_record(rec: Any) -> None:
                     f"serve_batch.{field} must be a non-negative int, "
                     f"got {v!r}")
         _validate_packed_fields(event, rec)
+        _validate_quant_fields(event, rec)
     if event == "serve_request":
         _validate_packed_fields(event, rec)
+        _validate_quant_fields(event, rec)
         if rec["outcome"] not in SERVE_REQUEST_OUTCOMES:
             raise ValueError(f"serve_request.outcome {rec['outcome']!r} "
                              f"not in {SERVE_REQUEST_OUTCOMES}")
@@ -350,6 +373,22 @@ def validate_record(rec: Any) -> None:
     if event == "fleet_end" and rec["outcome"] not in SERVE_OUTCOMES:
         raise ValueError(f"fleet_end.outcome {rec['outcome']!r} not in "
                          f"{SERVE_OUTCOMES}")
+    if event == "note" and rec.get("kind") == "comm_quant":
+        # The quantized-collectives capture (bench.py --comm, ISSUE
+        # 12): its ratio fields are the trajectory-sentinel inputs, so
+        # a writer bug must fail validation, not poison the series.
+        for name in ("int8_grad_wire_ratio", "bf16_grad_wire_ratio"):
+            v = rec.get(name)
+            if name == "int8_grad_wire_ratio" and v is None:
+                raise ValueError(
+                    "note(kind=comm_quant): missing required field "
+                    "'int8_grad_wire_ratio'")
+            if v is not None and (isinstance(v, bool)
+                                  or not isinstance(v, (int, float))
+                                  or not math.isfinite(v) or v <= 0):
+                raise ValueError(
+                    f"note(kind=comm_quant).{name} must be a positive "
+                    f"finite number, got {v!r}")
 
 
 def make_example(event: str) -> Dict[str, Any]:

@@ -216,7 +216,27 @@ def seq_parallel_apply(
 def make_seq_parallel_train_step(mesh: Mesh, cfg: PretrainConfig):
     """Jitted pretraining step whose forward runs seq_parallel_apply —
     drop-in for train_state.train_step when (seq > 1 and use_pallas).
-    Corruption, loss, optimizer update are shared with the default step."""
+    Corruption, loss, optimizer update are shared with the default step.
+
+    grad_reduce_dtype="int8" is REJECTED here (typed QuantConfigError,
+    mirroring the packing rejection below): the quantized reduce-
+    scatter (parallel/quant.py) needs per-replica partial gradients
+    from its own data-parallel shard_map, and this step's hand-written
+    seq shard_map already owns the gradient computation — its grads
+    exit as fully-reduced logical tensors the quantizer cannot
+    compress. "bf16" stays the PR-2 cast-only reduction here
+    (numerics, not wire — docs/distributed.md)."""
+    if cfg.parallel.zero_update and cfg.parallel.grad_reduce_dtype == "int8":
+        from proteinbert_tpu.parallel.quant import QuantConfigError
+
+        raise QuantConfigError(
+            "grad_reduce_dtype='int8' is not supported by the explicit "
+            "sequence-parallel Pallas step: the quantized reduce-"
+            "scatter needs per-replica partial gradients from its own "
+            "data-parallel shard_map, which this hand-sharded path "
+            "cannot provide. Disable model.use_pallas (the implicit-"
+            "SPMD jit cannot quantize either — use a data/fsdp mesh), "
+            "or keep grad_reduce_dtype to 'fp32'/'bf16' here.")
     import optax
 
     from proteinbert_tpu.data.corruption import corrupt_batch

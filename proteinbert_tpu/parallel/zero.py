@@ -31,15 +31,19 @@ and passes it in; the plateau/warmup schedules and `needs_loss_value`
 semantics ride through unchanged because the body calls the SAME shared
 optimizer-apply (train_state.gradient_update) on shards.
 
-`parallel.grad_reduce_dtype = "bf16"` additionally rounds gradients to
-bf16 at the update boundary — the NUMERICS of an EQuARX-style
-compressed reduction (arXiv:2506.17615), with the optimizer math fp32
-on the rounded shards and the measured bound in tests/test_zero.py and
-docs/distributed.md. It does NOT compress the wire today: the cast
-applies to the already-reduced logical gradients (no compiler may hoist
-it ahead of the fp32 reduction), so collective bytes are unchanged —
-true compression needs the reduction to consume per-replica bf16
-partials, future work on an explicit pure-DP path.
+`parallel.grad_reduce_dtype` in {"bf16", "int8"} routes
+`make_zero_train_step` to the QUANTIZED reduce-scatter
+(parallel/quant.py): the forward/backward runs inside an explicit
+data-parallel shard_map producing per-replica partial gradients, and
+the reduction consumes quantized payloads — bf16 (stochastic
+rounding) or int8 (per-chunk scale + stochastic rounding) — so the
+wire really moves 2x/4x fewer bytes (verified from compiled HLO by
+`collective_wire_bytes_from_hlo`, bench.py --comm). The
+`zero_gradient_update` function below keeps the PR-2 cast-only bf16
+behavior for the explicit seq-parallel step, whose shard_map computes
+grads itself: there the cast applies to already-reduced gradients and
+changes numerics only, not wire bytes (documented limitation;
+docs/distributed.md).
 
 Checkpoint compatibility: leaf SHAPES never change (only shardings), so
 orbax save/restore — including the PR-1 staged overlapped save — works
@@ -97,7 +101,14 @@ def zero_gradient_update(
     sharding (param_spec) — the partitioner compiles that exit
     constraint into the all-gather — so callers build the next
     TrainState exactly as in the replicated path and repeated calls see
-    stable input shardings (no retrace, donation-safe)."""
+    stable input shardings (no retrace, donation-safe).
+
+    grad_reduce_dtype here supports "fp32"/"bf16" only, and the bf16
+    cast is NUMERICS-ONLY (it applies to already-reduced gradients —
+    this entry is what the explicit seq-parallel step calls, whose own
+    shard_map produced the grads). The wire-compressing bf16/int8
+    reduction lives in parallel/quant.make_quant_zero_train_step,
+    which make_zero_train_step routes to."""
     import optax
 
     from proteinbert_tpu.train.schedule import make_optimizer, needs_loss_value
@@ -167,7 +178,17 @@ def make_zero_train_step(mesh: Mesh, cfg: PretrainConfig):
     (the trainer selects it). The front half (corruption, forward,
     loss, backward) and the plateau_value contract are SHARED code with
     the default step (train_state.corrupt_forward_grads /
-    plateau_observation), not a copy — only the update differs."""
+    plateau_observation), not a copy — only the update differs.
+
+    grad_reduce_dtype "bf16"/"int8" routes to the QUANTIZED
+    reduce-scatter step (parallel/quant.py) — real wire-byte
+    compression, same signature and plateau contract."""
+    if cfg.parallel.grad_reduce_dtype != "fp32":
+        from proteinbert_tpu.parallel.quant import (
+            make_quant_zero_train_step,
+        )
+
+        return make_quant_zero_train_step(mesh, cfg)
     from proteinbert_tpu.train import train_state as ts
     from proteinbert_tpu.train.schedule import effective_lr
 
@@ -206,18 +227,17 @@ _COLLECTIVES = ("all-reduce", "all-gather", "reduce-scatter",
                 "all-to-all", "collective-permute")
 
 
-def collective_bytes_from_hlo(hlo_text: str) -> Dict[str, int]:
-    """Per-collective output bytes of one compiled (per-device) HLO
-    module — the recorded evidence behind the comm claims (`bench.py
-    --comm`); under SPMD the module is the per-chip program, so shapes
-    are per-chip shapes. `*-start/done` async pairs are counted once
-    (at the start op); the 'total' key sums every kind."""
+def _iter_collectives(hlo_text: str):
+    """Yield (kind, output_bytes, line) for every collective op of one
+    compiled per-device HLO module (shared by the output-bytes and
+    wire-bytes counters below). `*-start/done` async pairs are counted
+    once, at the start op, keeping only the results half of its
+    (operands..., results...) tuple."""
     import re
 
     shape_re = re.compile(r"(\w+)\[([\d,]*)\]")
     op_re = re.compile(
         r"=\s*(.+?)\s+(" + "|".join(_COLLECTIVES) + r")(-start)?\(")
-    out: Dict[str, int] = {k: 0 for k in _COLLECTIVES}
     for line in hlo_text.splitlines():
         if "-done(" in line:
             continue
@@ -238,20 +258,98 @@ def collective_bytes_from_hlo(hlo_text: str) -> Dict[str, int]:
                 if d:
                     n *= int(d)
             nbytes += n * _DTYPE_BYTES[dt]
-        out[m.group(2)] += nbytes
+        yield m.group(2), nbytes, line
+
+
+def collective_bytes_from_hlo(hlo_text: str) -> Dict[str, int]:
+    """Per-collective output bytes of one compiled (per-device) HLO
+    module — the recorded evidence behind the comm claims (`bench.py
+    --comm`); under SPMD the module is the per-chip program, so shapes
+    are per-chip shapes. The 'total' key sums every kind."""
+    out: Dict[str, int] = {k: 0 for k in _COLLECTIVES}
+    for kind, nbytes, _ in _iter_collectives(hlo_text):
+        out[kind] += nbytes
     out["total"] = sum(out[k] for k in _COLLECTIVES)
     return out
 
 
-def record_comm_metrics(registry, hlo_text: str) -> Dict[str, int]:
+def _group_size(line: str, default: int) -> int:
+    """Participant count of one collective op, parsed from its
+    replica_groups attribute — `{{0,1,...},...}` (explicit) or
+    `[G,N]<=[...]` (iota [num_groups, group_size])."""
+    import re
+
+    m = re.search(r"replica_groups=\{\{([\d,\s]*)\}", line)
+    if m:
+        ids = [x for x in m.group(1).split(",") if x.strip()]
+        return max(len(ids), 1)
+    m = re.search(r"replica_groups=\[(\d+),(\d+)\]", line)
+    if m:
+        return max(int(m.group(2)), 1)
+    return default
+
+
+def collective_wire_bytes_from_hlo(hlo_text: str,
+                                   default_group: int = 1,
+                                   ) -> Dict[str, int]:
+    """Estimated per-device WIRE bytes of one compiled module's
+    collectives, from the HLO itself (output shapes + replica_groups;
+    never inferred from unreduced source dtypes). The output-bytes
+    counter above under-represents a reduce-scatter (its per-device
+    output is 1/n of what crossed the wire) and over-represents an
+    all-to-all (its output already spans every peer), so quantized-vs-
+    fp32 comparisons need the ring-algorithm per-device conversion:
+
+      all-reduce      2(n-1)/n x out   (reduce-scatter + all-gather)
+      reduce-scatter  (n-1)   x out    (receives n-1 foreign shards)
+      all-gather      (n-1)/n x out
+      all-to-all      (n-1)/n x out    (1/n of the output is local)
+      collective-permute       out
+
+    `default_group` (pass the mesh's device count) covers ops whose
+    replica_groups the backend elided."""
+    out: Dict[str, int] = {k: 0 for k in _COLLECTIVES}
+    for kind, nbytes, line in _iter_collectives(hlo_text):
+        n = _group_size(line, default_group)
+        if kind == "all-reduce":
+            wire = 2 * nbytes * (n - 1) // max(n, 1)
+        elif kind == "reduce-scatter":
+            wire = nbytes * (n - 1)
+        elif kind in ("all-gather", "all-to-all"):
+            wire = nbytes * (n - 1) // max(n, 1)
+        else:  # collective-permute: point-to-point, output == wire
+            wire = nbytes
+        out[kind] += wire
+    out["total"] = sum(out[k] for k in _COLLECTIVES)
+    return out
+
+
+def grad_reduce_wire_bytes(wire: Dict[str, int]) -> int:
+    """The gradient-REDUCTION share of a wire-bytes breakdown: the
+    collectives a grad reduction can lower to (reduce-scatter under
+    implicit SPMD on TPU, all-reduce on backends that fuse the slice,
+    all-to-all in the explicit quantized step) — the single number the
+    int8-vs-fp32 ratio gate compares (bench.py --comm,
+    tools/quant_smoke.py)."""
+    return (wire["reduce-scatter"] + wire["all-reduce"]
+            + wire["all-to-all"])
+
+
+def record_comm_metrics(registry, hlo_text: str,
+                        default_group: int = 1) -> Dict[str, int]:
     """Fold one compiled module's per-collective bytes into a telemetry
     metrics registry (obs/metrics.py) as `collective_bytes{kind=...}`
-    gauges — so `bench.py --comm` evidence and any consumer of the
-    unified metrics stream read the SAME accounting instead of a
-    private dict. Returns the collective_bytes_from_hlo breakdown."""
+    (output bytes) and `collective_wire_bytes{kind=...}` (per-device
+    wire estimate) gauges — so `bench.py --comm` evidence and any
+    consumer of the unified metrics stream read the SAME accounting
+    instead of a private dict. Returns the collective_bytes_from_hlo
+    breakdown."""
     out = collective_bytes_from_hlo(hlo_text)
     for kind, n in out.items():
         registry.gauge("collective_bytes", kind=kind).set(n)
+    for kind, n in collective_wire_bytes_from_hlo(
+            hlo_text, default_group).items():
+        registry.gauge("collective_wire_bytes", kind=kind).set(n)
     return out
 
 

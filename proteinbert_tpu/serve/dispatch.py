@@ -117,7 +117,14 @@ class BucketDispatcher:
         batch_classes: Optional[Sequence[int]] = None,
         mesh=None,
         metrics=None,
+        quant: str = "fp32",
+        quant_parity_every: int = 0,
     ):
+        from proteinbert_tpu.parallel.quant import SERVE_QUANT_MODES
+
+        if quant not in SERVE_QUANT_MODES:
+            raise ValueError(f"quant must be one of {SERVE_QUANT_MODES}, "
+                             f"got {quant!r}")
         self.params = params
         self.cfg = cfg
         self.buckets = resolve_buckets(cfg, buckets)
@@ -157,6 +164,61 @@ class BucketDispatcher:
             # uncommitted params, as tests build, were merely lucky).
             self.params = jax.device_put(
                 self.params, NamedSharding(mesh, PartitionSpec()))
+        # Quantized executable arm (ISSUE 12): with quant != "fp32" the
+        # dispatcher quantizes the trunk's weights ONCE at load time
+        # (symmetric per-channel int8, parallel/quant.py) and every
+        # request runs the quantized executables, which hold int8
+        # weights in HBM and dequantize in-executable. The fp32 params
+        # are kept resident too — they are the parity-shadow arm
+        # (quant_parity_every) and the source of truth for head trunk
+        # fingerprints. quant_report records the measured HBM-footprint
+        # evidence; parity samples land in quant_parity_max /
+        # `serve_quant_parity_max`.
+        self.quant = quant
+        self.quant_parity_every = int(quant_parity_every)
+        # True while warmup() runs its dummy batches: quant parity
+        # bookkeeping skips them (see _quant_batch_tick).
+        self._warming = False
+        self.qparams = None
+        self.quant_report: Dict = {}
+        self.quant_parity_max: Optional[float] = None
+        self._quant_parity_g = (
+            metrics.gauge("serve_quant_parity_max")
+            if metrics is not None and quant != "fp32" else None)
+        self._quant_batches = 0
+        if quant != "fp32":
+            from proteinbert_tpu.parallel.quant import (
+                param_bytes, quantize_params,
+            )
+
+            fp32_bytes = param_bytes(self.params)
+            qp = quantize_params(self.params)
+            if mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                qp = jax.device_put(
+                    qp, NamedSharding(mesh, PartitionSpec()))
+            self.qparams = qp
+            q_bytes = param_bytes(self.qparams)
+            if self.quant_parity_every <= 0:
+                # No parity shadow → the fp32 trunk has no device-side
+                # consumer (head fingerprints hash host values), so
+                # PARK IT ON HOST: resident HBM holds only the int8
+                # weights — the footprint claim, honored, and the
+                # headroom a second resident trunk needs. With the
+                # shadow on, both trunks stay resident by design
+                # (docs/serving.md documents the cost).
+                self.params = jax.tree.map(np.asarray, self.params)
+            self.quant_report = {
+                "mode": quant,
+                "weight_bytes_fp32": fp32_bytes,
+                "weight_bytes_quant": q_bytes,
+                "weight_bytes_ratio": round(q_bytes / max(fp32_bytes, 1),
+                                            4),
+                "parity_every": self.quant_parity_every,
+                "fp32_resident": ("device" if self.quant_parity_every > 0
+                                  else "host"),
+            }
         self._compile_hist = (metrics.histogram("serve_compile_seconds")
                               if metrics is not None else None)
         # Executable-zoo accounting (ISSUE 9 satellite): how many warm
@@ -321,7 +383,17 @@ class BucketDispatcher:
 
     # ----------------------------------------------------------- execution
 
-    def _fn(self, kind: str):
+    def _fn(self, kind: str, quantized: Optional[bool] = None):
+        """The jitted entry for one request kind — the quantized arm's
+        (parallel/quant.py) when this dispatcher serves quantized,
+        unless `quantized=False` asks for the fp32 shadow (parity
+        sampling)."""
+        if quantized is None:
+            quantized = self.quant != "fp32"
+        if quantized:
+            from proteinbert_tpu.parallel.quant import quant_entry
+
+            return quant_entry(kind, act=self.quant == "int8_act")
         if kind == "embed":
             return inference._encode_batch
         if kind == "predict_go":
@@ -329,6 +401,71 @@ class BucketDispatcher:
         if kind == "predict_residues":
             return inference._residue_probs_batch
         raise ValueError(f"unknown request kind {kind!r}; have {KINDS}")
+
+    def _run_params(self, quantized: Optional[bool] = None):
+        if quantized is None:
+            quantized = self.quant != "fp32"
+        return self.qparams if quantized else self.params
+
+    def _trunk_fn(self, quantized: Optional[bool] = None):
+        """The shared predict_task trunk entry — quantized arm when
+        configured (head TAILS always run fp32 on the trunk's outputs:
+        they are tiny, and per-head quantization would multiply
+        artifacts; docs/serving.md)."""
+        if quantized is None:
+            quantized = self.quant != "fp32"
+        if quantized:
+            from proteinbert_tpu.parallel.quant import _q_trunk_batch
+
+            return _q_trunk_batch
+        return heads_apply.trunk_batch
+
+    @staticmethod
+    def _parity_max(a, b) -> float:
+        """Max abs elementwise deviation between two same-structure
+        outputs (dicts/arrays/lists of arrays) on host; boolean leaves
+        (masks) are excluded — identical by construction, and their
+        arithmetic difference is meaningless."""
+        worst = 0.0
+        for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+            xa, ya = np.asarray(x), np.asarray(y)
+            if xa.dtype == np.bool_ or ya.dtype == np.bool_:
+                continue
+            if xa.size:
+                worst = max(worst, float(np.max(np.abs(
+                    xa.astype(np.float32) - ya.astype(np.float32)))))
+        return worst
+
+    def _quant_batch_tick(self, timings: Dict) -> bool:
+        """Per-batch quant bookkeeping shared by every dispatch path:
+        stamp the arm onto the timings (UNCONDITIONALLY — the
+        absent-means-fp32 event contract must hold on untimed batches
+        too; the schedulers merge these fields from a timed=False
+        run), advance the batch counter, and decide whether THIS batch
+        runs the fp32 parity shadow. Warmup dummy batches are excluded
+        entirely: they must neither consume the parity cadence nor
+        count all-PAD compiles as LIVE parity samples."""
+        if self.quant == "fp32" or self._warming:
+            return False
+        timings["quant"] = self.quant
+        self._quant_batches += 1
+        return (self.quant_parity_every > 0
+                and (self._quant_batches - 1)
+                % self.quant_parity_every == 0)
+
+    def _shadow_parity(self, out, ref_thunk,
+                       timings: Dict) -> None:
+        """Run the fp32 shadow (`ref_thunk`), record the worst
+        per-request deviation against `out` — the one implementation
+        every (bucketed|ragged) x (kind|heads) path shares."""
+        worst = self._parity_max(out, ref_thunk())
+        self.quant_parity_max = max(self.quant_parity_max or 0.0, worst)
+        self.quant_report["parity_max"] = round(self.quant_parity_max, 9)
+        self.quant_report["parity_samples"] = (
+            self.quant_report.get("parity_samples", 0) + 1)
+        if self._quant_parity_g is not None:
+            self._quant_parity_g.set(round(self.quant_parity_max, 9))
+        timings["quant_parity_max"] = round(worst, 9)
 
     def _place(self, tokens: np.ndarray, annotations: np.ndarray):
         if self._shardings is None:
@@ -386,20 +523,36 @@ class BucketDispatcher:
         if timed:
             t1 = time.perf_counter()
             timings["prep_s"] = round(t1 - t0, 9)
+        parity_due = self._quant_batch_tick(timings)
         if heads is not None:
             # Multi-tenant path: ONE shared trunk executable for the
             # whole (possibly mixed-head) batch, then each distinct
             # head's cheap tail over the full batch — every row keeps
             # its own head's output (heads/apply.py).
-            trunk_out = heads_apply.trunk_batch(self.params, tb, ab,
-                                                self.cfg.model)
+            trunk_out = self._trunk_fn()(self._run_params(), tb, ab,
+                                         self.cfg.model)
             self._note_warm(("trunk", L, cls))
             out = heads_apply.apply_heads(trunk_out, heads)
+            if parity_due:
+                self._shadow_parity(
+                    out,
+                    lambda: heads_apply.apply_heads(
+                        heads_apply.trunk_batch(self.params, tb, ab,
+                                                self.cfg.model), heads),
+                    timings)
         else:
             fn = self._fn(kind)
-            res = fn(self.params, tb, ab, self.cfg.model)
+            res = fn(self._run_params(), tb, ab, self.cfg.model)
             self._note_warm((kind, L, cls))
             out = jax.tree.map(lambda a: np.asarray(a)[:rows], res)
+            if parity_due:
+                self._shadow_parity(
+                    out,
+                    lambda: jax.tree.map(
+                        lambda a: np.asarray(a)[:rows],
+                        self._fn(kind, quantized=False)(
+                            self.params, tb, ab, self.cfg.model)),
+                    timings)
         if timed:
             timings["device_s"] = round(time.perf_counter() - t1, 9)
         return out, timings
@@ -429,26 +582,31 @@ class BucketDispatcher:
         t_warm = time.perf_counter()
         n = 0
         kinds = tuple(kinds)
-        for kind in kinds:
-            if kind == TASK_KIND:
-                continue
-            if kind not in KINDS:
-                raise ValueError(f"unknown request kind {kind!r}; "
-                                 f"have {KINDS + (TASK_KIND,)}")
-            for L in self.buckets:
-                for cls in self.batch_classes:
-                    if (kind, L, cls) in self._warm:
-                        continue
-                    dummy, _ = self._dummy_batch(L, cls)
-                    if self._compile_hist is not None:
-                        t0 = time.perf_counter()
-                        self.run(kind, dummy)
-                        self._compile_hist.observe(time.perf_counter() - t0)
-                    else:
-                        self.run(kind, dummy)
-                    n += 1
-        if TASK_KIND in kinds or self.heads:
-            n += self._warmup_task()
+        self._warming = True
+        try:
+            for kind in kinds:
+                if kind == TASK_KIND:
+                    continue
+                if kind not in KINDS:
+                    raise ValueError(f"unknown request kind {kind!r}; "
+                                     f"have {KINDS + (TASK_KIND,)}")
+                for L in self.buckets:
+                    for cls in self.batch_classes:
+                        if (kind, L, cls) in self._warm:
+                            continue
+                        dummy, _ = self._dummy_batch(L, cls)
+                        if self._compile_hist is not None:
+                            t0 = time.perf_counter()
+                            self.run(kind, dummy)
+                            self._compile_hist.observe(
+                                time.perf_counter() - t0)
+                        else:
+                            self.run(kind, dummy)
+                        n += 1
+            if TASK_KIND in kinds or self.heads:
+                n += self._warmup_task()
+        finally:
+            self._warming = False
         self._note_warmup_seconds(time.perf_counter() - t_warm)
         return n
 
@@ -470,8 +628,8 @@ class BucketDispatcher:
                 with self._warm_lock:
                     new = ("trunk", L, cls) not in self._warm
                 t0 = time.perf_counter()
-                trunk_out = heads_apply.trunk_batch(self.params, tb, ab,
-                                                    self.cfg.model)
+                trunk_out = self._trunk_fn()(self._run_params(), tb, ab,
+                                             self.cfg.model)
                 jax.block_until_ready(trunk_out)
                 dt = time.perf_counter() - t0
                 if new:
@@ -573,6 +731,8 @@ class RaggedDispatcher(BucketDispatcher):
         max_segments: int = 8,
         mesh=None,
         metrics=None,
+        quant: str = "fp32",
+        quant_parity_every: int = 0,
     ):
         if rows_per_batch < 1:
             raise ValueError(f"rows_per_batch must be >= 1, "
@@ -580,6 +740,12 @@ class RaggedDispatcher(BucketDispatcher):
         if max_segments < 1:
             raise ValueError(f"max_segments must be >= 1, "
                              f"got {max_segments}")
+        if quant == "int8_act":
+            raise ValueError(
+                "quant='int8_act' is a bucketed-arm option: the packed "
+                "executables have no activation fake-quant variant "
+                "(use quant='int8' for weight-only quantized ragged "
+                "serving — docs/serving.md)")
         # Mesh support (ISSUE 11 satellite, PR 8 residual): packed rows
         # shard over the joint ('data','fsdp') batch axis exactly like
         # bucketed micro-batches (serve_batch_sharding — segment_ids
@@ -589,7 +755,8 @@ class RaggedDispatcher(BucketDispatcher):
         super().__init__(params, cfg, buckets=buckets,
                          max_batch=rows_per_batch,
                          batch_classes=(rows_per_batch,), mesh=mesh,
-                         metrics=metrics)
+                         metrics=metrics, quant=quant,
+                         quant_parity_every=quant_parity_every)
         self.rows_per_batch = int(rows_per_batch)
         self.max_segments = int(max_segments)
 
@@ -606,7 +773,13 @@ class RaggedDispatcher(BucketDispatcher):
                 jax.device_put(segment_ids, self._shardings["segment_ids"]),
                 jax.device_put(annotations, self._shardings["annotations"]))
 
-    def _packed_fn(self, kind: str):
+    def _packed_fn(self, kind: str, quantized: Optional[bool] = None):
+        if quantized is None:
+            quantized = self.quant != "fp32"
+        if quantized:
+            from proteinbert_tpu.parallel.quant import quant_packed_entry
+
+            return quant_packed_entry(kind)
         if kind == "embed":
             return inference._packed_encode_batch
         if kind == "predict_go":
@@ -614,6 +787,17 @@ class RaggedDispatcher(BucketDispatcher):
         if kind == "predict_residues":
             return inference._packed_residue_probs_batch
         raise ValueError(f"unknown request kind {kind!r}; have {KINDS}")
+
+    def _packed_trunk_fn(self, quantized: Optional[bool] = None):
+        if quantized is None:
+            quantized = self.quant != "fp32"
+        if quantized:
+            from proteinbert_tpu.parallel.quant import (
+                _q_packed_trunk_batch,
+            )
+
+            return _q_packed_trunk_batch
+        return heads_apply.packed_trunk_batch
 
     def run_timed(self, *args, **kwargs):
         raise NotImplementedError(
@@ -669,28 +853,51 @@ class RaggedDispatcher(BucketDispatcher):
         if timed:
             t1 = time.perf_counter()
             timings["prep_s"] = round(t1 - t0, 9)
+        parity_due = self._quant_batch_tick(timings)
+
+        def fan_out(host):
+            fanned = []
+            for row, seg, start, span in riders:
+                if kind == "embed":
+                    fanned.append(
+                        {"global": host["global"][row, seg],
+                         "local_mean": host["local_mean"][row, seg]})
+                elif kind == "predict_go":
+                    fanned.append(host[row, seg])
+                else:  # predict_residues: the span lines up with the
+                    # bucketed (bucket_len, V) output
+                    fanned.append(host[row, start:start + span])
+            return fanned
+
         if heads is not None:
-            trunk_out = heads_apply.packed_trunk_batch(
-                self.params, tb, sb, ab, self.cfg.model)
+            trunk_out = self._packed_trunk_fn()(
+                self._run_params(), tb, sb, ab, self.cfg.model)
             self._note_warm(("trunk", L, R))
             outs = heads_apply.apply_heads_packed(
                 trunk_out,
                 [(h,) + tuple(r) for h, r in zip(heads, riders)])
+            if parity_due:
+                self._shadow_parity(
+                    outs,
+                    lambda: heads_apply.apply_heads_packed(
+                        heads_apply.packed_trunk_batch(
+                            self.params, tb, sb, ab, self.cfg.model),
+                        [(h,) + tuple(r)
+                         for h, r in zip(heads, riders)]),
+                    timings)
         else:
-            res = self._packed_fn(kind)(self.params, tb, sb, ab,
+            res = self._packed_fn(kind)(self._run_params(), tb, sb, ab,
                                         self.cfg.model)
             self._note_warm((kind, L, R))
-            host = jax.tree.map(np.asarray, res)
-            outs = []
-            for row, seg, start, span in riders:
-                if kind == "embed":
-                    outs.append({"global": host["global"][row, seg],
-                                 "local_mean": host["local_mean"][row, seg]})
-                elif kind == "predict_go":
-                    outs.append(host[row, seg])
-                else:  # predict_residues: the span lines up with the
-                    # bucketed (bucket_len, V) output
-                    outs.append(host[row, start:start + span])
+            outs = fan_out(jax.tree.map(np.asarray, res))
+            if parity_due:
+                self._shadow_parity(
+                    outs,
+                    lambda: fan_out(jax.tree.map(
+                        np.asarray,
+                        self._packed_fn(kind, quantized=False)(
+                            self.params, tb, sb, ab, self.cfg.model))),
+                    timings)
         if timed:
             timings["device_s"] = round(time.perf_counter() - t1, 9)
         return outs, timings
@@ -724,23 +931,27 @@ class RaggedDispatcher(BucketDispatcher):
         kinds = tuple(kinds)
         R, L = self.rows_per_batch, self.cfg.data.seq_len
         tokens, seg, ann, riders = self._dummy_packed()
-        for kind in kinds:
-            if kind == TASK_KIND:
-                continue
-            if kind not in KINDS:
-                raise ValueError(f"unknown request kind {kind!r}; "
-                                 f"have {KINDS + (TASK_KIND,)}")
-            if (kind, L, R) in self._warm:
-                continue
-            if self._compile_hist is not None:
-                t0 = time.perf_counter()
-                self.run_packed(kind, tokens, seg, ann, riders)
-                self._compile_hist.observe(time.perf_counter() - t0)
-            else:
-                self.run_packed(kind, tokens, seg, ann, riders)
-            n += 1
-        if TASK_KIND in kinds or self.heads:
-            n += self._warmup_task()
+        self._warming = True
+        try:
+            for kind in kinds:
+                if kind == TASK_KIND:
+                    continue
+                if kind not in KINDS:
+                    raise ValueError(f"unknown request kind {kind!r}; "
+                                     f"have {KINDS + (TASK_KIND,)}")
+                if (kind, L, R) in self._warm:
+                    continue
+                if self._compile_hist is not None:
+                    t0 = time.perf_counter()
+                    self.run_packed(kind, tokens, seg, ann, riders)
+                    self._compile_hist.observe(time.perf_counter() - t0)
+                else:
+                    self.run_packed(kind, tokens, seg, ann, riders)
+                n += 1
+            if TASK_KIND in kinds or self.heads:
+                n += self._warmup_task()
+        finally:
+            self._warming = False
         self._note_warmup_seconds(time.perf_counter() - t_warm)
         return n
 
@@ -757,8 +968,8 @@ class RaggedDispatcher(BucketDispatcher):
         with self._warm_lock:
             new = ("trunk", L, R) not in self._warm
         t0 = time.perf_counter()
-        trunk_out = heads_apply.packed_trunk_batch(self.params, tb, sb,
-                                                   ab, self.cfg.model)
+        trunk_out = self._packed_trunk_fn()(self._run_params(), tb, sb,
+                                            ab, self.cfg.model)
         jax.block_until_ready(trunk_out)
         dt = time.perf_counter() - t0
         n = 0

@@ -26,13 +26,16 @@ Routes (POST bodies and responses are JSON):
   POST /v1/heads/remove      {"head_id"} → hot-remove (drain: queued
                              requests for it still complete)
   GET  /healthz              → {"ok": true, "mode": "bucketed"|"ragged",
+                               "quant": "fp32"|"int8"|"int8_act",
                                "stats": {...}} — `mode` is the serving
                                dispatch mode (`pbt serve --serve-mode`,
-                               ISSUE 9); stats carries the executable-
-                               zoo accounting (executables,
-                               warmup_seconds, the two-sided
-                               fused_path coverage + deprecated
-                               fused_fallback)
+                               ISSUE 9), `quant` the executable arm
+                               (`pbt serve --quant`, ISSUE 12); stats
+                               carries the executable-zoo accounting
+                               (executables, warmup_seconds, fused_path
+                               coverage) and, on a quantized arm, the
+                               weight-bytes footprint + sampled parity
+                               under "quant"
   GET  /metrics              → Prometheus textfile (the registry's
                                exposition; empty when telemetry is off)
 
@@ -99,6 +102,7 @@ def make_handler(server: Server):
         def do_GET(self):
             if self.path in ("/healthz", "/stats"):
                 self._reply(200, {"ok": True, "mode": server.serve_mode,
+                                  "quant": server.quant,
                                   "stats": server.stats()})
             elif self.path == "/v1/heads":
                 self._reply(200, {"heads": server.list_heads()})

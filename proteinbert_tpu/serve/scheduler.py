@@ -251,11 +251,19 @@ class MicroBatchScheduler:
             # run_timed (BucketDispatcher) splits prep (pad/place) from
             # device execute and reports the padded grid's pad
             # fraction; plain run() keeps stub dispatchers working.
-            run_timed = (getattr(self.dispatcher, "run_timed", None)
-                         if tracing and timed else None)
-            if run_timed is not None:
+            # Untimed batches still go through run_timed(timed=False)
+            # when the dispatcher has it: the quantized arm stamps its
+            # `quant`/`quant_parity_max` event fields unconditionally
+            # (absent-means-fp32 must hold on untimed batches too), and
+            # timed=False skips only the O(rows*L) pad scan.
+            run_timed = getattr(self.dispatcher, "run_timed", None)
+            if run_timed is not None and tracing and timed:
                 result, timings = run_timed(kind, tokens, annotations,
                                             **extra)
+                ctx.update(timings)
+            elif run_timed is not None:
+                result, timings = run_timed(kind, tokens, annotations,
+                                            timed=False, **extra)
                 ctx.update(timings)
             else:
                 result = self.dispatcher.run(kind, tokens, annotations,
@@ -305,11 +313,15 @@ class MicroBatchScheduler:
         self.rows_total += len(batch)
         self._occupancy_g.set(len(batch) / cls)
         self._rows_h.observe(len(batch))
+        # Quant fields ride only when the arm set them: the documented
+        # contract is absent-means-fp32, not null (obs/events.py).
+        quant_fields = {k: ctx[k] for k in ("quant", "quant_parity_max")
+                        if ctx.get(k) is not None}
         self.tele.emit("serve_batch", kind=kind, bucket_len=bucket_len,
                        rows=len(batch), batch_class=cls,
                        batch_seconds=round(dt, 6),
                        pad_fraction=ctx.get("pad_fraction"),
-                       heads=ctx.get("heads"))
+                       heads=ctx.get("heads"), **quant_fields)
         return len(batch)
 
     def poll(self, now: Optional[float] = None) -> int:
@@ -580,15 +592,18 @@ class PackedBatchScheduler(MicroBatchScheduler):
         t0 = time.perf_counter()
         run0 = self.clock()
         try:
+            # Same rule as the bucketed scheduler: untimed batches run
+            # timed=False so the quantized arm's unconditionally-
+            # stamped event fields still reach the ctx.
             if tracing and timed:
                 outs, timings = self.dispatcher.run_packed_timed(
                     kind, tokens, segment_ids, annotations, geom,
                     heads=heads)
-                ctx.update(timings)
             else:
-                outs = self.dispatcher.run_packed(
+                outs, timings = self.dispatcher.run_packed_timed(
                     kind, tokens, segment_ids, annotations, geom,
-                    heads=heads)
+                    heads=heads, timed=False)
+            ctx.update(timings)
         except Exception as e:  # fail THIS batch, keep serving
             logger.exception("packed batch dispatch failed "
                              "(%s, rows=%d, segments=%d)",
@@ -641,6 +656,8 @@ class PackedBatchScheduler(MicroBatchScheduler):
         self._occupancy_g.set(1.0 - pad if pad is not None
                               else n_riders / (R * S))
         self._rows_h.observe(n_riders)
+        quant_fields = {k: ctx[k] for k in ("quant", "quant_parity_max")
+                        if ctx.get(k) is not None}
         self.tele.emit("serve_batch", kind=kind, bucket_len=L,
                        rows=R, batch_class=R,
                        batch_seconds=round(dt, 6),
@@ -648,7 +665,7 @@ class PackedBatchScheduler(MicroBatchScheduler):
                        segments=n_riders,
                        segments_per_row=ctx["segments_per_row"],
                        mode="ragged",
-                       heads=ctx.get("heads"))
+                       heads=ctx.get("heads"), **quant_fields)
         return n_riders
 
     def fail_pending(self, exc: Exception) -> List[Request]:

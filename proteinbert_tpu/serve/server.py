@@ -116,6 +116,8 @@ class Server:
         partition_heads: bool = False,
         serve_mode: str = "bucketed",
         pack_max_segments: int = 8,
+        quant: Optional[str] = None,
+        quant_parity_every: Optional[int] = None,
     ):
         from proteinbert_tpu.obs import as_telemetry
 
@@ -130,6 +132,17 @@ class Server:
         self.default_deadline_s = default_deadline_s
         self.clock = clock
         self.serve_mode = serve_mode
+        # Quantized executable arm (ISSUE 12): defaults ride the run
+        # config (configs.ServeConfig) so `pbt serve --pretrained DIR`
+        # inherits the trained-against quantization decision; explicit
+        # ctor args override per server.
+        serve_cfg = getattr(cfg, "serve", None)
+        if quant is None:
+            quant = getattr(serve_cfg, "quant", "fp32")
+        if quant_parity_every is None:
+            quant_parity_every = getattr(serve_cfg,
+                                         "quant_parity_every", 0)
+        self.quant = quant
         self.tele = as_telemetry(telemetry)
         metrics = self.tele.metrics
         self.cache = EmbeddingCache(cache_size, metrics=metrics)
@@ -155,7 +168,8 @@ class Server:
             self.dispatcher = RaggedDispatcher(
                 params, cfg, buckets=buckets, rows_per_batch=max_batch,
                 max_segments=pack_max_segments, mesh=mesh,
-                metrics=metrics)
+                metrics=metrics, quant=quant,
+                quant_parity_every=quant_parity_every)
             self.scheduler = PackedBatchScheduler(
                 self.queue, self.dispatcher, self._finalize,
                 rows_per_batch=max_batch, max_wait_s=max_wait_s,
@@ -167,7 +181,8 @@ class Server:
         else:
             self.dispatcher = BucketDispatcher(
                 params, cfg, buckets=buckets, max_batch=max_batch,
-                batch_classes=batch_classes, mesh=mesh, metrics=metrics)
+                batch_classes=batch_classes, mesh=mesh, metrics=metrics,
+                quant=quant, quant_parity_every=quant_parity_every)
             self.scheduler = MicroBatchScheduler(
                 self.queue, self.dispatcher, self._finalize,
                 max_batch=max_batch, max_wait_s=max_wait_s, clock=clock,
@@ -257,13 +272,12 @@ class Server:
         # path and the XLA reference path — into the registry as
         # fused_kernel_path_total{path=,reason=}, so /metrics, stats()
         # and `pbt diagnose --serve` show how many compiled shapes run
-        # the fast path, not just the misses. Reference-path bumps also
-        # feed the DEPRECATED one-sided fused_kernel_fallback_total
-        # (kept emitting for one release, docs/observability.md).
-        # Registered LAST — after every raising statement above — so a
-        # failed construction (bad SLO spec, trunk-mismatched head)
-        # cannot leak a process-global observer; drain()/abort()
-        # unregister it.
+        # the fast path, not just the misses. (The one-release
+        # deprecated fused_kernel_fallback_total mirror was removed in
+        # ISSUE 12, as PR 9 scheduled.) Registered LAST — after every
+        # raising statement above — so a failed construction (bad SLO
+        # spec, trunk-mismatched head) cannot leak a process-global
+        # observer; drain()/abort() unregister it.
         from proteinbert_tpu.kernels.fused_block import (
             register_path_observer,
         )
@@ -277,12 +291,6 @@ class Server:
                 c = _c[(path, reason)] = _metrics.counter(
                     "fused_kernel_path_total", path=path, reason=reason)
             c.inc()
-            if path == "reference":
-                c2 = _c.get(("fallback", reason))
-                if c2 is None:
-                    c2 = _c[("fallback", reason)] = _metrics.counter(
-                        "fused_kernel_fallback_total", reason=reason)
-                c2.inc()
 
         self._path_cb = _mirror_path
         register_path_observer(self._path_cb)
@@ -320,6 +328,8 @@ class Server:
                      if self.dispatcher.mesh is not None else None),
             "heads": sorted(self.dispatcher.heads),
             "warmup": self.dispatcher.warmup_report,
+            "quant": self.quant,
+            "quant_report": self.dispatcher.quant_report or None,
         })
         self.scheduler.start()
         self._started = True
@@ -464,6 +474,11 @@ class Server:
                 f"{self._id_prefix}{n:x}", kind, now0,
                 sampled=stride_sampled(n, self.trace_sample_rate))
             trace.head_id = head_id
+            # Which executable arm will serve this request (`quant` on
+            # serve_request events — the per-request A/B attribution
+            # field; absent on the fp32 arm).
+            if self.quant != "fp32":
+                trace.quant = self.quant
         head = None
         if kind == TASK_KIND:
             try:
@@ -710,9 +725,7 @@ class Server:
                 "truncated": self.truncated_total,
                 "rejected": dict(self.rejected_total),
             }
-        from proteinbert_tpu.kernels.fused_block import (
-            FALLBACK_TOTAL, PATH_TOTAL,
-        )
+        from proteinbert_tpu.kernels.fused_block import PATH_TOTAL
 
         qw = self.scheduler.queue_wait
         out = {
@@ -731,9 +744,11 @@ class Server:
             # the XLA composition (ISSUE 10 two-sided counter).
             "fused_path": {f"{p}/{r}": n
                            for (p, r), n in sorted(PATH_TOTAL.items())},
-            # DEPRECATED one-sided view (reference-path reasons only);
-            # kept for one release — read fused_path instead.
-            "fused_fallback": dict(FALLBACK_TOTAL),
+            # Quantized executable arm (ISSUE 12): which arm serves,
+            # the measured weight-HBM footprint, and the worst sampled
+            # parity deviation vs the fp32 shadow (None = fp32 arm).
+            "quant": ({"mode": self.quant, **self.dispatcher.quant_report}
+                      if self.quant != "fp32" else None),
             "heads": len(self.dispatcher.heads),
             "batches": self.scheduler.batches_total,
             "batched_rows": self.scheduler.rows_total,

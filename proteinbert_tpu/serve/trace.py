@@ -68,7 +68,7 @@ class RequestTrace:
         "t_run0", "t_run1", "t_done",
         "bucket_len", "batch_class", "rows", "pad_fraction",
         "prep_s", "device_s", "cache", "outcome", "error", "head_id",
-        "segments", "segments_per_row", "mode",
+        "segments", "segments_per_row", "mode", "quant",
     )
 
     def __init__(self, request_id: str, kind: str, now: float,
@@ -105,6 +105,9 @@ class RequestTrace:
         self.segments: Optional[int] = None
         self.segments_per_row: Optional[float] = None
         self.mode: Optional[str] = None
+        # Quantized executable arm (ISSUE 12): "int8"/"int8_act" when
+        # a quantized executable served this request, None on fp32.
+        self.quant: Optional[str] = None
 
     # ------------------------------------------------------------ marks
 
@@ -226,7 +229,7 @@ class RequestTrace:
         }
         for name in ("bucket_len", "batch_class", "rows", "pad_fraction",
                      "prep_s", "device_s", "error", "head_id",
-                     "segments", "segments_per_row", "mode"):
+                     "segments", "segments_per_row", "mode", "quant"):
             v = getattr(self, name)
             if v is not None:
                 fields[name] = v

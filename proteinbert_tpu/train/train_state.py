@@ -75,6 +75,44 @@ def gradient_update(
     return params, opt_state
 
 
+def corrupt_for_step(
+    state: "TrainState", batch: Dict[str, jax.Array], cfg: PretrainConfig,
+):
+    """The pretraining step's front QUARTER — split the RNG key and
+    corrupt the clean batch — shared by `corrupt_forward_grads` below
+    and the quantized-reduction step (parallel/quant.py, whose forward/
+    backward runs inside a shard_map but whose corruption must be the
+    SAME implicit-SPMD ops on the same step key, so fp32-vs-quantized
+    runs see identical masking and their deviation is quantization
+    noise alone). Returns (next state key, X, Y, W, segment_ids|None);
+    a batch carrying "segment_ids" is a PACKED batch (data/packing.py)
+    and corrupts segment-aware."""
+    key, step_key = jax.random.split(state.key)
+    if "segment_ids" in batch:
+        seg = batch["segment_ids"]
+        X, Y, W = corrupt_packed_batch(
+            step_key,
+            batch["tokens"],
+            seg,
+            batch["annotations"],
+            token_randomize_prob=cfg.data.token_randomize_prob,
+            annotation_corrupt_prob=cfg.data.annotation_corrupt_prob,
+            annotation_drop_prob=cfg.data.annotation_drop_prob,
+            annotation_add_prob=cfg.data.annotation_add_prob,
+        )
+        return key, X, Y, W, seg
+    X, Y, W = corrupt_batch(
+        step_key,
+        batch["tokens"],
+        batch["annotations"],
+        token_randomize_prob=cfg.data.token_randomize_prob,
+        annotation_corrupt_prob=cfg.data.annotation_corrupt_prob,
+        annotation_drop_prob=cfg.data.annotation_drop_prob,
+        annotation_add_prob=cfg.data.annotation_add_prob,
+    )
+    return key, X, Y, W, None
+
+
 def corrupt_forward_grads(
     state: "TrainState", batch: Dict[str, jax.Array], cfg: PretrainConfig,
 ) -> Tuple[jax.Array, Any, Dict[str, jax.Array]]:
@@ -89,19 +127,8 @@ def corrupt_forward_grads(
     aware path (per-segment annotation state + per-segment loss
     normalization), selected at trace time from the batch's pytree
     structure — no config flag needed on device."""
-    key, step_key = jax.random.split(state.key)
-    if "segment_ids" in batch:
-        seg = batch["segment_ids"]
-        X, Y, W = corrupt_packed_batch(
-            step_key,
-            batch["tokens"],
-            seg,
-            batch["annotations"],
-            token_randomize_prob=cfg.data.token_randomize_prob,
-            annotation_corrupt_prob=cfg.data.annotation_corrupt_prob,
-            annotation_drop_prob=cfg.data.annotation_drop_prob,
-            annotation_add_prob=cfg.data.annotation_add_prob,
-        )
+    key, X, Y, W, seg = corrupt_for_step(state, batch, cfg)
+    if seg is not None:
 
         def loss_fn(params):
             local_logits, global_logits = proteinbert.apply(
@@ -113,15 +140,6 @@ def corrupt_forward_grads(
 
         grads, metrics = jax.grad(loss_fn, has_aux=True)(state.params)
         return key, grads, metrics
-    X, Y, W = corrupt_batch(
-        step_key,
-        batch["tokens"],
-        batch["annotations"],
-        token_randomize_prob=cfg.data.token_randomize_prob,
-        annotation_corrupt_prob=cfg.data.annotation_corrupt_prob,
-        annotation_drop_prob=cfg.data.annotation_drop_prob,
-        annotation_add_prob=cfg.data.annotation_add_prob,
-    )
     pad_mask = W["local"] > 0
 
     def loss_fn(params):

@@ -239,6 +239,17 @@ def pretrain(
             "replicated update",
             "no mesh was passed" if mesh is None
             else "the mesh has data*fsdp == 1 (nothing to shard across)")
+    if cfg.parallel.grad_reduce_dtype != "fp32" and not zero_on:
+        # The quantized reduce-scatter (parallel/quant.py) only exists
+        # on the zero-update path: without it there IS no cross-replica
+        # gradient reduction to compress, and silently training at fp32
+        # when the config asked for int8/bf16 wire would misreport
+        # every comm claim downstream.
+        logger.warning(
+            "parallel.grad_reduce_dtype=%r has no effect without an "
+            "active ZeRO-1 update (zero_update on a data*fsdp > 1 "
+            "mesh) — the replicated step reduces gradients at fp32",
+            cfg.parallel.grad_reduce_dtype)
     # plateau_step is the eval-keyed variant (extra plateau_value arg);
     # the zero step carries it natively, mirroring train_step.
     plateau_step = (lambda state, batch, v:               # noqa: E731
@@ -266,8 +277,10 @@ def pretrain(
                         zero_step(state, batch, v))
         logger.info(
             "using ZeRO-1 sharded-update train step (update sharded over "
-            "data*fsdp = %d replicas, grad reduction %s)",
-            zero_extent(mesh), cfg.parallel.grad_reduce_dtype)
+            "data*fsdp = %d replicas, grad reduction %s%s)",
+            zero_extent(mesh), cfg.parallel.grad_reduce_dtype,
+            "" if cfg.parallel.grad_reduce_dtype == "fp32"
+            else " — quantized reduce-scatter wire, parallel/quant.py")
     else:
         step_fn = ts.train_step
 

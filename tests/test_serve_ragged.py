@@ -105,9 +105,14 @@ class StubRaggedDispatcher:
         return [("ok", kind) + tuple(r) for r in riders]
 
     def run_packed_timed(self, kind, tokens, segment_ids, annotations,
-                         riders, heads=None):
+                         riders, heads=None, timed=True):
+        # `timed` mirrors the real dispatcher's contract: the scheduler
+        # now always calls this entry (timed=False on untimed batches,
+        # so the quantized arm's event fields flow either way).
         outs = self.run_packed(kind, tokens, segment_ids, annotations,
                                riders, heads=heads)
+        if not timed:
+            return outs, {}
         real = int((tokens != 0).sum())
         grid = tokens.size
         return outs, {"pad_fraction": round(1 - real / grid, 6),
@@ -467,7 +472,7 @@ class TestFusedPathCounter:
         from proteinbert_tpu.kernels import fused_block as fb
 
         params = _tiny_track_params()
-        seen_path, seen_legacy, records = [], [], []
+        seen_path, records = [], []
 
         def path_cb(p, r):
             seen_path.append((p, r))
@@ -479,10 +484,12 @@ class TestFusedPathCounter:
         handler.emit = records.append
         fb.logger.addHandler(handler)
         fb.register_path_observer(path_cb)
-        fb.register_fallback_observer(seen_legacy.append)
         key = ("reference", "segments")
         before = fb.PATH_TOTAL.get(key, 0)
-        before_legacy = fb.FALLBACK_TOTAL.get("segments", 0)
+        # The deprecated one-release fused_kernel_fallback_total mirror
+        # is GONE (removed in ISSUE 12, as PR 9 scheduled).
+        assert not hasattr(fb, "FALLBACK_TOTAL")
+        assert not hasattr(fb, "register_fallback_observer")
         # Reset the warn latch for exactly the shapes this test uses so
         # the count below is deterministic whatever ran earlier.
         shapes = [(1, 24, 4, 2, "float32"), (1, 40, 4, 2, "float32")]
@@ -501,12 +508,8 @@ class TestFusedPathCounter:
         finally:
             fb.logger.removeHandler(handler)
             fb.unregister_path_observer(path_cb)
-            fb.unregister_fallback_observer(seen_legacy.append)
         assert fb.PATH_TOTAL[key] == before + 3
-        # Deprecated one-sided mirror keeps emitting for one release.
-        assert fb.FALLBACK_TOTAL["segments"] == before_legacy + 3
         assert seen_path == [key] * 3
-        assert seen_legacy == ["segments"] * 3
         warnings = [r for r in records
                     if "XLA reference" in r.getMessage()]
         # Same shape twice → ONE warning; the new shape → its own.
@@ -527,14 +530,12 @@ class TestFusedPathCounter:
                                      path="reference", reason="segments")
         c_pal = tele.metrics.counter("fused_kernel_path_total",
                                      path="pallas", reason="packed")
-        c_old = tele.metrics.counter("fused_kernel_fallback_total",
-                                     reason="segments")
         assert c_ref.value == 1 and c_pal.value == 1
-        assert c_old.value == 1  # deprecated mirror, one release
         stats = srv.stats()
         assert stats["fused_path"]["reference/segments"] >= 1
         assert stats["fused_path"]["pallas/packed"] >= 1
-        assert stats["fused_fallback"]["segments"] >= 1
+        # The deprecated one-sided stats mirror is gone (ISSUE 12).
+        assert "fused_fallback" not in stats
         srv.drain(timeout=10)
         fb.note_kernel_path("pallas", "packed")  # observer released
         assert c_pal.value == 1

@@ -159,13 +159,18 @@ def test_zero_opt_state_sharded_and_smaller():
 
 @requires_8
 def test_bf16_grad_reduction_error_bounded():
-    """parallel.grad_reduce_dtype='bf16' rounds gradients at the
-    reduction boundary. Measured bound (documented in
+    """parallel.grad_reduce_dtype='bf16' now routes to the QUANTIZED
+    reduce-scatter (parallel/quant.py, ISSUE 12): per-replica partial
+    gradients are stochastically rounded to bf16 and exchanged at 2
+    bytes/element on the wire. Measured bound (documented in
     docs/distributed.md): after two steps at lr 1e-3 the max param
-    deviation from the exact fp32 path stays under 1e-4 — i.e. within
-    bf16's ~2^-9 relative rounding of the update magnitude — while the
-    fp32 zero path stays under 2e-6 (the parity test). The loss at
-    step 1 is computed BEFORE any update and must match exactly."""
+    deviation from the exact fp32 path stays under 5e-4 — the
+    stochastic per-PARTIAL rounding of n=8 replicas accumulates
+    ~sqrt(n) of the old post-reduction cast's error, which is the
+    price of the wire actually moving bf16 — while the fp32 zero path
+    stays under 2e-6 (the parity test). The loss at step 1 is computed
+    BEFORE any update and must match exactly (same corruption ops on
+    the same key; tests/test_quant.py holds the full payload grid)."""
     mesh_cfg = MeshConfig(data=4, fsdp=2)
     batch = make_batch(cfg_for(mesh_cfg))
 
@@ -177,7 +182,7 @@ def test_bf16_grad_reduction_error_bounded():
 
     assert abs(float(m1["loss"]) - float(ref_m1["loss"])) <= 2e-5
     err = _max_param_err(ref_state, state)
-    assert 0.0 < err < 1e-4, err  # rounded (not exact), and bounded
+    assert 0.0 < err < 5e-4, err  # rounded (not exact), and bounded
 
 
 def test_grad_reduce_dtype_rejected():

@@ -144,7 +144,8 @@ def series_from_bench_files(paths: List[str],
     return series
 
 
-# (event kind, payload field) → series name; all higher-is-better.
+# (event kind, payload field) → series name; higher-is-better unless
+# the series name is in _LOWER_IS_BETTER below.
 _EVENT_METRICS = (
     ("serve_capture", "served_requests_per_sec", "serve_requests_per_sec"),
     ("serve_capture", "speedup_x", "serve_speedup_x"),
@@ -160,7 +161,30 @@ _EVENT_METRICS = (
      "heads_mixed_requests_per_sec"),
     ("heads_capture", "mixed_speedup_x", "heads_mixed_speedup_x"),
     ("heads_capture", "eval_score_min", "heads_eval_score_min"),
+    # Quantized collectives + int8 serving (ISSUE 12): the int8 grad-
+    # reduction wire ratio vs the fp32 reduce-scatter (bench --comm,
+    # LOWER is better — creeping back toward 1.0 means the compression
+    # regressed), the quantized serve arm's throughput and its worst
+    # per-request parity vs the fp32 arm (bench --serve phase 5), and
+    # the quantized-trunk downstream-eval floor (bench --heads — the
+    # heads_eval_score_min sentinel's quantized sibling).
+    ("comm_quant", "int8_grad_wire_ratio", "comm_bytes_int8_ratio"),
+    ("serve_quant_capture", "quant_requests_per_sec",
+     "serve_quant_requests_per_sec"),
+    ("serve_quant_capture", "parity_max", "serve_quant_parity_max"),
+    ("heads_capture", "eval_score_min_quant",
+     "heads_eval_score_min_quant"),
 )
+
+# Series (by base name, before the /platform suffix) where a LOWER
+# value is the good direction — ratios and error bounds.
+_LOWER_IS_BETTER = {"comm_bytes_int8_ratio", "serve_quant_parity_max"}
+
+
+def series_direction(name: str) -> bool:
+    """higher_is_better for one series key (base name before the
+    platform/fallback suffixes)."""
+    return name.split("/")[0] not in _LOWER_IS_BETTER
 
 
 def series_from_events(path: str,
@@ -197,7 +221,8 @@ def build_verdict(bench_paths: List[str],
     series = series_from_bench_files(bench_paths, errors)
     if events_path and os.path.exists(events_path):
         series.update(series_from_events(events_path, errors))
-    judged = {name: judge_series(values)
+    judged = {name: judge_series(values,
+                                 higher_is_better=series_direction(name))
               for name, values in sorted(series.items())}
     verdicts = [s["verdict"] for s in judged.values()]
     if errors:

@@ -124,6 +124,18 @@ timeout -k 10 420 python "$(dirname "$0")/fleet_drill.py" --json \
 rcfd=$?
 [ "$rc" -eq 0 ] && rc=$rcfd
 
+# Quant smoke (ISSUE 12): tiny int8 ZeRO-1 steps on the 4x2 CPU-virtual
+# mesh vs the replicated fp32 reference + the quantized serve arm.
+# GATED: step-1 loss identity, param deviation within the documented
+# quantization bounds (fp32-payload control isolates harness error),
+# int8 determinism, int8 grad-reduction wire bytes <= 0.30x the fp32
+# reduce-scatter FROM COMPILED HLO, serve-arm parity + weight-bytes
+# ratio, schema-valid quant-tagged events.
+echo "=== quant smoke (int8 reduce-scatter + int8 serve arm, CPU) ==="
+timeout -k 10 420 python "$(dirname "$0")/quant_smoke.py"
+rcq=$?
+[ "$rc" -eq 0 ] && rc=$rcq
+
 # Multi-tenant heads smoke (ISSUE 8 satellite): the platform loop end
 # to end — tiny finetune → register into a head registry → serve one
 # mixed-head micro-batch through the shared trunk → downstream eval.

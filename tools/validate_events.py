@@ -117,6 +117,30 @@ def self_test() -> int:
          "outcome": "collapsed", "stats": {}},  # outcome is drained|aborted
         {"v": 1, "event": "fleet_start", "seq": 0, "t": 0.0,
          "config": {}},  # missing pid
+        # quantized serving arm (ISSUE 12): optional but TYPED fields.
+        {"v": 1, "event": "serve_request", "seq": 0, "t": 0.0,
+         "kind": "embed", "outcome": "ok", "request_id": "r1",
+         "stages": {}, "quant": "int4"},  # not a quant mode
+        {"v": 1, "event": "serve_batch", "seq": 0, "t": 0.0,
+         "kind": "embed", "bucket_len": 256, "rows": 4,
+         "quant": "quantized"},  # not a quant mode
+        {"v": 1, "event": "serve_batch", "seq": 0, "t": 0.0,
+         "kind": "embed", "bucket_len": 256, "rows": 4,
+         "quant": "int8", "quant_parity_max": -0.5},  # must be >= 0
+        {"v": 1, "event": "serve_request", "seq": 0, "t": 0.0,
+         "kind": "embed", "outcome": "ok", "request_id": "r1",
+         "stages": {}, "quant_parity_max": float("inf")},  # finite
+        # the comm_quant capture note (bench --comm): the sentinel's
+        # input series, so its ratio fields are typed + required.
+        {"v": 1, "event": "note", "seq": 0, "t": 0.0,
+         "source": "bench", "kind": "comm_quant"},  # missing ratio
+        {"v": 1, "event": "note", "seq": 0, "t": 0.0,
+         "source": "bench", "kind": "comm_quant",
+         "int8_grad_wire_ratio": 0.0},  # ratio must be > 0
+        {"v": 1, "event": "note", "seq": 0, "t": 0.0,
+         "source": "bench", "kind": "comm_quant",
+         "int8_grad_wire_ratio": 0.27,
+         "bf16_grad_wire_ratio": "half"},  # typed when present
     ]
     for rec in bad:
         try:

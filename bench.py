@@ -741,6 +741,11 @@ def run_pack():
     if int(os.environ.get("PBT_PACK_BENCH_FUSED_AB", 1)):
         fused_ab = _pack_fused_ab(model, ds, batch, failures)
 
+    # ---- attention fused-vs-reference A/B (ISSUE 13 satellite) -------
+    attn_ab = None
+    if int(os.environ.get("PBT_PACK_BENCH_ATTN_AB", 1)):
+        attn_ab = _pack_attn_ab(model, ds, batch, failures)
+
     record = {
         "metric": "packed_throughput",
         "platform": jax.devices()[0].platform,
@@ -752,6 +757,7 @@ def run_pack():
             packed["effective_residues_per_sec"]
             / max(unpacked["effective_residues_per_sec"], 1e-9), 2),
         "fused_ab": fused_ab,
+        "attn_ab": attn_ab,
         "failures": failures,
     }
     try:  # mirror onto the shared bench event stream (best-effort)
@@ -775,6 +781,24 @@ def run_pack():
                     parity_max_abs_diff=fused_ab["parity_max_abs_diff"],
                     pallas_executables=fused_ab["pallas_executables"],
                     segment_fallbacks=fused_ab["segment_fallbacks"],
+                    failures=len(failures))
+        if attn_ab is not None:
+            # The attention-arm capture (ISSUE 13): its speedup feeds
+            # the pack_attn_speedup_x sentinel series, and the packed
+            # step's MFU rides along as the pack_mfu_effective series —
+            # the compound packing × fused-kernels claim, recorded on
+            # whatever platform actually ran (the `platform` field
+            # splits CPU-interpret plumbing numbers from TPU captures).
+            ev.emit("note", source="bench", kind="pack_attn_capture",
+                    platform=record["platform"], seq_len=seq_len,
+                    batch=batch, attn_dim=attn_ab["attn_dim"],
+                    attn_supported=attn_ab["supported"],
+                    attn_speedup_x=attn_ab["attn_speedup_x"],
+                    parity_max_abs_diff=attn_ab["parity_max_abs_diff"],
+                    pallas_executables=attn_ab["pallas_executables"],
+                    segment_fallbacks=attn_ab["segment_fallbacks"],
+                    mfu_raw=packed["mfu_raw"],
+                    mfu_effective=packed["mfu_effective"],
                     failures=len(failures))
         ev.close()
     except Exception as e:
@@ -933,6 +957,146 @@ def _pack_fused_ab(model, ds, batch, failures):
     }
 
 
+def _pack_attn_ab(model, ds, batch, failures):
+    """Attention fused-vs-reference A/B (`bench.py --pack`, ISSUE 13):
+    the SAME packed batch's segment layout drives the ragged Pallas
+    attention kernel (kernels/attention.fused_packed_attention) and the
+    masked-XLA reference (`packed_global_attention_apply`) at a
+    lane-aligned local dim (PBT_PACK_BENCH_ATTN_DIM, default 128 — the
+    kernel needs C % 128 == 0, so the main capture's dim series stays
+    untouched).
+
+    GATED (appended to `failures`, nonzero exit):
+    - fused-vs-reference parity within the documented jitted 1e-5
+      tolerance on the per-segment (B, S, G) attention output;
+    - on a supported shape, the fused arm must take the Pallas path
+      (`attention_kernel_path_total{path=pallas,reason=packed}` bumps)
+      with ZERO reason=segments fallbacks;
+    - the PBT_FORCE_REFERENCE_KERNEL debug override must route a fresh
+      trace onto the reference path (and agree with it bit-for-bit).
+
+    Wall-clock speedup is REPORTED, not gated: off-TPU the kernel runs
+    in interpret mode, so the CPU number is a plumbing check — the TPU
+    capture is the MFU claim (docs/performance.md, packed fast path)."""
+    import jax
+    import jax.numpy as jnp
+
+    from proteinbert_tpu.data import make_packed_iterator
+    from proteinbert_tpu.kernels import attention as ka
+    from proteinbert_tpu.ops.attention import (
+        global_attention_init, packed_global_attention_apply,
+    )
+
+    attn_dim = int(os.environ.get("PBT_PACK_BENCH_ATTN_DIM", 128))
+    reps = int(os.environ.get("PBT_PACK_BENCH_ATTN_REPS", 3))
+    from proteinbert_tpu.kernels import fused_block as fb
+
+    forced_env = fb.force_reference_requested()
+
+    pbatch = next(make_packed_iterator(ds, batch, seed=0))
+    seg = jnp.asarray(pbatch["segment_ids"])
+    B, L = seg.shape
+    S = int(pbatch["annotations"].shape[1])
+    G, key_dim, H = model.global_dim, model.key_dim, model.num_heads
+    params = global_attention_init(jax.random.PRNGKey(0), attn_dim, G,
+                                   key_dim, H)
+    local = jax.random.normal(jax.random.PRNGKey(1), (B, L, attn_dim),
+                              jnp.float32)
+    gseg = jax.random.normal(jax.random.PRNGKey(2), (B, S, G),
+                             jnp.float32)
+    supported = ka.pallas_attention_supported(attn_dim, G, L, S,
+                                              key_dim, H, "float32")
+
+    fused_fn = jax.jit(lambda p, x, g, s: ka.fused_packed_attention(
+        p, x, g, s))
+    ref_fn = jax.jit(lambda p, x, g, s: packed_global_attention_apply(
+        p, x, g, s))
+    before = dict(ka.ATTN_PATH_TOTAL)
+    out_f = jax.block_until_ready(fused_fn(params, local, gseg, seg))
+    after = dict(ka.ATTN_PATH_TOTAL)
+    pallas_bumps = (after.get(("pallas", "packed"), 0)
+                    - before.get(("pallas", "packed"), 0))
+    seg_falls = (after.get(("reference", "segments"), 0)
+                 - before.get(("reference", "segments"), 0))
+    out_r = jax.block_until_ready(ref_fn(params, local, gseg, seg))
+
+    max_diff = float(np.abs(np.asarray(out_f, np.float32)
+                            - np.asarray(out_r, np.float32)).max())
+    if not np.allclose(np.asarray(out_f, np.float32),
+                       np.asarray(out_r, np.float32),
+                       atol=1e-5, rtol=1e-5):
+        failures.append(
+            f"attention fused-vs-reference parity broke: max |diff| "
+            f"{max_diff:.2e} outside the documented 1e-5 jitted "
+            "tolerance")
+    if supported and not forced_env:
+        if pallas_bumps < 1:
+            failures.append(
+                "attention fused arm did not take the Pallas path on a "
+                f"supported shape (C={attn_dim}, L={L}, S={S})")
+        if seg_falls:
+            failures.append(
+                f"{seg_falls} attention reason=segments fallback(s) on "
+                "a supported shape — the packed fast path regressed")
+
+    def clock(fn):
+        jax.block_until_ready(fn(params, local, gseg, seg))
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            jax.block_until_ready(fn(params, local, gseg, seg))
+        return (time.perf_counter() - t0) / reps
+
+    dt_f, dt_r = clock(fused_fn), clock(ref_fn)
+
+    # Debug-override probe (same contract as the fused-block arm): a
+    # fresh jit forces a new trace, so the env var (read at trace
+    # time) must land it on the reference path bit-for-bit.
+    forced = None
+    if not forced_env:
+        os.environ[fb.FORCE_REFERENCE_ENV] = "1"
+        try:
+            b2 = dict(ka.ATTN_PATH_TOTAL)
+            forced_fn = jax.jit(
+                lambda p, x, g, s: ka.fused_packed_attention(p, x, g, s))
+            out_fo = jax.block_until_ready(
+                forced_fn(params, local, gseg, seg))
+            a2 = dict(ka.ATTN_PATH_TOTAL)
+            bumps = (a2.get(("reference", "forced"), 0)
+                     - b2.get(("reference", "forced"), 0))
+            bit = np.array_equal(np.asarray(out_fo), np.asarray(out_r))
+            forced = {"forced_bumps": bumps, "bit_identical": bit}
+            if bumps < 1:
+                failures.append(
+                    "PBT_FORCE_REFERENCE_KERNEL did not route a fresh "
+                    "attention trace onto the reference path")
+            elif not bit:
+                failures.append(
+                    "forced-reference attention probe diverged from "
+                    "the masked-XLA reference arm")
+        finally:
+            del os.environ[fb.FORCE_REFERENCE_ENV]
+
+    return {
+        "attn_dim": attn_dim, "seq_len": L, "max_segments": S,
+        "global_dim": G, "key_dim": key_dim, "num_heads": H,
+        "supported": bool(supported),
+        "pallas_executables": int(pallas_bumps),
+        "segment_fallbacks": int(seg_falls),
+        "parity_max_abs_diff": float(f"{max_diff:.3e}"),
+        "fused_ms_per_fwd": round(dt_f * 1e3, 2),
+        "reference_ms_per_fwd": round(dt_r * 1e3, 2),
+        # Reported, not gated: interpret-mode CPU wall-clock is a
+        # plumbing number, the TPU capture is the claim. Floored at
+        # 1e-3 so the schema's positive-finite contract on the
+        # sentinel series holds even on a pathologically slow
+        # interpret run.
+        "attn_speedup_x": max(round(dt_r / max(dt_f, 1e-9), 3), 1e-3),
+        "forced_reference_probe": forced,
+        "path_total": {f"{p}/{r}": n
+                       for (p, r), n in sorted(ka.ATTN_PATH_TOTAL.items())},
+    }
+
+
 def parse_length_mix(spec):
     """`--serve-length-mix` spec → (median, sigma, seed) for the
     log-normal request-length population (clamped to the model window
@@ -999,10 +1163,13 @@ def _serve_ragged_ab(Server, params, cfg, seqs, max_batch, max_wait_s,
     # Fused-path coverage across the whole A/B (ISSUE 10): under
     # use_pallas, the ragged arms' packed executables must land on the
     # Pallas fast path when the kernel supports the shape — gated
-    # below from the trace-time PATH_TOTAL delta.
+    # below from the trace-time PATH_TOTAL delta. The attention kernel
+    # (ISSUE 13) is gated the same way from ATTN_PATH_TOTAL.
+    from proteinbert_tpu.kernels import attention as _ka
     from proteinbert_tpu.kernels import fused_block as _fb
 
     path_before = dict(_fb.PATH_TOTAL)
+    attn_before = dict(_ka.ATTN_PATH_TOTAL)
     arms = (("bucketed", "bucketed", None),
             ("ragged", "ragged", None),
             ("ragged_dense", "ragged", dense_buckets))
@@ -1153,6 +1320,29 @@ def _serve_ragged_ab(Server, params, cfg, seqs, max_batch, max_wait_s,
                 failures.append(
                     f"ragged A/B under use_pallas: "
                     f"{path_delta[('reference', 'segments')]} "
+                    "reason=segments fallback(s) on a supported shape")
+    # ---- attention fast-path coverage gate (ISSUE 13 acceptance) -----
+    attn_delta = {k: _ka.ATTN_PATH_TOTAL.get(k, 0) - attn_before.get(k, 0)
+                  for k in set(_ka.ATTN_PATH_TOTAL) | set(attn_before)
+                  if _ka.ATTN_PATH_TOTAL.get(k, 0) != attn_before.get(k, 0)}
+    fused_path["attention_delta"] = {
+        f"{p}/{r}": n for (p, r), n in sorted(attn_delta.items())}
+    if cfg.model.use_pallas and not _fb.force_reference_requested():
+        attn_supported = _ka.pallas_attention_supported(
+            cfg.model.local_dim, cfg.model.global_dim, seq_len,
+            servers["ragged"].dispatcher.max_segments,
+            cfg.model.key_dim, cfg.model.num_heads, cfg.model.dtype)
+        fused_path["attention_supported"] = bool(attn_supported)
+        if attn_supported:
+            if attn_delta.get(("pallas", "packed"), 0) < 1:
+                failures.append(
+                    "ragged A/B under use_pallas: no packed executable "
+                    "took the Pallas ATTENTION fast path on a "
+                    "supported shape")
+            if attn_delta.get(("reference", "segments"), 0):
+                failures.append(
+                    f"ragged A/B under use_pallas: "
+                    f"{attn_delta[('reference', 'segments')]} attention "
                     "reason=segments fallback(s) on a supported shape")
     for srv in servers.values():
         srv.drain(timeout=60)

@@ -32,9 +32,10 @@ one-hot lane reduction — exact 0.0 across boundaries, the
 `_segment_conv` semantics), and the per-position global→local
 broadcast is gathered from each position's own segment IN the kernel
 as a (TL, S) @ (S, C) one-hot matmul, so the packed fast path never
-materialises the (B, L, C) broadcast tensor. Scope: the
-weights-resident C <= MAX_PALLAS_DIM regime (`pallas_segments_
-supported`); other shapes fall back to the XLA reference path, counted
+materialises the (B, L, C) broadcast tensor. Beyond C = MAX_PALLAS_DIM
+a channel-tiled SEGMENT variant runs (`_fused_segment_kernel_tiled`,
+ISSUE 13) — ProteinBERT-Large packed rows stay on the fast path.
+Shapes neither plan fits fall back to the XLA reference path, counted
 in `PATH_TOTAL` / `fused_kernel_path_total{path=,reason=}`.
 
 VMEM budget: weights dominate at 2·K·C² + C² activation-dtype bytes
@@ -86,6 +87,8 @@ from jax.ad_checkpoint import checkpoint_name
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from proteinbert_tpu.kernels.path_counter import KernelPathCounter
+
 logger = logging.getLogger(__name__)
 
 Params = Dict[str, jax.Array]
@@ -113,15 +116,16 @@ Params = Dict[str, jax.Array]
 # or any trainer holding a registry) mirror bumps into a registry
 # counter (`fused_kernel_path_total{path=,reason=}`) so fast-path
 # COVERAGE — not just misses — is visible in /metrics, Server.stats()
-# and `pbt diagnose --serve`.
-PATH_TOTAL: Dict[Tuple[str, str], int] = {}
-_PATH_OBSERVERS: List[Callable[[str, str], None]] = []
-
-# One-time warning bookkeeping, keyed by (reason, call-site shape): a
-# server that builds a reference executable for a NEW shape after a
-# fused one must still warn (a process-wide once latch misled there —
-# ISSUE 10 satellite fix).
-_FALLBACK_WARNED: set = set()
+# and `pbt diagnose --serve`. The mechanics (dict, observers, one-time
+# shape-keyed reference warning) live in the shared KernelPathCounter
+# (kernels/path_counter.py) so the attention kernel's counter cannot
+# drift from this one; the module-level API here is kept verbatim.
+_COUNTER = KernelPathCounter("fused local-track kernel",
+                             "fused_kernel_path_total", log=logger)
+PATH_TOTAL: Dict[Tuple[str, str], int] = _COUNTER.total
+# The shape-keyed one-time-warning latch, exposed for tests that reset
+# specific (reason, shape) keys to make warning counts deterministic.
+_FALLBACK_WARNED: set = _COUNTER._warned
 
 # Debug override: force every fused_local_track_segments dispatch onto
 # the XLA reference path. Read at TRACE time — set it before the first
@@ -140,12 +144,11 @@ def force_reference_requested() -> bool:
 def register_path_observer(cb: Callable[[str, str], None]) -> None:
     """`cb(path, reason)` is invoked on every dispatch bump (trace
     time), both fast-path and reference — the coverage feed."""
-    _PATH_OBSERVERS.append(cb)
+    _COUNTER.register(cb)
 
 
 def unregister_path_observer(cb: Callable[[str, str], None]) -> None:
-    if cb in _PATH_OBSERVERS:
-        _PATH_OBSERVERS.remove(cb)
+    _COUNTER.unregister(cb)
 
 
 def note_kernel_path(path: str, reason: str,
@@ -153,22 +156,7 @@ def note_kernel_path(path: str, reason: str,
     """Record one kernel dispatch decision (trace time = once per
     executable). `shape` keys the one-time reference warning per
     (reason, call-site shape)."""
-    if path not in ("pallas", "reference"):
-        raise ValueError(f"path must be 'pallas' or 'reference', "
-                         f"got {path!r}")
-    PATH_TOTAL[(path, reason)] = PATH_TOTAL.get((path, reason), 0) + 1
-    for cb in list(_PATH_OBSERVERS):
-        cb(path, reason)
-    if path != "reference":
-        return
-    warn_key = (reason, shape)
-    if warn_key not in _FALLBACK_WARNED:
-        _FALLBACK_WARNED.add(warn_key)
-        logger.warning(
-            "fused local-track kernel fell back to the XLA reference "
-            "path (reason=%s, shape=%s) — this executable runs without "
-            "the fused fast path; counted in "
-            "fused_kernel_path_total{path=reference}", reason, shape)
+    _COUNTER.note(path, reason, shape)
 
 # Largest feature dim whose weights fit the VMEM budget whole (see
 # module doc); larger dims use the channel-tiled kernel.
@@ -579,9 +567,73 @@ def _fused_kernel_tiled(
                                  dk_ref, db_ref, s2_ref, b2_ref, dtype)
 
 
+def _fused_segment_kernel_tiled(
+    x_ref, oh_ref, bcast_ref,
+    cw_ref, cb_ref,
+    s1_ref, b1_ref, dk_ref, db_ref, s2_ref, b2_ref,
+    out_ref,
+    h_scratch,
+    *, tile, halo, taps, narrow_dilation, wide_dilation, c_tiles,
+    resident,
+):
+    """Channel-tiled SEGMENT body (ISSUE 13 second leg): the same two
+    grid orders and phase layout as `_fused_kernel_tiled`, with the
+    segment one-hot folded in exactly like the weights-resident segment
+    kernel — every tap's shifted operand is masked by the one-hot lane
+    reduction (`_seg_tap_matmuls`), and the finish step's broadcast is
+    the own-segment (TL, S) @ (S, C) one-hot gather instead of the
+    row-wide vector. The one-hot row block and per-segment broadcast
+    ride the b-varying specs (priced in `_plan_tiled(max_segments=)`);
+    nothing column-slices them by the dynamic grid index, so the
+    static-slice rule the dense tiled kernel obeys holds here too."""
+    if resident:
+        c = pl.program_id(1)
+        phase = pl.program_id(2)
+        j = pl.program_id(3)
+        rsel = pl.ds(j * tile, tile)
+    else:
+        j = pl.program_id(1)
+        c = pl.program_id(2)
+        phase = pl.program_id(3)
+        rsel = slice(None)
+    dtype = x_ref.dtype
+    window = x_ref[0, pl.ds(j * tile, tile + 2 * halo), :]
+    oh_window = oh_ref[0, pl.ds(j * tile, tile + 2 * halo), :]
+
+    tc = cw_ref.shape[-1]
+
+    @pl.when(phase == 0)
+    def _narrow():
+        conv = _seg_tap_matmuls(window, oh_window, cw_ref[0], taps,
+                                narrow_dilation, halo, tile)
+        h_scratch[rsel, pl.ds(c * tc, tc)] = _gelu(
+            conv + cb_ref[0, 0].astype(jnp.float32))
+
+    @pl.when(phase == 1)
+    def _wide():
+        conv = _seg_tap_matmuls(window, oh_window, cw_ref[0], taps,
+                                wide_dilation, halo, tile)
+        h_scratch[rsel, pl.ds(c * tc, tc)] += _gelu(
+            conv + cb_ref[0, 0].astype(jnp.float32))
+
+    @pl.when((c == c_tiles - 1) & (phase == 1))
+    def _finish():
+        bcast_pos = lax.dot_general(
+            oh_window[halo:halo + tile], bcast_ref[0],
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        h32 = (h_scratch[rsel, :]
+               + window[halo:halo + tile].astype(jnp.float32)
+               + bcast_pos)
+        out_ref[0] = _finish_row(h32, s1_ref, b1_ref,
+                                 dk_ref, db_ref, s2_ref, b2_ref, dtype)
+
+
 def _plan_tiled(C: int, seq_len: int, dtype,
                 narrow_taps: int = 9, wide_taps: int = 9,
-                wide_dilation: int = 5, resident: bool = False):
+                wide_dilation: int = 5, resident: bool = False,
+                max_segments: int = 0):
     """(c_tile, l_tile) of the widest-channel plan that fits the VMEM
     budget, or (0, 0).
 
@@ -598,11 +650,18 @@ def _plan_tiled(C: int, seq_len: int, dtype,
     fastest, see _fused_kernel_tiled): the only difference is the fp32
     scratch covering the full (seq_len, C) row set instead of one
     (tile, C) row, so a resident plan always fits wherever it exists —
-    the per-row plan is the superset and remains the support gate."""
+    the per-row plan is the superset and remains the support gate.
+
+    `max_segments > 0` prices the SEGMENT variant (ISSUE 13): the
+    (Lp, S) one-hot row block (lane-padded, varies with b → double-
+    buffered), the (S, C) per-segment broadcast block replacing the
+    (1, C) row vector, and the per-tap mask temporaries."""
     if narrow_taps != wide_taps:
         return 0, 0  # the stacked phase layout needs equal tap counts
     itemsize = jnp.dtype(dtype).itemsize
     halo = max((narrow_taps - 1) // 2, (wide_taps - 1) // 2 * wide_dilation)
+    # Mosaic pads the lane dim UP to the next multiple of 128.
+    lanes = -(-max_segments // _LANE) * _LANE if max_segments else 0
     for tc in (512, 256, 128):
         if C % tc:
             continue
@@ -615,7 +674,12 @@ def _plan_tiled(C: int, seq_len: int, dtype,
             out = 2 * tile * C * itemsize                 # varies with (b, j)
             scratch = (seq_len if resident else tile) * C * 4  # fp32 h
             finish = tile * C * (4 + 4 + 4 + itemsize)    # h32, d, h2 f32 + x1
-            if (conv_w + dense + row + out + scratch + finish
+            seg = 0
+            if max_segments:
+                seg = (2 * (seq_len + 2 * halo) * lanes * itemsize  # one-hot
+                       + 2 * max_segments * C * itemsize            # bcast
+                       + tile * lanes * 4)                          # masks
+            if (conv_w + dense + row + out + scratch + finish + seg
                     <= _VMEM_BUDGET):
                 return tc, tile
     return 0, 0
@@ -825,9 +889,11 @@ def pallas_supported(
 #   move, PAPERS.md) — the model passes the tiny per-segment (B, S, C)
 #   tensor and never materialises the (B, L, C) gather on this path.
 #
-# Scope: C <= MAX_PALLAS_DIM with the whole weight set VMEM-resident
-# (the channel-tiled C=1024 variant has no segment form yet — those
-# shapes fall back with reason="segments").
+# Scope: C <= MAX_PALLAS_DIM runs with the whole weight set
+# VMEM-resident; C > MAX_PALLAS_DIM runs the channel-tiled segment
+# variant (`_fused_segment_kernel_tiled` — same one-hot operands over
+# the tiled grid, ISSUE 13), so ProteinBERT-Large packed shapes no
+# longer fall back with reason="segments".
 
 
 def _seg_tap_matmuls(window, oh_window, kernel, taps, dilation, halo,
@@ -896,23 +962,29 @@ def pallas_segments_supported(
     """Whether the SEGMENT kernel handles this packed shape+dtype
     within the VMEM budget (else fused_local_track_segments falls back
     to the XLA reference path with reason="segments"). Versus
-    `pallas_supported`: only the weights-resident C <= MAX_PALLAS_DIM
-    regime (no channel-tiled segment variant), taps must be odd (the
-    symmetric-halo tap layout), and the budget additionally prices the
-    (Lp, S) one-hot row block (lane-padded to 128 on TPU) and the
-    (S, C) per-segment broadcast block."""
-    if (local_dim % _LANE or local_dim > MAX_PALLAS_DIM or seq_len < 8
+    `pallas_supported`: taps must be odd (the symmetric-halo tap
+    layout), and the budget additionally prices the (Lp, S) one-hot
+    row block (lane-padded to 128 on TPU) and the (S, C) per-segment
+    broadcast block. Beyond MAX_PALLAS_DIM the channel-tiled SEGMENT
+    plan (`_plan_tiled(max_segments=)`, ISSUE 13) must find a tile
+    width — ProteinBERT-Large C=1024 packed rows run the fast path."""
+    if (local_dim % _LANE or local_dim > MAX_TILED_DIM or seq_len < 8
             or max_segments < 1):
         return False
     if narrow_taps % 2 == 0 or wide_taps % 2 == 0:
         return False
+    if local_dim > MAX_PALLAS_DIM:
+        return _plan_tiled(local_dim, seq_len, dtype, narrow_taps,
+                           wide_taps, wide_dilation,
+                           max_segments=max_segments)[0] > 0
     itemsize = jnp.dtype(dtype).itemsize
     C = local_dim
     halo = max((narrow_taps - 1) // 2 * narrow_dilation,
                (wide_taps - 1) // 2 * wide_dilation)
     tile = _pick_tile(seq_len)
     Lp = seq_len + 2 * halo
-    lanes = max(max_segments, _LANE)  # Mosaic pads the lane dim
+    # Mosaic pads the lane dim UP to the next multiple of 128.
+    lanes = -(-max_segments // _LANE) * _LANE
     weights = (narrow_taps + wide_taps + 1) * C * C * itemsize
     row = Lp * C * itemsize
     oh_row = Lp * lanes * itemsize
@@ -961,34 +1033,114 @@ def _pallas_segments_forward(
         bytes_accessed=x.size * x.dtype.itemsize * 2,
         transcendentals=3 * B * L * C,
     )
-    grid = (B, L // tile)
-    row_spec = pl.BlockSpec((1, Lp, C), lambda b, j: (b, 0, 0),
+    if C <= MAX_PALLAS_DIM:
+        grid = (B, L // tile)
+        row_spec = pl.BlockSpec((1, Lp, C), lambda b, j: (b, 0, 0),
+                                memory_space=pltpu.VMEM)
+        oh_spec = pl.BlockSpec((1, Lp, S), lambda b, j: (b, 0, 0),
+                               memory_space=pltpu.VMEM)
+        bcast_spec = pl.BlockSpec((1, S, C), lambda b, j: (b, 0, 0),
+                                  memory_space=pltpu.VMEM)
+
+        def whole(a):
+            return pl.BlockSpec(a.shape, lambda b, j: (0,) * a.ndim,
+                                memory_space=pltpu.VMEM)
+
+        kernel = functools.partial(
+            _fused_segment_kernel, tile=tile, halo=halo,
+            narrow_taps=narrow_taps, wide_taps=wide_taps,
+            narrow_dilation=narrow_dilation, wide_dilation=wide_dilation,
+        )
+        return pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[row_spec, oh_spec, bcast_spec]
+                     + [whole(a) for a in inputs[3:]],
+            out_specs=pl.BlockSpec((1, tile, C), lambda b, j: (b, j, 0),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((B, L, C), dtype),
+            cost_estimate=cost,
+            interpret=interpret,
+        )(*inputs)
+
+    # Channel-tiled SEGMENT variant for C > MAX_PALLAS_DIM (ISSUE 13
+    # second leg — ProteinBERT-Large packed rows). Same grid orders as
+    # the dense tiled kernel: prefer weights-resident, fall back to the
+    # per-row scratch order when the full-row fp32 scratch doesn't fit.
+    resident = True
+    tc, tile = _plan_tiled(C, L, dtype, narrow_taps, wide_taps,
+                           wide_dilation, resident=True, max_segments=S)
+    if tc == 0:
+        resident = False
+        tc, tile = _plan_tiled(C, L, dtype, narrow_taps, wide_taps,
+                               wide_dilation, max_segments=S)
+    if tc == 0:  # callers gate via pallas_segments_supported
+        raise ValueError(f"no segment VMEM plan for C={C}, L={L}, S={S}")
+    c_tiles = C // tc
+    if resident:
+        grid = (B, c_tiles, 2, L // tile)  # L tiles fastest
+
+        def imap(f):  # block index from (c, phase, j)
+            return lambda b, c, p, j: f(b, c, p, j)
+    else:
+        grid = (B, L // tile, c_tiles, 2)  # phase (narrow/wide) fastest
+
+        def imap(f):
+            return lambda b, j, c, p: f(b, c, p, j)
+
+    # Both convs stacked on a leading phase axis so each grid step
+    # loads ONE conv's weight slice (see _plan_tiled).
+    conv_w = jnp.stack([inputs[3], inputs[5]])          # (2, taps, C, C)
+    conv_b = jnp.stack([inputs[4], inputs[6]])          # (2, 1, C)
+
+    row_spec = pl.BlockSpec((1, Lp, C), imap(lambda b, c, p, j: (b, 0, 0)),
                             memory_space=pltpu.VMEM)
-    oh_spec = pl.BlockSpec((1, Lp, S), lambda b, j: (b, 0, 0),
+    oh_spec = pl.BlockSpec((1, Lp, S), imap(lambda b, c, p, j: (b, 0, 0)),
                            memory_space=pltpu.VMEM)
-    bcast_spec = pl.BlockSpec((1, S, C), lambda b, j: (b, 0, 0),
+    bcast_spec = pl.BlockSpec((1, S, C), imap(lambda b, c, p, j: (b, 0, 0)),
                               memory_space=pltpu.VMEM)
 
-    def whole(a):
-        return pl.BlockSpec(a.shape, lambda b, j: (0,) * a.ndim,
+    def whole4(a):
+        return pl.BlockSpec(a.shape, lambda *_: (0,) * a.ndim,
                             memory_space=pltpu.VMEM)
 
+    conv_w_spec = pl.BlockSpec((1, narrow_taps, C, tc),
+                               imap(lambda b, c, p, j: (p, 0, 0, c)),
+                               memory_space=pltpu.VMEM)
+    conv_b_spec = pl.BlockSpec((1, 1, tc),
+                               imap(lambda b, c, p, j: (p, 0, c)),
+                               memory_space=pltpu.VMEM)
+
+    in_specs = [
+        row_spec, oh_spec, bcast_spec, conv_w_spec, conv_b_spec,
+        *[whole4(a) for a in inputs[7:]],
+    ]
     kernel = functools.partial(
-        _fused_segment_kernel, tile=tile, halo=halo,
-        narrow_taps=narrow_taps, wide_taps=wide_taps,
+        _fused_segment_kernel_tiled, tile=tile, halo=halo,
+        taps=narrow_taps,
         narrow_dilation=narrow_dilation, wide_dilation=wide_dilation,
+        c_tiles=c_tiles, resident=resident,
     )
+    if resident:
+        # Same out-map pinning as the dense tiled kernel: the output
+        # block index changes only across the finish sweep's j steps,
+        # so exactly the finished blocks are written, once each.
+        def out_map(b, c, p, j):
+            return (b, jnp.where((c == c_tiles - 1) & (p == 1), j, 0), 0)
+    else:
+        out_map = imap(lambda b, c, p, j: (b, j, 0))
     return pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[row_spec, oh_spec, bcast_spec]
-                 + [whole(a) for a in inputs[3:]],
-        out_specs=pl.BlockSpec((1, tile, C), lambda b, j: (b, j, 0),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, tile, C), out_map,
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((B, L, C), dtype),
+        scratch_shapes=[pltpu.VMEM((L if resident else tile, C),
+                                   jnp.float32)],
         cost_estimate=cost,
         interpret=interpret,
-    )(*inputs)
+    )(*inputs[:3], conv_w, conv_b, *inputs[7:])
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))

@@ -1,0 +1,76 @@
+"""Two-sided kernel fast-path accounting shared by the fused-block and
+global-attention dispatches (ISSUE 13 satellite).
+
+Each Pallas kernel family keeps one process-wide `KernelPathCounter`:
+a count of kernel dispatch decisions keyed by `(path, reason)`, bumped
+at TRACE time — once per traced block body, i.e. once per compiled
+executable under `cfg.scan_blocks` (see kernels/fused_block.py module
+docs for why that is the granularity the MFU question needs). Paths
+are "pallas" (the fused kernel ran) and "reference" (the XLA
+composition ran); the reason vocabulary labels WHY/WHAT (dense,
+packed, segments, unsupported_shape, forced).
+
+`register` lets a telemetry owner (serve/server.Server, or any trainer
+holding a registry) mirror bumps into a registry counter
+(`fused_kernel_path_total` / `attention_kernel_path_total`
+`{path=,reason=}`) so fast-path COVERAGE — not just misses — is
+visible in /metrics, Server.stats() and `pbt diagnose --serve`.
+
+Reference dispatches warn ONCE per (reason, call-site shape): a server
+that builds a reference executable for a NEW shape after a fused one
+must still warn (the shape-keyed latch from ISSUE 10)."""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+
+class KernelPathCounter:
+    """Process-wide (path, reason) dispatch counter for one kernel
+    family. `total` is a plain dict so callers can snapshot it with
+    `dict(counter.total)` and diff across a trace (the bench gates)."""
+
+    def __init__(self, kernel_name: str, metric_name: str,
+                 log: Optional[logging.Logger] = None) -> None:
+        self.kernel_name = kernel_name
+        self.metric_name = metric_name
+        # Warnings go through the OWNING module's logger (when given)
+        # so per-family log handlers/filters keep working.
+        self.logger = log or logger
+        self.total: Dict[Tuple[str, str], int] = {}
+        self._observers: List[Callable[[str, str], None]] = []
+        self._warned: set = set()
+
+    def register(self, cb: Callable[[str, str], None]) -> None:
+        """`cb(path, reason)` is invoked on every dispatch bump (trace
+        time), both fast-path and reference — the coverage feed."""
+        self._observers.append(cb)
+
+    def unregister(self, cb: Callable[[str, str], None]) -> None:
+        if cb in self._observers:
+            self._observers.remove(cb)
+
+    def note(self, path: str, reason: str,
+             shape: Optional[tuple] = None) -> None:
+        """Record one kernel dispatch decision (trace time = once per
+        executable). `shape` keys the one-time reference warning per
+        (reason, call-site shape)."""
+        if path not in ("pallas", "reference"):
+            raise ValueError(f"path must be 'pallas' or 'reference', "
+                             f"got {path!r}")
+        self.total[(path, reason)] = self.total.get((path, reason), 0) + 1
+        for cb in list(self._observers):
+            cb(path, reason)
+        if path != "reference":
+            return
+        warn_key = (reason, shape)
+        if warn_key not in self._warned:
+            self._warned.add(warn_key)
+            self.logger.warning(
+                "%s fell back to the XLA reference path (reason=%s, "
+                "shape=%s) — this executable runs without the Pallas "
+                "fast path; counted in %s{path=reference}",
+                self.kernel_name, reason, shape, self.metric_name)

@@ -183,7 +183,12 @@ def block_apply(
 
     # Global track (reference modules.py:219-229) — per segment when
     # packed: every dense/LN is feature-last and shape-agnostic over the
-    # leading (B, S) axes, only attention needs the segment mask.
+    # leading (B, S) axes, only attention needs the segment mask. Under
+    # use_pallas attention routes through the ragged Pallas kernel
+    # (kernels/attention.py, ISSUE 13) on supported shapes — packed AND
+    # dense, so bucketed serving and unpacked training share it — with
+    # the masked-XLA reference as fallback; every dispatch is counted
+    # in attention_kernel_path_total{path=,reason=}.
     dense1 = jax.nn.gelu(dense_apply(params["global_dense1"], global_))
     if packed:
         # pad_mask is the REAL-token mask: for training packs it equals
@@ -191,9 +196,21 @@ def block_apply(
         # there; the ragged serving path packs bucket-quantized spans
         # with <pad> tails and passes tokens != PAD_ID, which must be
         # excluded from the softmax like the bucketed path excludes it.
-        attn = packed_global_attention_apply(
-            params["attention"], local, global_, segment_ids,
-            real_mask=pad_mask)
+        if cfg.use_pallas:
+            from proteinbert_tpu.kernels import fused_packed_attention
+
+            attn = fused_packed_attention(
+                params["attention"], local, global_, segment_ids,
+                real_mask=pad_mask)
+        else:
+            attn = packed_global_attention_apply(
+                params["attention"], local, global_, segment_ids,
+                real_mask=pad_mask)
+    elif cfg.use_pallas:
+        from proteinbert_tpu.kernels import fused_global_attention
+
+        attn = fused_global_attention(
+            params["attention"], local, global_, pad_mask)
     else:
         attn = global_attention_apply(
             params["attention"], local, global_, pad_mask)

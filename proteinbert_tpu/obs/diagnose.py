@@ -289,6 +289,7 @@ def summarize_serve(records: List[Dict[str, Any]],
             "count": end_stats.get("executables"),
             "warmup_seconds": end_stats.get("warmup_seconds"),
             "fused_path": end_stats.get("fused_path"),
+            "attention_path": end_stats.get("attention_path"),
             "fused_fallback": end_stats.get("fused_fallback"),
         }
 
@@ -376,18 +377,22 @@ def render_serve(summary: Dict[str, Any]) -> str:
             f"executables: {ex['count']} warm "
             f"(mode {ex.get('serve_mode')}, warmup "
             f"{ex.get('warmup_seconds')}s)")
-        fp = ex.get("fused_path") or {}
-        if fp:
-            pallas = sum(n for k, n in fp.items()
+        for stats_key, label in (("fused_path", "fused-kernel"),
+                                 ("attention_path", "attention-kernel")):
+            cov = ex.get(stats_key) or {}
+            if not cov:
+                continue
+            pallas = sum(n for k, n in cov.items()
                          if k.startswith("pallas/"))
-            ref = sum(n for k, n in fp.items()
+            ref = sum(n for k, n in cov.items()
                       if k.startswith("reference/"))
             lines.append(
-                f"  fused-kernel coverage: {pallas} executable(s) on "
+                f"  {label} coverage: {pallas} executable(s) on "
                 f"the Pallas fast path, {ref} on the XLA reference")
-            for key, n in sorted(fp.items()):
+            for key, n in sorted(cov.items()):
                 lines.append(f"    {key}: {n}")
-        else:
+        fp = ex.get("fused_path") or {}
+        if not fp:
             # Pre-ISSUE-10 stats snapshots: one-sided fallback view.
             fb = ex.get("fused_fallback") or {}
             for reason, n in sorted(fb.items()):

@@ -389,6 +389,28 @@ def validate_record(rec: Any) -> None:
                 raise ValueError(
                     f"note(kind=comm_quant).{name} must be a positive "
                     f"finite number, got {v!r}")
+    if event == "note" and rec.get("kind") == "pack_attn_capture":
+        # The ragged-attention A/B capture (bench.py --pack, ISSUE 13):
+        # its speedup/MFU fields feed trajectory-sentinel series, so a
+        # writer bug must fail validation, not poison the series.
+        v = rec.get("attn_speedup_x")
+        if v is None:
+            raise ValueError(
+                "note(kind=pack_attn_capture): missing required field "
+                "'attn_speedup_x'")
+        if (isinstance(v, bool) or not isinstance(v, (int, float))
+                or not math.isfinite(v) or v <= 0):
+            raise ValueError(
+                f"note(kind=pack_attn_capture).attn_speedup_x must be "
+                f"a positive finite number, got {v!r}")
+        for name in ("mfu_effective", "mfu_raw", "parity_max_abs_diff"):
+            v = rec.get(name)
+            if v is not None and (isinstance(v, bool)
+                                  or not isinstance(v, (int, float))
+                                  or not math.isfinite(v) or v < 0):
+                raise ValueError(
+                    f"note(kind=pack_attn_capture).{name} must be a "
+                    f"non-negative finite number, got {v!r}")
 
 
 def make_example(event: str) -> Dict[str, Any]:

@@ -267,33 +267,50 @@ class Server:
         self._mirror_lock = threading.Lock()
         self.truncated_total = 0
         self.rejected_total = {r: 0 for r in self._rej_c}
-        # Fused-kernel fast-path COVERAGE (ISSUE 10 satellite): mirror
-        # kernels/fused_block dispatch bumps — both the Pallas fast
-        # path and the XLA reference path — into the registry as
-        # fused_kernel_path_total{path=,reason=}, so /metrics, stats()
-        # and `pbt diagnose --serve` show how many compiled shapes run
-        # the fast path, not just the misses. (The one-release
-        # deprecated fused_kernel_fallback_total mirror was removed in
-        # ISSUE 12, as PR 9 scheduled.) Registered LAST — after every
-        # raising statement above — so a failed construction (bad SLO
-        # spec, trunk-mismatched head) cannot leak a process-global
-        # observer; drain()/abort() unregister it.
+        # Kernel fast-path COVERAGE (ISSUEs 10/13): mirror the
+        # kernels/fused_block AND kernels/attention dispatch bumps —
+        # both the Pallas fast path and the XLA reference path — into
+        # the registry as fused_kernel_path_total{path=,reason=} /
+        # attention_kernel_path_total{path=,reason=}, so /metrics,
+        # stats() and `pbt diagnose --serve` show how many compiled
+        # shapes run the fast path, not just the misses. (The
+        # one-release deprecated fused_kernel_fallback_total mirror was
+        # removed in ISSUE 12, as PR 9 scheduled.) Registered LAST —
+        # after every raising statement above — so a failed
+        # construction (bad SLO spec, trunk-mismatched head) cannot
+        # leak a process-global observer; drain()/abort() unregister
+        # them.
+        from proteinbert_tpu.kernels.attention import (
+            register_attention_path_observer,
+        )
         from proteinbert_tpu.kernels.fused_block import (
             register_path_observer,
         )
 
         self._path_c: Dict[Any, Any] = {}
 
-        def _mirror_path(path: str, reason: str,
-                         _metrics=metrics, _c=self._path_c) -> None:
-            c = _c.get((path, reason))
+        # Bind metrics + the counter dict via default args, NOT self: a
+        # Server abandoned without drain()/abort() must leak only this
+        # small dict through the process-global observer lists, never
+        # the params/dispatcher it would pin via a bound method.
+        def _mirror(name: str, path: str, reason: str,
+                    _metrics=metrics, _c=self._path_c) -> None:
+            c = _c.get((name, path, reason))
             if c is None:
-                c = _c[(path, reason)] = _metrics.counter(
-                    "fused_kernel_path_total", path=path, reason=reason)
+                c = _c[(name, path, reason)] = _metrics.counter(
+                    name, path=path, reason=reason)
             c.inc()
 
+        def _mirror_path(path: str, reason: str) -> None:
+            _mirror("fused_kernel_path_total", path, reason)
+
+        def _mirror_attn_path(path: str, reason: str) -> None:
+            _mirror("attention_kernel_path_total", path, reason)
+
         self._path_cb = _mirror_path
+        self._attn_path_cb = _mirror_attn_path
         register_path_observer(self._path_cb)
+        register_attention_path_observer(self._attn_path_cb)
 
     def _bump(self, mirror: str, reason: Optional[str] = None) -> None:
         with self._mirror_lock:
@@ -403,11 +420,15 @@ class Server:
         return done
 
     def _release_path_observer(self) -> None:
+        from proteinbert_tpu.kernels.attention import (
+            unregister_attention_path_observer,
+        )
         from proteinbert_tpu.kernels.fused_block import (
             unregister_path_observer,
         )
 
         unregister_path_observer(self._path_cb)
+        unregister_attention_path_observer(self._attn_path_cb)
 
     def abort(self) -> None:
         """Hard shutdown: fail all queued + pending work with
@@ -725,6 +746,7 @@ class Server:
                 "truncated": self.truncated_total,
                 "rejected": dict(self.rejected_total),
             }
+        from proteinbert_tpu.kernels.attention import ATTN_PATH_TOTAL
         from proteinbert_tpu.kernels.fused_block import PATH_TOTAL
 
         qw = self.scheduler.queue_wait
@@ -744,6 +766,11 @@ class Server:
             # the XLA composition (ISSUE 10 two-sided counter).
             "fused_path": {f"{p}/{r}": n
                            for (p, r), n in sorted(PATH_TOTAL.items())},
+            # Same two-sided coverage for the ragged attention kernel
+            # (kernels/attention.py, ISSUE 13).
+            "attention_path": {f"{p}/{r}": n
+                               for (p, r), n
+                               in sorted(ATTN_PATH_TOTAL.items())},
             # Quantized executable arm (ISSUE 12): which arm serves,
             # the measured weight-HBM footprint, and the worst sampled
             # parity deviation vs the fp32 shadow (None = fp32 arm).

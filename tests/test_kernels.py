@@ -116,9 +116,14 @@ def test_pallas_segments_supported_gating():
     assert pallas_segments_supported(128, 256, 8, "float32")
     assert pallas_segments_supported(512, 512, 8)       # base config, bf16
     assert not pallas_segments_supported(96, 256, 8)    # non-lane-aligned C
-    # No channel-tiled segment variant yet: Large C=1024 falls back
-    # (reason="segments") even though the dense kernel supports it.
-    assert not pallas_segments_supported(1024, 512, 8)
+    # Channel-tiled SEGMENT variant (ISSUE 13): Large C=1024 packed
+    # rows now run the fast path instead of falling back with
+    # reason="segments"…
+    assert pallas_segments_supported(1024, 512, 8)
+    # …but the fp32 tiled plan still has no room, like the dense one,
+    # and nothing exceeds MAX_TILED_DIM.
+    assert not pallas_segments_supported(1024, 512, 8, "float32")
+    assert not pallas_segments_supported(4096, 512, 8)
     assert not pallas_segments_supported(512, 512, 8, "float32")  # VMEM
     assert not pallas_segments_supported(128, 4, 2)     # seq too short
     assert not pallas_segments_supported(128, 256, 0)   # no segments
@@ -260,6 +265,161 @@ def test_tiled_prehaloed_parity(key):
     want = local_track_valid_reference(params, xh, bcast, 1, 5
                                        ).astype(jnp.float32)
     assert got.shape == (1, 64, 1024)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=0.05, atol=0.05)
+
+
+# ------------------------------- channel-tiled SEGMENT variant (ISSUE 13)
+# The C>512 packed fast path: same grid orders as the dense tiled
+# kernel, segment one-hot operands folded in. Shapes here mirror the
+# dense tiled tier so interpret cost stays bounded.
+
+def _make_segment_inputs(key, B=1, L=128, C=1024, S=4,
+                         dtype=jnp.bfloat16):
+    params, x, _ = _make_inputs(key, B=B, L=L, C=C, dtype=dtype)
+    bc = jax.random.normal(jax.random.PRNGKey(11), (B, S, C), dtype)
+    rng = np.random.default_rng(5)
+    seg = np.zeros((B, L), np.int32)
+    for b in range(B):
+        pos = 0
+        for sid in range(1, S + 1):
+            ln = int(rng.integers(8, max(9, L // S)))
+            if pos + ln > L:
+                break
+            seg[b, pos:pos + ln] = sid
+            pos += ln
+    return params, x, bc, jnp.asarray(seg)
+
+
+def test_tiled_segment_forward_parity_c1024(key):
+    """Large-config C=1024 PACKED rows run the channel-tiled segment
+    kernel instead of falling back with reason=segments (ISSUE 13
+    acceptance). bf16 like the dense tiled tier (fp32 has no plan)."""
+    from proteinbert_tpu.kernels import (
+        fused_local_track_segments, gather_segment_broadcast,
+        local_track_segment_reference, pallas_segments_supported,
+    )
+    from proteinbert_tpu.kernels import fused_block as fb
+
+    params, x, bc, seg = _make_segment_inputs(key)
+    assert pallas_segments_supported(1024, 128, 4)
+    before = dict(fb.PATH_TOTAL)
+    got = fused_local_track_segments(params, x, bc, seg, 1, 5, True
+                                     ).astype(jnp.float32)
+    assert (fb.PATH_TOTAL.get(("pallas", "packed"), 0)
+            > before.get(("pallas", "packed"), 0))
+    assert (fb.PATH_TOTAL.get(("reference", "segments"), 0)
+            == before.get(("reference", "segments"), 0))
+    want = local_track_segment_reference(
+        params, x, gather_segment_broadcast(bc, seg), seg, 1, 5
+    ).astype(jnp.float32)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=0.05, atol=0.05)
+
+
+def test_tiled_segment_multi_l_tiles_and_batch(key):
+    """Multiple L tiles AND batch entries with a segment boundary at
+    the tile edge: the fp32 scratch row must be fully overwritten per
+    step and the one-hot masks must track the (b, j) window."""
+    from proteinbert_tpu.kernels import (
+        fused_local_track_segments, gather_segment_broadcast,
+        local_track_segment_reference,
+    )
+
+    params, x, _, _ = _make_segment_inputs(key, B=2, L=256)
+    bc = jax.random.normal(jax.random.PRNGKey(12), (2, 3, 1024),
+                           jnp.bfloat16)
+    seg = np.zeros((2, 256), np.int32)
+    seg[0, :128] = 1
+    seg[0, 128:220] = 2   # boundary exactly at the 128 tile edge
+    seg[1, :100] = 1
+    seg[1, 100:256] = 3
+    seg = jnp.asarray(seg)
+    got = fused_local_track_segments(params, x, bc, seg, 1, 5, True
+                                     ).astype(jnp.float32)
+    want = local_track_segment_reference(
+        params, x, gather_segment_broadcast(bc, seg), seg, 1, 5
+    ).astype(jnp.float32)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=0.05, atol=0.05)
+
+
+def test_tiled_segment_gradient_parity(key):
+    """The existing custom VJP wraps whichever forward variant runs —
+    the tiled segment path must keep the rematerialised oh-reference
+    backward contract."""
+    from proteinbert_tpu.kernels import fused_block as fb
+
+    params, x, bc, seg = _make_segment_inputs(key, L=64)
+
+    def f_fused(p, xx, bb):
+        return (fb.fused_local_track_segments(p, xx, bb, seg, 1, 5, True)
+                .astype(jnp.float32).sum())
+
+    def f_ref(p, xx, bb):
+        return (fb.local_track_segment_reference(
+            p, xx, fb.gather_segment_broadcast(bb, seg), seg, 1, 5)
+            .astype(jnp.float32).sum())
+
+    g_fused = jax.grad(f_fused, argnums=(0, 1, 2))(params, x, bc)
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(params, x, bc)
+    for a, b in zip(jax.tree.leaves(g_fused), jax.tree.leaves(g_ref)):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            rtol=0.05, atol=0.1)
+
+
+def test_tiled_segment_plan_details():
+    from proteinbert_tpu.kernels.fused_block import _plan_tiled
+
+    # Large preset packed: the per-row order has a plan at L=512; the
+    # weights-resident order's full-row fp32 scratch only fits once
+    # the one-hot/bcast extras shrink with L (the forward prefers
+    # resident when it fits, per-row otherwise — both orders run).
+    assert _plan_tiled(1024, 512, "bfloat16",
+                       max_segments=8) == (128, 128)
+    assert _plan_tiled(1024, 512, "bfloat16", resident=True,
+                       max_segments=8)[0] == 0
+    assert _plan_tiled(1024, 128, "bfloat16", resident=True,
+                       max_segments=4) == (128, 128)
+    # Long rows: only the per-row order fits (same shape family as the
+    # dense tier's per-row case).
+    assert _plan_tiled(640, 2048, "bfloat16", resident=True,
+                       max_segments=16)[0] == 0
+    assert _plan_tiled(640, 2048, "bfloat16",
+                       max_segments=16) == (128, 128)
+    # The one-hot/bcast price is real: a plan that fits dense can still
+    # refuse segments when S is enormous.
+    assert _plan_tiled(1024, 512, "bfloat16")[0] > 0
+    assert _plan_tiled(1024, 512, "bfloat16", max_segments=4096)[0] == 0
+
+
+def test_tiled_segment_per_row_order_parity(key):
+    """C=640/L=2048 has no weights-resident segment plan (full-row
+    scratch blows VMEM) — exercises the per-row fallback grid order of
+    the SEGMENT kernel."""
+    from proteinbert_tpu.kernels import (
+        fused_local_track_segments, gather_segment_broadcast,
+        local_track_segment_reference, pallas_segments_supported,
+    )
+    from proteinbert_tpu.kernels.fused_block import _plan_tiled
+
+    assert _plan_tiled(640, 2048, "bfloat16", resident=True,
+                       max_segments=2)[0] == 0
+    assert pallas_segments_supported(640, 2048, 2)
+    params, x, _ = _make_inputs(key, B=1, L=2048, C=640,
+                                dtype=jnp.bfloat16)
+    bc = jax.random.normal(jax.random.PRNGKey(13), (1, 2, 640),
+                           jnp.bfloat16)
+    seg = np.zeros((1, 2048), np.int32)
+    seg[0, :1200] = 1
+    seg[0, 1200:2000] = 2
+    seg = jnp.asarray(seg)
+    got = fused_local_track_segments(params, x, bc, seg, 1, 5, True
+                                     ).astype(jnp.float32)
+    want = local_track_segment_reference(
+        params, x, gather_segment_broadcast(bc, seg), seg, 1, 5
+    ).astype(jnp.float32)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=0.05, atol=0.05)
 

@@ -516,6 +516,7 @@ class TestFusedPathCounter:
         assert len(warnings) == 2
 
     def test_server_mirrors_path_into_registry(self, trunk):
+        from proteinbert_tpu.kernels import attention as ka
         from proteinbert_tpu.kernels import fused_block as fb
         from proteinbert_tpu.obs import Telemetry
 
@@ -526,25 +527,39 @@ class TestFusedPathCounter:
                      telemetry=tele)
         fb.note_kernel_path("reference", "segments", ("test-shape",))
         fb.note_kernel_path("pallas", "packed", ("test-shape",))
+        # The attention counter mirrors alongside (ISSUE 13 satellite).
+        ka.note_attention_path("pallas", "packed", ("test-shape",))
+        ka.note_attention_path("reference", "segments", ("test-shape",))
         c_ref = tele.metrics.counter("fused_kernel_path_total",
                                      path="reference", reason="segments")
         c_pal = tele.metrics.counter("fused_kernel_path_total",
                                      path="pallas", reason="packed")
+        a_ref = tele.metrics.counter("attention_kernel_path_total",
+                                     path="reference", reason="segments")
+        a_pal = tele.metrics.counter("attention_kernel_path_total",
+                                     path="pallas", reason="packed")
         assert c_ref.value == 1 and c_pal.value == 1
+        assert a_ref.value == 1 and a_pal.value == 1
         stats = srv.stats()
         assert stats["fused_path"]["reference/segments"] >= 1
         assert stats["fused_path"]["pallas/packed"] >= 1
+        assert stats["attention_path"]["pallas/packed"] >= 1
+        assert stats["attention_path"]["reference/segments"] >= 1
         # The deprecated one-sided stats mirror is gone (ISSUE 12).
         assert "fused_fallback" not in stats
         srv.drain(timeout=10)
         fb.note_kernel_path("pallas", "packed")  # observer released
+        ka.note_attention_path("pallas", "packed")
         assert c_pal.value == 1
+        assert a_pal.value == 1
 
     def test_ragged_packed_takes_pallas_path(self):
-        """THE ragged-serve fast-path smoke (ISSUE 10 acceptance): on a
-        shape the segment kernel supports, the packed executable the
-        ragged dispatcher builds must land on the Pallas path — zero
-        reason=segments fallbacks."""
+        """THE ragged-serve fast-path smoke (ISSUE 10/13 acceptance):
+        on a shape the kernels support, the packed executable the
+        ragged dispatcher builds must land on the Pallas path for BOTH
+        the fused local track and the ragged attention — zero
+        reason=segments fallbacks on either counter."""
+        from proteinbert_tpu.kernels import attention as ka
         from proteinbert_tpu.kernels import fused_block as fb
 
         pcfg = PretrainConfig(
@@ -559,15 +574,23 @@ class TestFusedPathCounter:
             checkpoint=CheckpointConfig(),
         )
         assert fb.pallas_segments_supported(128, SEQ_LEN, 4, "float32")
+        assert ka.pallas_attention_supported(128, 32, SEQ_LEN, 4, 8, 2,
+                                             "float32")
         params = create_train_state(jax.random.PRNGKey(0), pcfg).params
         disp = RaggedDispatcher(params, pcfg, rows_per_batch=2,
                                 max_segments=4)
         before = dict(fb.PATH_TOTAL)
+        attn_before = dict(ka.ATTN_PATH_TOTAL)
         assert disp.warmup(("embed",)) == 1
         delta = {k: fb.PATH_TOTAL.get(k, 0) - before.get(k, 0)
                  for k in fb.PATH_TOTAL}
         assert delta.get(("pallas", "packed"), 0) >= 1
         assert delta.get(("reference", "segments"), 0) == 0
+        attn_delta = {k: ka.ATTN_PATH_TOTAL.get(k, 0)
+                      - attn_before.get(k, 0)
+                      for k in ka.ATTN_PATH_TOTAL}
+        assert attn_delta.get(("pallas", "packed"), 0) >= 1
+        assert attn_delta.get(("reference", "segments"), 0) == 0
 
 
 class TestRaggedMesh:

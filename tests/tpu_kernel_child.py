@@ -55,7 +55,48 @@ def main() -> None:
         local_track_reference(params, x, bcast, 1, 5).astype(jnp.float32))
     err = float(np.max(np.abs(got - want)))
     np.testing.assert_allclose(got, want, rtol=0.05, atol=0.05)
-    print(f"PARITY OK {err:.6f} (resident plan tc={tc} tile={tile})")
+
+    # ISSUE 13: the tiled SEGMENT variant and the ragged attention
+    # kernel through Mosaic on the same chip — interpret mode is the
+    # tier-1 oracle, the hardware run is the lowering proof (the
+    # attention kernel's A·Bᵀ / Aᵀ·B dot_generals and the segment
+    # kernel's one-hot operands must actually lower).
+    from proteinbert_tpu.kernels import (
+        fused_local_track_segments, fused_packed_attention,
+        gather_segment_broadcast, local_track_segment_reference,
+    )
+    from proteinbert_tpu.ops.attention import (
+        global_attention_init, packed_global_attention_apply,
+    )
+
+    S = 4
+    seg = np.zeros((B, L), np.int32)
+    for b in range(B):
+        seg[b, : L // 2] = 1
+        seg[b, L // 2 : L - 30] = 2
+    seg = jnp.asarray(seg)
+    bc_seg = jax.random.normal(jax.random.PRNGKey(7), (B, S, C),
+                               jnp.bfloat16)
+    got_s = np.asarray(fused_local_track_segments(
+        params, x, bc_seg, seg, 1, 5, False).astype(jnp.float32))
+    want_s = np.asarray(local_track_segment_reference(
+        params, x, gather_segment_broadcast(bc_seg, seg), seg, 1, 5
+    ).astype(jnp.float32))
+    err_s = float(np.max(np.abs(got_s - want_s)))
+    np.testing.assert_allclose(got_s, want_s, rtol=0.05, atol=0.05)
+
+    aparams = global_attention_init(jax.random.PRNGKey(8), C, 64, 16, 4)
+    gseg = jax.random.normal(jax.random.PRNGKey(9), (B, S, 64),
+                             jnp.bfloat16)
+    got_a = np.asarray(fused_packed_attention(
+        aparams, x, gseg, seg, interpret=False).astype(jnp.float32))
+    want_a = np.asarray(packed_global_attention_apply(
+        aparams, x, gseg, seg).astype(jnp.float32))
+    err_a = float(np.max(np.abs(got_a - want_a)))
+    np.testing.assert_allclose(got_a, want_a, rtol=0.05, atol=0.05)
+
+    print(f"PARITY OK {err:.6f} (resident plan tc={tc} tile={tile}) "
+          f"segment {err_s:.6f} attention {err_a:.6f}")
 
 
 if __name__ == "__main__":

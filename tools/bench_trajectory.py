@@ -154,6 +154,14 @@ _EVENT_METRICS = (
     # wall-clock on the packed A/B arm (interpret-mode plumbing number
     # on CPU, the real kernel on TPU — platform-split like the rest).
     ("pack_fused_capture", "fused_speedup_x", "pack_fused_speedup_x"),
+    # Ragged Pallas attention (ISSUE 13): the attention A/B arm's
+    # wall-clock ratio, and the packed train step's pad-adjusted MFU —
+    # the packing × fused-kernels compound claim as a sentinel series
+    # (CPU-interpret points and TPU hardware points are separate
+    # series via the platform split, so the honest CPU numbers never
+    # masquerade as the hardware capture).
+    ("pack_attn_capture", "attn_speedup_x", "pack_attn_speedup_x"),
+    ("pack_attn_capture", "mfu_effective", "pack_mfu_effective"),
     # Multi-tenant heads (ISSUE 8): mixed-head throughput + the WORST
     # normalized downstream-eval score across heads — finetune-quality
     # regressions gate through the same sentinel as perf.

@@ -85,7 +85,9 @@ rcr=$?
 
 # Packed fused fast-path smoke (ISSUE 10 satellite): a tiny packed
 # batch through the segment-aware Pallas kernel at a lane-aligned dim
-# (the bench --pack fused A/B arm). GATED: fused-vs-reference parity
+# (the bench --pack fused A/B arm — which since ISSUE 13 ALSO runs the
+# attention fused-vs-reference arm and emits its pack_attn_capture
+# note under the same gates). GATED: fused-vs-reference parity
 # within the documented 1e-5 jitted tolerance, supported shapes take
 # the Pallas path with ZERO reason=segments fallbacks, and the
 # PBT_FORCE_REFERENCE_KERNEL debug override (documented in
@@ -100,6 +102,19 @@ timeout -k 10 420 env JAX_PLATFORMS=cpu \
   python "$(dirname "$0")/../bench.py" --pack
 rcf=$?
 [ "$rc" -eq 0 ] && rc=$rcf
+
+# Packed attention smoke (ISSUE 13): the ragged Pallas attention
+# kernel and the tiled-segment fused block through their real dispatch
+# entries on tiny shapes. GATED: packed/dense/serving-real_mask parity
+# within the documented 1e-5 jitted tolerance, custom-VJP gradient
+# parity, supported shapes take the Pallas path with ZERO
+# reason=segments fallbacks (attention AND the C=1024 tiled segment
+# fused block), PBT_FORCE_REFERENCE_KERNEL routes attention onto the
+# reference path, and the pack_attn_capture note schema round-trips.
+echo "=== packed attention smoke (Pallas attention + tiled segment, CPU) ==="
+timeout -k 10 420 python "$(dirname "$0")/attn_smoke.py"
+rca=$?
+[ "$rc" -eq 0 ] && rc=$rca
 
 # Reshard smoke (ISSUE 11): save a tiny ZeRO-1 train state on a 4x2
 # CPU-virtual mesh, reshard 4x2 -> 8x1 -> 1 -> 4x2 through the real

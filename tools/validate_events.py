@@ -141,6 +141,21 @@ def self_test() -> int:
          "source": "bench", "kind": "comm_quant",
          "int8_grad_wire_ratio": 0.27,
          "bf16_grad_wire_ratio": "half"},  # typed when present
+        # the pack_attn_capture note (bench --pack attention arm,
+        # ISSUE 13): sentinel-input fields are typed + required.
+        {"v": 1, "event": "note", "seq": 0, "t": 0.0,
+         "source": "bench", "kind": "pack_attn_capture"},  # no speedup
+        {"v": 1, "event": "note", "seq": 0, "t": 0.0,
+         "source": "bench", "kind": "pack_attn_capture",
+         "attn_speedup_x": 0.0},  # speedup must be > 0
+        {"v": 1, "event": "note", "seq": 0, "t": 0.0,
+         "source": "bench", "kind": "pack_attn_capture",
+         "attn_speedup_x": 1.1,
+         "mfu_effective": -0.2},  # MFU must be >= 0 when present
+        {"v": 1, "event": "note", "seq": 0, "t": 0.0,
+         "source": "bench", "kind": "pack_attn_capture",
+         "attn_speedup_x": 1.1,
+         "parity_max_abs_diff": float("nan")},  # finite when present
     ]
     for rec in bad:
         try:

@@ -26,6 +26,9 @@ is one console with subcommands:
   predict-residues   fill '?'-masked residues, report per-position probs
   serve              online JSON/HTTP inference server (continuous
                      micro-batching over length buckets, docs/serving.md)
+  map                resumable sharded batch inference: corpus → content-
+                     addressed embedding store with checkpointed shard
+                     cursors (--verify audits it; docs/mapping.md)
 
 Cluster sharding (reference C17 parity): create-uniref-db reads
 --task-index/--task-count or SLURM array env vars (utils/sharding.py) and
@@ -818,7 +821,8 @@ def cmd_diagnose(args) -> int:
     artifacts can be copied."""
     from proteinbert_tpu.obs import read_events, validate_flight_dump
     from proteinbert_tpu.obs.diagnose import (
-        render, render_serve, summarize, summarize_serve,
+        render, render_map, render_serve, summarize, summarize_map,
+        summarize_serve,
     )
 
     records = read_events(args.events)
@@ -832,25 +836,35 @@ def cmd_diagnose(args) -> int:
             validate_flight_dump(flight)
         except ValueError as e:
             raise SystemExit(f"{args.flight} is not a valid flight dump: {e}")
-    # The serve section renders when asked for (--serve) or when the
-    # stream carries serving records (a mixed stream shows both).
+    # The serve/map sections render when asked for (--serve/--map) or
+    # when the stream carries their records (a mixed stream shows all).
     has_serve = any(r["event"].startswith("serve_") for r in records)
     if args.serve and not has_serve:
         raise SystemExit(f"--serve: no serve_* records in {args.events}")
+    has_map = any(r["event"].startswith("map_") for r in records)
+    if args.map and not has_map:
+        raise SystemExit(f"--map: no map_* records in {args.events}")
     serve_summary = (summarize_serve(records, slow_top=args.slow_top)
                      if has_serve else None)
+    map_summary = summarize_map(records) if has_map else None
     summary = summarize(records, flight=flight,
                         slow_top=args.slow_top, last=args.last)
     if serve_summary is not None:
         summary["serve"] = serve_summary
+    if map_summary is not None:
+        summary["map"] = map_summary
     if args.json:
         print(json.dumps(summary))
     elif args.serve:
         print(render_serve(serve_summary))
+    elif args.map:
+        print(render_map(map_summary))
     else:
         print(render(summary))
         if serve_summary is not None:
             print(render_serve(serve_summary))
+        if map_summary is not None:
+            print(render_map(map_summary))
     return 0
 
 
@@ -1290,6 +1304,100 @@ def cmd_serve(args) -> int:
     return 0
 
 
+def cmd_map(args) -> int:
+    """Resumable sharded batch inference (ISSUE 14 tentpole): stream a
+    corpus through the ragged packed trunk into a content-addressed,
+    integrity-verified embedding store (proteinbert_tpu/mapper/).
+    Kill-anywhere semantics: every shard has a crash-safe cursor
+    advanced only after its block is durably on disk, so a SIGKILL
+    resumes with at most one block of re-work per shard. `--verify`
+    recomputes every block digest and reports corruption/holes — it
+    needs only the store, no model or jax. docs/mapping.md has the
+    run/resume/verify lifecycle and the failure matrix."""
+    from proteinbert_tpu.mapper import (
+        StoreConfigError, StoreError, verify_store,
+    )
+
+    if args.verify:
+        try:
+            report = verify_store(args.store)
+        except StoreConfigError as e:
+            raise SystemExit(f"--verify: {e}")
+        print(json.dumps(report))
+        if not report["ok"]:
+            problems = []
+            for rec in report["corrupt"]:
+                problems.append(
+                    f"corrupt block shard {rec['shard']} block "
+                    f"{rec['block']} ({rec['reason']}, "
+                    f"{rec['digest'][:16]}…)")
+            for rec in report["holes"]:
+                problems.append(
+                    f"hole: shard {rec['shard']} block {rec['block']} "
+                    f"object {rec['digest'][:16]}… is missing")
+            problems.extend(report["coverage_errors"])
+            log("store FAILED verification: " + "; ".join(problems))
+            return 1
+        log(f"store OK: {report['blocks_checked']} block(s) verified, "
+            f"{report['embedded']} embedded, "
+            f"{report['quarantined']} quarantined"
+            + ("" if report["complete"] else " (mapping incomplete)"))
+        return 0
+
+    if not args.pretrained:
+        raise SystemExit("pbt map needs --pretrained (or --verify to "
+                         "audit an existing store)")
+    from proteinbert_tpu.mapper.engine import run_map
+
+    params, cfg = _load_inference_trunk(args)
+    ids, seqs = _read_named_seqs(args)
+    buckets = None
+    if args.buckets:
+        try:
+            buckets = tuple(json.loads(args.buckets))
+        except (ValueError, TypeError):
+            raise SystemExit(f"--buckets expects a JSON list, got "
+                             f"{args.buckets!r}")
+    tele = None
+    if args.events_jsonl:
+        from proteinbert_tpu.obs import Telemetry
+
+        tele = Telemetry(events_path=args.events_jsonl)
+        tele.flight.install_excepthook()
+    log(f"mapping {len(seqs)} sequence(s) over {args.num_shards} "
+        f"shard(s) (block {args.block_size}, {args.rows_per_batch} "
+        f"packed rows x {cfg.data.seq_len}, up to {args.max_segments} "
+        f"seqs/row) → {args.store}")
+    try:
+        out = run_map(
+            params, cfg, ids, seqs, args.store,
+            num_shards=args.num_shards, block_size=args.block_size,
+            rows_per_batch=args.rows_per_batch,
+            max_segments=args.max_segments, buckets=buckets,
+            telemetry=tele, max_blocks=args.max_blocks)
+    except (StoreError, ValueError) as e:
+        raise SystemExit(f"map failed: {e}")
+    finally:
+        if tele is not None:
+            _export_metrics(tele)
+            tele.close()
+    log(f"map {out['outcome']}: {out['blocks']} block(s), "
+        f"{out['seqs']} sequence(s) at {out['seqs_per_s']:.1f} seqs/s, "
+        f"{out['quarantined']} quarantined, {out['retries']} "
+        f"retry(ies), {out['rework']} re-worked block(s)")
+    if out["outcome"] == "preempted":
+        # EX_TEMPFAIL, same contract as pretrain: not done — requeue;
+        # the cursors make the requeue cost at most one block per shard.
+        log("mapping preempted; exiting 75 so a supervisor requeues it")
+        return 75
+    if out["outcome"] in ("halted", "error"):
+        log(f"mapping {out['outcome']}: halted_shards="
+            f"{out['halted_shards']} failed_shards="
+            f"{out['failed_shards']}")
+        return 1
+    return 0
+
+
 def cmd_reshard(args) -> int:
     """Mesh-agnostic checkpoint resharding (ISSUE 11 tentpole): restore
     a run directory's checkpoint onto a NEW mesh layout and save it into
@@ -1712,6 +1820,12 @@ def build_parser() -> argparse.ArgumentParser:
                          "outcomes, stage attribution, SLO breaches); "
                          "a stream with serve_* records shows it "
                          "automatically after the training report")
+    dg.add_argument("--map", action="store_true",
+                    help="render only the offline-mapping section "
+                         "(per-shard progress, block throughput, "
+                         "re-work across incarnations, quarantines); "
+                         "a stream with map_* records shows it "
+                         "automatically after the training report")
     dg.set_defaults(fn=cmd_diagnose)
 
     dbench = sub.add_parser("data-bench",
@@ -1877,6 +1991,63 @@ def build_parser() -> argparse.ArgumentParser:
                          "0 disables. Default: the run config's "
                          "serve.quant_parity_every")
     sv.set_defaults(fn=cmd_serve)
+
+    mp = sub.add_parser("map",
+                        help="resumable sharded batch inference: embed "
+                             "a corpus through the packed trunk into a "
+                             "content-addressed, integrity-verified "
+                             "embedding store (docs/mapping.md)")
+    mp.add_argument("--store", required=True,
+                    help="embedding-store directory (created on first "
+                         "run; an existing store RESUMES from its "
+                         "shard cursors)")
+    mp.add_argument("--verify", action="store_true",
+                    help="audit an existing store instead of mapping: "
+                         "recompute every block sha256, report "
+                         "corruption and holes (typed, nonzero exit), "
+                         "audit shard coverage. Needs only --store")
+    mp.add_argument("--pretrained",
+                    help="pretrain checkpoint dir for the trunk "
+                         "(required unless --verify)")
+    mp.add_argument("--preset", default="tiny",
+                    choices=["tiny", "base", "long", "large"])
+    mp.add_argument("--pretrained-set", action="append",
+                    metavar="PATH=VALUE",
+                    help="config override the pretrain run was made with")
+    mp.add_argument("--fasta", type=existing_file)
+    mp.add_argument("--seqs-file", type=existing_file,
+                    help="one sequence per line, optionally id<TAB>seq")
+    mp.add_argument("seqs", nargs="*", help="literal AA sequences")
+    mp.add_argument("--num-shards", type=int, default=1,
+                    help="deterministic contiguous corpus shards, each "
+                         "with its own crash-safe cursor (re-work "
+                         "after a kill is bounded per shard)")
+    mp.add_argument("--block-size", type=int, default=64,
+                    help="sequences per durably-committed block (the "
+                         "re-work unit: a kill loses at most one "
+                         "in-flight block per shard)")
+    mp.add_argument("--rows-per-batch", type=int, default=8,
+                    help="packed rows per executable dispatch (one "
+                         "warm (rows, seq_len) executable serves the "
+                         "whole run)")
+    mp.add_argument("--max-segments", type=int, default=8,
+                    help="max sequences packed into one row")
+    mp.add_argument("--buckets",
+                    help="span-quantization ladder as a JSON list "
+                         "(e.g. [64,128,512]; ascending, last == "
+                         "seq_len). Denser ladders pack tighter; the "
+                         "default (the run config's data.buckets, else "
+                         "the single full-length bucket) keeps store "
+                         "numbers within jitted tolerance of pbt "
+                         "embed. Pinned in the store manifest")
+    mp.add_argument("--max-blocks", type=int,
+                    help="stop (resumably, exit 75) after this many "
+                         "blocks this invocation — smoke/drill knob")
+    mp.add_argument("--events-jsonl", type=creatable_path,
+                    help="append map_start/map_shard/map_block/map_end "
+                         "events here (pbt diagnose --map reads them); "
+                         "also arms the flight recorder for NaN halts")
+    mp.set_defaults(fn=cmd_map)
 
     rs = sub.add_parser("reshard",
                         help="restore a checkpoint onto a new mesh "

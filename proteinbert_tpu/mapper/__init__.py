@@ -1,0 +1,46 @@
+"""Resumable sharded batch inference + integrity-verified embedding
+store (`pbt map`, ISSUE 14).
+
+Layout:
+- `store.py`  — content-addressed block store, crash-safe shard
+  cursors, quarantine sidecars, `verify_store` (stdlib+numpy only).
+- `engine.py` — the map run loop: packed-trunk embedding, retries,
+  poison quarantine, NaN halt, telemetry (imports jax — loaded lazily
+  so `pbt map --verify` and `pbt diagnose --map` work on machines that
+  only hold the artifacts).
+- `faults.py` — the PBT_MAP_FAULTS injection hooks the chaos drill
+  (tools/map_drill.py) drives.
+
+docs/mapping.md is the operator reference.
+"""
+
+from proteinbert_tpu.mapper.faults import (  # noqa: F401
+    FAULT_ENV, MapFaults, TransientDispatchError,
+)
+from proteinbert_tpu.mapper.store import (  # noqa: F401
+    BlockFormatError, BlockIntegrityError, CursorError, EmbeddingStore,
+    ShardCursor, StoreConfigError, StoreError, block_digest,
+    commit_block, corpus_digest, deserialize_block, iter_embeddings,
+    next_offset, resume_shard, serialize_block, shard_ranges,
+    store_digests, verify_store,
+)
+
+__all__ = [
+    "FAULT_ENV", "MapFaults", "TransientDispatchError",
+    "BlockFormatError", "BlockIntegrityError", "CursorError",
+    "EmbeddingStore", "ShardCursor", "StoreConfigError", "StoreError",
+    "block_digest", "commit_block", "corpus_digest", "deserialize_block",
+    "iter_embeddings", "next_offset", "resume_shard", "serialize_block",
+    "shard_ranges", "store_digests", "verify_store",
+    # lazy (jax-importing) engine surface:
+    "run_map", "MapError", "ShardHaltedError", "poison_reason",
+]
+
+
+def __getattr__(name):  # PEP 562: keep --verify jax-free
+    if name in ("run_map", "MapError", "ShardHaltedError",
+                "poison_reason"):
+        from proteinbert_tpu.mapper import engine
+
+        return getattr(engine, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -303,6 +303,119 @@ def summarize_serve(records: List[Dict[str, Any]],
     return out
 
 
+def summarize_map(records: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """The `pbt diagnose --map` section: per-shard progress, block
+    throughput, re-work across incarnations, quarantine/retry totals
+    from a stream's map_* records (ISSUE 14). Optional-input-safe like
+    the other summarizers — a stream from a SIGKILLed run (no map_end)
+    still summarizes, which is the whole point for this workload."""
+    starts = [r for r in records if r["event"] == "map_start"]
+    end = next((r for r in reversed(records)
+                if r["event"] == "map_end"), None)
+    blocks = [r for r in records if r["event"] == "map_block"]
+    shard_evs = [r for r in records if r["event"] == "map_shard"]
+
+    # Re-work = committed blocks emitted more than once for the same
+    # (shard, block) across ALL incarnations in the file — exactly the
+    # chaos drill's bounded-re-work metric (map_block only fires after
+    # the cursor advance, so a crashed in-flight block never counts).
+    seen = collections.Counter((b["shard"], b["block"]) for b in blocks)
+    rework = sum(n - 1 for n in seen.values() if n > 1)
+
+    per_shard: Dict[int, Dict[str, Any]] = {}
+    for b in blocks:
+        s = per_shard.setdefault(b["shard"], {
+            "blocks": 0, "seqs": 0, "quarantined": 0, "retries": 0,
+            "last_state": None, "consumed": None, "size": None})
+        s["blocks"] += 1
+        s["seqs"] += b["n"]
+        s["quarantined"] += b.get("quarantined") or 0
+        s["retries"] += b.get("retries") or 0
+    for ev in shard_evs:  # stream order: the LAST transition wins
+        s = per_shard.setdefault(ev["shard"], {
+            "blocks": 0, "seqs": 0, "quarantined": 0, "retries": 0,
+            "last_state": None, "consumed": None, "size": None})
+        s["last_state"] = ev["state"]
+        if isinstance(ev.get("size"), int):
+            s["size"] = ev["size"]
+        if isinstance(ev.get("next"), int):
+            s["consumed"] = ev["next"]
+    for b in blocks:  # committed coverage trumps transition snapshots
+        s = per_shard[b["shard"]]
+        if isinstance(b.get("end"), int):
+            s["consumed"] = max(s["consumed"] or 0, b["end"])
+
+    rates = sorted(b["seqs_per_s"] for b in blocks
+                   if isinstance(b.get("seqs_per_s"), (int, float)))
+    out: Dict[str, Any] = {
+        "manifest": (starts[-1].get("config") if starts else None),
+        "incarnations": len(starts),
+        "outcome": (end["outcome"] if end
+                    else "unknown (no map_end record — killed?)"),
+        "blocks": len(blocks),
+        "seqs": sum(b["n"] for b in blocks),
+        "quarantined": sum(b.get("quarantined") or 0 for b in blocks),
+        "retries": sum(b.get("retries") or 0 for b in blocks),
+        "rework_blocks": rework,
+        "per_shard": {str(k): v for k, v in sorted(per_shard.items())},
+        "throughput": {
+            "seqs_per_s_p50": _percentile(rates, 0.50),
+            "seqs_per_s_last": rates and blocks[-1].get("seqs_per_s")
+            or None,
+        },
+        "halted_shards": sorted({ev["shard"] for ev in shard_evs
+                                 if ev["state"] == "halted"}),
+        "failed_shards": sorted({ev["shard"] for ev in shard_evs
+                                 if ev["state"] == "failed"}),
+    }
+    if end is not None and isinstance(end.get("stats"), dict):
+        out["final_stats"] = end["stats"]
+    return out
+
+
+def render_map(summary: Dict[str, Any]) -> str:
+    """Human-readable mapping section (`pbt diagnose --map`)."""
+    lines = ["-- map --"]
+    lines.append(f"outcome: {summary['outcome']} "
+                 f"({summary['incarnations']} incarnation(s))")
+    man = summary.get("manifest")
+    if man:
+        lines.append(
+            f"manifest: corpus {man.get('corpus_n')} over "
+            f"{man.get('num_shards')} shard(s), block "
+            f"{man.get('block_size')}, rows {man.get('rows_per_batch')}"
+            f"x{man.get('seq_len')}, trunk "
+            f"{man.get('model_fingerprint')}")
+    lines.append(
+        f"committed: {summary['blocks']} block(s), {summary['seqs']} "
+        f"sequence(s), {summary['quarantined']} quarantined, "
+        f"{summary['retries']} retry(ies), "
+        f"{summary['rework_blocks']} re-worked block(s) across "
+        "incarnations")
+    tp = summary["throughput"]
+    if tp["seqs_per_s_p50"] is not None:
+        lines.append(f"throughput: p50 {tp['seqs_per_s_p50']:.2f} "
+                     f"seqs/s (last block "
+                     f"{tp['seqs_per_s_last'] or 0:.2f})")
+    for shard, s in summary["per_shard"].items():
+        prog = ""
+        if s["size"]:
+            done = s["consumed"] if s["consumed"] is not None else 0
+            prog = f" {done}/{s['size']}"
+        lines.append(
+            f"  shard {shard}: {s['blocks']} block(s), {s['seqs']} "
+            f"seq(s){prog}, state {s['last_state'] or '?'}"
+            + (f", {s['quarantined']} quarantined"
+               if s["quarantined"] else "")
+            + (f", {s['retries']} retries" if s["retries"] else ""))
+    for which in ("halted_shards", "failed_shards"):
+        if summary[which]:
+            lines.append(f"{which.replace('_', ' ')}: "
+                         f"{summary[which]} — see the flight dump / "
+                         "shard events")
+    return "\n".join(lines)
+
+
 def render_serve(summary: Dict[str, Any]) -> str:
     """Human-readable serve section (`pbt diagnose --serve`)."""
     lines = ["-- serve --"]

@@ -123,6 +123,24 @@ EVENT_FIELDS: Dict[str, Dict[str, Any]] = {
     # Terminal router record; outcome in SERVE_OUTCOMES, stats is
     # FleetRouter.stats().
     "fleet_end": {"outcome": str, "stats": dict},
+    # ---- offline batch inference (`pbt map`, ISSUE 14) ----
+    # Run manifest: the resolved map configuration (store dir, corpus
+    # size, shard/block/row geometry, trunk fingerprint) — the mapping
+    # counterpart of run_start.
+    "map_start": {"config": dict, "pid": int},
+    # One shard lifecycle transition: state in MAP_SHARD_STATES
+    # (start/resume/done/halted/failed). Typed optional fields: blocks,
+    # next, size (non-negative ints), reason, cursor_source.
+    "map_shard": {"shard": int, "state": str},
+    # One durably COMMITTED block (emitted only after the cursor
+    # advance — the engine's commit point, so counting these across
+    # incarnations measures re-work exactly). `digest` is the block
+    # payload's sha256. Typed optional fields: retries, quarantined,
+    # start, end (non-negative ints), seqs_per_s (non-negative finite).
+    "map_block": {"shard": int, "block": int, "digest": str, "n": int},
+    # Terminal mapping record; outcome in MAP_OUTCOMES, stats is the
+    # run_map result (blocks/seqs/quarantined/retries/rework/...).
+    "map_end": {"outcome": str, "stats": dict},
 }
 
 CKPT_PHASES = ("dispatch", "landed", "save")
@@ -148,6 +166,16 @@ FLEET_REPLICA_STATES = ("up", "degraded", "dead", "draining", "admitted")
 # (a non-retryable error reached the client).
 FLEET_REQUEST_OUTCOMES = ("ok", "cache_hit", "retried_ok", "shed",
                           "failed")
+# Map shard lifecycle states (mapper/engine.py): start (fresh cursor),
+# resume (an existing cursor was picked up — incl. a torn-cursor /
+# torn-tail fallback), done (shard exhausted), halted (non-finite
+# embeddings — flight dump taken), failed (retry budget exhausted).
+MAP_SHARD_STATES = ("start", "resume", "done", "halted", "failed")
+# Terminal map-run outcomes: completed (every shard done), preempted
+# (SIGTERM/SIGINT or a max-blocks bound — resumable, CLI exits 75),
+# halted (a shard hit non-finite output), error (a shard exhausted its
+# retry budget).
+MAP_OUTCOMES = ("completed", "preempted", "halted", "error")
 
 
 def sanitize(value: Any) -> Any:
@@ -373,6 +401,61 @@ def validate_record(rec: Any) -> None:
     if event == "fleet_end" and rec["outcome"] not in SERVE_OUTCOMES:
         raise ValueError(f"fleet_end.outcome {rec['outcome']!r} not in "
                          f"{SERVE_OUTCOMES}")
+    if event in ("map_shard", "map_block"):
+        for name in ("shard", "block", "n", "blocks", "next", "size",
+                     "start", "end", "retries", "quarantined"):
+            v = rec.get(name)
+            if v is not None and (not isinstance(v, int)
+                                  or isinstance(v, bool) or v < 0):
+                raise ValueError(f"{event}.{name} must be a "
+                                 f"non-negative int, got {v!r}")
+    if event == "map_shard" and rec["state"] not in MAP_SHARD_STATES:
+        raise ValueError(f"map_shard.state {rec['state']!r} not in "
+                         f"{MAP_SHARD_STATES}")
+    if event == "map_block":
+        dg = rec["digest"]
+        if len(dg) != 64 or any(c not in "0123456789abcdef" for c in dg):
+            raise ValueError(f"map_block.digest must be a lowercase "
+                             f"sha256 hex digest, got {dg!r}")
+        sps = rec.get("seqs_per_s")
+        if sps is not None and (isinstance(sps, bool)
+                                or not isinstance(sps, (int, float))
+                                or not math.isfinite(sps) or sps < 0):
+            raise ValueError(f"map_block.seqs_per_s must be a "
+                             f"non-negative finite number, got {sps!r}")
+    if event == "map_end" and rec["outcome"] not in MAP_OUTCOMES:
+        raise ValueError(f"map_end.outcome {rec['outcome']!r} not in "
+                         f"{MAP_OUTCOMES}")
+    if event == "note" and rec.get("kind") == "map_capture":
+        # The map-throughput capture (tools/map_drill.py --bench-events):
+        # its rate field is a trajectory-sentinel input, so a writer bug
+        # must fail validation, not poison the series.
+        v = rec.get("map_seqs_per_s")
+        if v is None:
+            raise ValueError(
+                "note(kind=map_capture): missing required field "
+                "'map_seqs_per_s'")
+        if (isinstance(v, bool) or not isinstance(v, (int, float))
+                or not math.isfinite(v) or v <= 0):
+            raise ValueError(
+                f"note(kind=map_capture).map_seqs_per_s must be a "
+                f"positive finite number, got {v!r}")
+    if event == "note" and rec.get("kind") == "restore_fallback":
+        # The checkpointer's torn-final-checkpoint fallback report
+        # (train/checkpoint.py): bad_step (the skipped torn step) is
+        # required; landed_step (the step actually restored, ISSUE 14
+        # satellite) is typed when present (older streams predate it).
+        bs = rec.get("bad_step")
+        if not isinstance(bs, int) or isinstance(bs, bool) or bs < 0:
+            raise ValueError(
+                f"note(kind=restore_fallback).bad_step must be a "
+                f"non-negative int, got {bs!r}")
+        ls = rec.get("landed_step")
+        if ls is not None and (not isinstance(ls, int)
+                               or isinstance(ls, bool) or ls < 0):
+            raise ValueError(
+                f"note(kind=restore_fallback).landed_step must be a "
+                f"non-negative int, got {ls!r}")
     if event == "note" and rec.get("kind") == "comm_quant":
         # The quantized-collectives capture (bench.py --comm, ISSUE
         # 12): its ratio fields are the trajectory-sentinel inputs, so
@@ -447,6 +530,12 @@ def make_example(event: str) -> Dict[str, Any]:
         "fleet_request": {"outcome": "ok", "path": "/v1/embed",
                           "replica": "r0", "retries": 0, "status": 200},
         "fleet_end": {"outcome": "drained", "stats": {"accepted": 0}},
+        "map_start": {"config": {"num_shards": 2}, "pid": 1},
+        "map_shard": {"shard": 0, "state": "start", "next": 0,
+                      "size": 16},
+        "map_block": {"shard": 0, "block": 0, "digest": "0" * 64,
+                      "n": 8, "seqs_per_s": 12.5},
+        "map_end": {"outcome": "completed", "stats": {"blocks": 1}},
     }
     return make_record(event, seq=0, t=0.0, **payloads[event])
 

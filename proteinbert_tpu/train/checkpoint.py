@@ -257,21 +257,28 @@ class Checkpointer:
                     raise
                 logger.warning(
                     "checkpoint at step %d in %s is unreadable (%s: %s) "
-                    "— falling back to the previous retained step",
-                    s, self.directory, type(exc).__name__, exc)
-                self._note_restore_fallback(s, exc)
+                    "— falling back to the previous retained step %d",
+                    s, self.directory, type(exc).__name__, exc,
+                    steps[i + 1])
+                self._note_restore_fallback(s, steps[i + 1], exc)
         raise AssertionError("unreachable: the loop returns or raises")
 
-    def _note_restore_fallback(self, bad_step: int, exc: Exception) -> None:
+    def _note_restore_fallback(self, bad_step: int, landed_step: int,
+                               exc: Exception) -> None:
         """Report one skipped-torn-step event through `on_note`
         (callable(**fields) — the trainer/CLI points it at
-        Telemetry.emit('note', ...)); never allowed to fail a restore."""
+        Telemetry.emit('note', ...)); never allowed to fail a restore.
+        The payload carries BOTH the skipped step (`bad_step`) and the
+        step the restore falls back to (`landed_step`) so an operator
+        reading the stream knows exactly how much history the run lost
+        without cross-referencing the directory listing."""
         cb = getattr(self, "on_note", None)
         if cb is None:
             return
         try:
             cb(source="checkpoint", kind="restore_fallback",
-               bad_step=int(bad_step), error=f"{type(exc).__name__}: {exc}")
+               bad_step=int(bad_step), landed_step=int(landed_step),
+               error=f"{type(exc).__name__}: {exc}")
         except Exception:
             logger.exception("checkpoint on_note hook failed — restore "
                              "path unaffected")

@@ -1,0 +1,669 @@
+"""Kill-anywhere offline inference (ISSUE 14): the mapper subsystem.
+
+Three tiers:
+- store/cursor unit tests (no jax): canonical serialization, the
+  crash-safe cursor protocol — including the satellite's parametrized
+  kill-at-every-boundary atomicity test — and `verify_store`'s typed
+  corruption/hole/coverage detection;
+- engine tests (tiny model): completion + parity with the bucketed
+  offline surface, resume byte-identity through torn artifacts, typed
+  poison quarantine, NaN shard halt with a flight dump, transient
+  retry/budget semantics, manifest pinning;
+- events: the map_* schema rows and the diagnose --map summary.
+
+The full chaos drill (real subprocesses, real SIGKILL) lives in
+tools/map_drill.py and runs as a tier-1 smoke stage.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from proteinbert_tpu.mapper import (
+    BlockFormatError, BlockIntegrityError, CursorError, EmbeddingStore,
+    MapFaults, ShardCursor, StoreConfigError, block_digest,
+    commit_block, deserialize_block, next_offset, resume_shard,
+    serialize_block, shard_ranges, store_digests, verify_store,
+)
+
+SEQ_LEN = 48
+BUCKETS = (16, 32, 48)
+
+
+# ------------------------------------------------- canonical block bytes
+
+class TestBlockSerialization:
+    def _arrays(self):
+        return {
+            "ids": np.array([b"a", b"bb"], dtype="S2"),
+            "lengths": np.array([3, 4], np.int32),
+            "global": np.arange(6, dtype=np.float32).reshape(2, 3),
+            "local_mean": np.ones((2, 2), np.float32),
+        }
+
+    def test_roundtrip_and_byte_determinism(self):
+        a = self._arrays()
+        p1 = serialize_block({"shard": 0, "block": 1}, a)
+        p2 = serialize_block({"shard": 0, "block": 1},
+                             {k: v.copy() for k, v in a.items()})
+        assert p1 == p2  # no timestamps, no dict-order dependence
+        meta, arrays = deserialize_block(p1)
+        assert meta == {"shard": 0, "block": 1}
+        for k in a:
+            assert np.array_equal(arrays[k], a[k])
+
+    def test_meta_changes_digest(self):
+        a = self._arrays()
+        d1 = block_digest(serialize_block({"block": 0}, a))
+        d2 = block_digest(serialize_block({"block": 1}, a))
+        assert d1 != d2
+
+    def test_torn_payload_typed(self):
+        p = serialize_block({}, self._arrays())
+        with pytest.raises(BlockFormatError, match="torn"):
+            deserialize_block(p[:-3])
+        with pytest.raises(BlockFormatError, match="magic"):
+            deserialize_block(b"NOPE" + p)
+        with pytest.raises(BlockFormatError, match="trailing"):
+            deserialize_block(p + b"x")
+
+
+def test_shard_ranges_cover_exactly_once():
+    for n, k in ((44, 2), (10, 3), (3, 5), (0, 2), (7, 1)):
+        ranges = shard_ranges(n, k)
+        assert len(ranges) == k
+        covered = [i for lo, hi in ranges for i in range(lo, hi)]
+        assert covered == list(range(n))
+    with pytest.raises(ValueError):
+        shard_ranges(4, 0)
+
+
+# --------------------------------------------------------------- cursors
+
+def _mk_store(tmp_path, n=24, num_shards=1, block_size=4):
+    store = EmbeddingStore(str(tmp_path / "store"))
+    store.ensure_manifest({
+        "kind": "embedding_store", "corpus_n": n, "corpus_digest": "cd",
+        "model_fingerprint": "mf", "num_shards": num_shards,
+        "block_size": block_size, "rows_per_batch": 2,
+        "max_segments": 4, "seq_len": SEQ_LEN,
+        "buckets": list(BUCKETS)})
+    return store
+
+
+def _payload(shard, block, start, end, n_rows):
+    ids = np.array([f"s{i}".encode() for i in range(start, start + n_rows)],
+                   dtype="S8")
+    arrays = {"ids": ids,
+              "lengths": np.full(n_rows, 7, np.int32),
+              "global": np.full((n_rows, 3), float(block), np.float32),
+              "local_mean": np.zeros((n_rows, 2), np.float32)}
+    return serialize_block({"shard": shard, "block": block,
+                            "start": start, "end": end}, arrays)
+
+
+def _commit(store, cursor, state, shard, block, start, end,
+            quarantined=(), crash=None):
+    n = end - start - len(quarantined)  # embedded rows exclude poison
+    payload = _payload(shard, block, start, end, n)
+    entry = {"block": block, "digest": block_digest(payload),
+             "start": start, "end": end, "n": n,
+             "quarantined": [list(q) for q in quarantined]}
+    return commit_block(store, cursor, state, payload, entry,
+                        crash=crash)
+
+
+class TestCursor:
+    def test_fresh_then_generations(self, tmp_path):
+        store = _mk_store(tmp_path)
+        cur = ShardCursor(store.directory, 0)
+        state, source = cur.load()
+        assert source == "fresh" and state["blocks"] == []
+        state = cur.write_state(state)
+        state = _commit(store, cur, state, 0, 0, 0, 4)
+        reloaded, source = ShardCursor(store.directory, 0).load()
+        assert source == "main"
+        assert [b["block"] for b in reloaded["blocks"]] == [0]
+        assert next_offset(reloaded) == 4
+
+    def test_torn_main_falls_back_one_generation(self, tmp_path):
+        store = _mk_store(tmp_path)
+        cur = ShardCursor(store.directory, 0)
+        state = cur.write_state(cur.load()[0])
+        state = _commit(store, cur, state, 0, 0, 0, 4)
+        state = _commit(store, cur, state, 0, 1, 4, 8)
+        with open(cur.path, "r+b") as f:  # tear mid-file
+            f.truncate(40)
+        reloaded, source = ShardCursor(store.directory, 0).load()
+        assert source == "prev"
+        # Exactly ONE generation lost: block 1 re-works, block 0 stays.
+        assert [b["block"] for b in reloaded["blocks"]] == [0]
+
+    def test_double_fault_is_typed_not_silent_restart(self, tmp_path):
+        store = _mk_store(tmp_path)
+        cur = ShardCursor(store.directory, 0)
+        state = cur.write_state(cur.load()[0])
+        _commit(store, cur, state, 0, 0, 0, 4)
+        for path in (cur.path, cur.prev_path):
+            with open(path, "w") as f:
+                f.write("{garbage")
+        with pytest.raises(CursorError, match="both cursor generations"):
+            ShardCursor(store.directory, 0).load()
+
+    def test_checksum_rejects_bitrot(self, tmp_path):
+        store = _mk_store(tmp_path)
+        cur = ShardCursor(store.directory, 0)
+        state = cur.write_state(cur.load()[0])
+        _commit(store, cur, state, 0, 0, 0, 4)
+        with open(cur.path, "rb") as f:
+            raw = bytearray(f.read())
+        i = raw.index(b'"end": 4') + 8 - 1
+        raw[i:i + 1] = b"5"  # parseable JSON, wrong content
+        with open(cur.path, "wb") as f:
+            f.write(bytes(raw))
+        _, source = ShardCursor(store.directory, 0).load()
+        assert source == "prev"  # checksum caught it
+
+    def test_quarantine_sidecar_dedupes_and_tolerates_torn_tail(
+            self, tmp_path):
+        store = _mk_store(tmp_path)
+        cur = ShardCursor(store.directory, 0)
+        cur.append_quarantine(0, [("p1", "empty")])
+        cur.append_quarantine(0, [("p1", "empty")])  # re-worked block
+        cur.append_quarantine(1, [("p2", "invalid_char")])
+        with open(cur.quarantine_path, "a") as f:
+            f.write('{"torn')  # crash mid-append
+        recs = cur.read_quarantine()
+        assert {r["id"]: r["reason"] for r in recs} == {
+            "p1": "empty", "p2": "invalid_char"}
+
+
+# ------------------------------- the satellite: kill at EVERY boundary
+
+CRASH_POINTS = ("before_object", "after_object", "cursor_serialized",
+                "cursor_prev_updated", "cursor_tmp_written",
+                "cursor_renamed")
+
+
+class SimulatedKill(BaseException):
+    """Stands in for SIGKILL inside one process: nothing below the
+    raise runs, exactly like the real signal (the drill does the real
+    one through subprocesses)."""
+
+
+@pytest.mark.parametrize("point", CRASH_POINTS)
+@pytest.mark.parametrize("victim_block", [0, 1, 2])
+def test_kill_between_flush_and_rename_never_loses_or_duplicates(
+        tmp_path, point, victim_block):
+    """Kill the writer at every filesystem boundary of the commit
+    protocol, for every block position, then resume — the store must
+    cover every sequence exactly once, with at most one block of
+    re-work (the ISSUE 14 cursor-atomicity satellite)."""
+    n, block = 12, 4
+    store = _mk_store(tmp_path, n=n, block_size=block)
+    cur = ShardCursor(store.directory, 0)
+    state = cur.write_state(cur.load()[0])
+
+    def crash_at(reached):
+        if reached == point:
+            raise SimulatedKill(point)
+
+    committed = 0
+    with pytest.raises(SimulatedKill):
+        for b in range(n // block):
+            crash = crash_at if b == victim_block else None
+            state = _commit(store, cur, state, 0, b, b * block,
+                            (b + 1) * block, crash=crash)
+            committed += 1
+        raise SimulatedKill("no-crash control never happens")
+    assert committed == victim_block  # died inside the victim's commit
+
+    # ---- resume from disk exactly as the engine does
+    state, info = resume_shard(store, 0)
+    start = next_offset(state)
+    # The victim block is the ONLY re-work, and only when its cursor
+    # advance had not landed (the commit point is cursor_renamed).
+    expected_next = (victim_block + 1 if point == "cursor_renamed"
+                     else victim_block) * block
+    assert start == expected_next
+    assert info["tail_dropped"] is None  # objects were never torn
+    for b in range(start // block, n // block):
+        state = _commit(store, cur, state, 0, b, b * block,
+                        (b + 1) * block)
+
+    # ---- audit: contiguous coverage, every sequence exactly once
+    final, source = ShardCursor(store.directory, 0).load()
+    assert source == "main"
+    assert [b["block"] for b in final["blocks"]] == list(range(n // block))
+    seen = []
+    for entry in final["blocks"]:
+        _, arrays = store.read_block(entry["digest"])
+        seen.extend(i.decode() for i in arrays["ids"])
+    assert seen == [f"s{i}" for i in range(n)]  # none lost, none doubled
+
+
+def test_resume_drops_torn_tail_object_only(tmp_path):
+    store = _mk_store(tmp_path)
+    cur = ShardCursor(store.directory, 0)
+    state = cur.write_state(cur.load()[0])
+    state = _commit(store, cur, state, 0, 0, 0, 4)
+    state = _commit(store, cur, state, 0, 1, 4, 8)
+    tail = state["blocks"][-1]["digest"]
+    with open(store.object_path(tail), "r+b") as f:
+        f.truncate(10)
+    state, info = resume_shard(store, 0)
+    assert info["tail_dropped"]["block"] == 1
+    assert [b["block"] for b in state["blocks"]] == [0]
+    assert next_offset(state) == 4
+
+
+# ----------------------------------------------------------- verification
+
+class TestVerify:
+    def _full_store(self, tmp_path):
+        store = _mk_store(tmp_path, n=8, num_shards=2, block_size=4)
+        digests = {}
+        for shard in range(2):
+            cur = ShardCursor(store.directory, shard)
+            state = cur.write_state(cur.load()[0])
+            state = _commit(store, cur, state, shard, 0, 0, 4,
+                            quarantined=[("px", "empty")] if shard == 0
+                            else ())
+            digests[shard] = state["blocks"][0]["digest"]
+            cur.write_state(dict(state, done=True))
+        return store, digests
+
+    def test_clean_store_ok_and_complete(self, tmp_path):
+        store, _ = self._full_store(tmp_path)
+        rep = verify_store(store.directory)
+        assert rep["ok"] and rep["complete"]
+        assert rep["blocks_checked"] == 2
+        assert rep["quarantined"] == 1
+        assert store_digests(store.directory).keys() == {(0, 0), (1, 0)}
+
+    def test_flipped_byte_is_typed_digest_mismatch(self, tmp_path):
+        store, digests = self._full_store(tmp_path)
+        path = store.object_path(digests[1])
+        with open(path, "r+b") as f:
+            data = bytearray(f.read())
+            data[-1] ^= 0xFF
+            f.seek(0)
+            f.write(bytes(data))
+        rep = verify_store(store.directory)
+        assert not rep["ok"]
+        assert rep["corrupt"] == [{"shard": 1, "block": 0,
+                                   "digest": digests[1],
+                                   "reason": "digest_mismatch"}]
+        with pytest.raises(BlockIntegrityError) as ei:
+            store.read_block(digests[1])
+        assert ei.value.reason == "digest_mismatch"
+
+    def test_deleted_object_is_a_hole(self, tmp_path):
+        store, digests = self._full_store(tmp_path)
+        os.remove(store.object_path(digests[0]))
+        rep = verify_store(store.directory)
+        assert not rep["ok"]
+        assert rep["holes"][0]["reason"] == "missing"
+        assert rep["holes"][0]["digest"] == digests[0]
+
+    def test_coverage_gap_detected(self, tmp_path):
+        store = _mk_store(tmp_path, n=8, num_shards=1, block_size=4)
+        cur = ShardCursor(store.directory, 0)
+        state = cur.write_state(cur.load()[0])
+        # Block 0 claims [0, 4) then block 1 claims [5, 8): a gap.
+        state = _commit(store, cur, state, 0, 0, 0, 4)
+        state = _commit(store, cur, state, 0, 1, 5, 8)
+        rep = verify_store(store.directory)
+        assert not rep["ok"]
+        assert any("gap or overlap" in e for e in rep["coverage_errors"])
+
+    def test_manifest_mismatch_is_typed(self, tmp_path):
+        store = _mk_store(tmp_path)
+        with pytest.raises(StoreConfigError, match="block_size"):
+            store.ensure_manifest({
+                "kind": "embedding_store", "corpus_n": 24,
+                "corpus_digest": "cd", "model_fingerprint": "mf",
+                "num_shards": 1, "block_size": 8, "rows_per_batch": 2,
+                "max_segments": 4, "seq_len": SEQ_LEN,
+                "buckets": list(BUCKETS)})
+
+    def test_verify_without_manifest_is_typed(self, tmp_path):
+        with pytest.raises(StoreConfigError, match="manifest"):
+            verify_store(str(tmp_path / "nothing"))
+
+
+# ----------------------------------------------------------- fault specs
+
+class TestMapFaults:
+    def test_parse_roundtrip(self):
+        f = MapFaults.parse("crash=0:1:after_object;fail=1:2:2;"
+                            "nan=0:0;latency=0.5")
+        assert f.armed() and f.latency_s == 0.5
+        assert f.poison_output(0, 0) and not f.poison_output(1, 0)
+        assert f.take_failure(1, 2) and f.take_failure(1, 2)
+        assert not f.take_failure(1, 2)  # consumed
+        assert f.crash_hook(0, 1) is not None
+        assert f.crash_hook(0, 0) is None
+
+    def test_empty_spec_inert(self):
+        f = MapFaults.parse("")
+        assert not f.armed()
+
+    @pytest.mark.parametrize("bad", [
+        "crash=0:1", "crash=0:1:nowhere", "fail=1", "nan=1",
+        "bogus=1:2", "crash0:1:after_object",
+    ])
+    def test_malformed_specs_raise(self, bad):
+        with pytest.raises(ValueError):
+            MapFaults.parse(bad)
+
+
+# -------------------------------------------------------------- events
+
+class TestMapEvents:
+    def test_examples_validate(self):
+        from proteinbert_tpu.obs.events import make_example, validate_record
+
+        for ev in ("map_start", "map_shard", "map_block", "map_end"):
+            validate_record(json.loads(json.dumps(make_example(ev))))
+
+    def test_typed_rejections(self):
+        from proteinbert_tpu.obs.events import make_record, validate_record
+
+        for bad in (
+            dict(event="map_shard", shard=0, state="limping"),
+            dict(event="map_block", shard=0, block=0, digest="zz", n=1),
+            dict(event="map_end", outcome="gone", stats={}),
+            dict(event="note", source="checkpoint",
+                 kind="restore_fallback", bad_step=1, landed_step=-1),
+        ):
+            event = bad.pop("event")
+            rec = make_record(event, seq=0, t=0.0, **bad)
+            with pytest.raises(ValueError):
+                validate_record(rec)
+
+
+def test_diagnose_map_counts_rework_across_incarnations():
+    from proteinbert_tpu.obs.diagnose import render_map, summarize_map
+    from proteinbert_tpu.obs.events import make_record
+
+    dg = "0" * 64
+    recs = [
+        make_record("map_start", 0, 0.0, config={"corpus_n": 8,
+                                                 "num_shards": 1}, pid=1),
+        make_record("map_shard", 1, 0.1, shard=0, state="start",
+                    next=0, size=8),
+        make_record("map_block", 2, 0.2, shard=0, block=0, digest=dg,
+                    n=4, retries=1, quarantined=1, seqs_per_s=10.0),
+        # killed; second incarnation re-works block 0, finishes
+        make_record("map_start", 0, 1.0, config={"corpus_n": 8,
+                                                 "num_shards": 1}, pid=2),
+        make_record("map_shard", 1, 1.1, shard=0, state="resume",
+                    next=0, size=8),
+        make_record("map_block", 2, 1.2, shard=0, block=0, digest=dg,
+                    n=4, seqs_per_s=12.0),
+        make_record("map_block", 3, 1.3, shard=0, block=1, digest=dg,
+                    n=4, seqs_per_s=11.0),
+        make_record("map_shard", 4, 1.4, shard=0, state="done",
+                    blocks=2),
+        make_record("map_end", 5, 1.5, outcome="completed",
+                    stats={"blocks": 2}),
+    ]
+    s = summarize_map(recs)
+    assert s["incarnations"] == 2
+    assert s["outcome"] == "completed"
+    assert s["rework_blocks"] == 1
+    assert s["blocks"] == 3 and s["seqs"] == 12
+    assert s["retries"] == 1 and s["quarantined"] == 1
+    assert s["per_shard"]["0"]["last_state"] == "done"
+    text = render_map(s)
+    assert "re-worked" in text and "shard 0" in text
+
+
+# ------------------------------------------------------------- engine
+
+jax = pytest.importorskip("jax")
+
+from proteinbert_tpu.configs import (  # noqa: E402
+    DataConfig, ModelConfig, OptimizerConfig, PretrainConfig, TrainConfig,
+)
+from proteinbert_tpu.mapper.engine import run_map  # noqa: E402
+from proteinbert_tpu.train import create_train_state  # noqa: E402
+
+ALPHABET = "ACDEFGHIKLMNPQRSTVWY"
+
+
+def _cfg():
+    return PretrainConfig(
+        model=ModelConfig(local_dim=16, global_dim=32, key_dim=8,
+                          num_heads=2, num_blocks=2, num_annotations=32,
+                          dtype="float32"),
+        data=DataConfig(seq_len=SEQ_LEN, batch_size=4),
+        optimizer=OptimizerConfig(warmup_steps=5),
+        train=TrainConfig(seed=0, max_steps=1),
+    )
+
+
+@pytest.fixture(scope="module")
+def trunk():
+    cfg = _cfg()
+    state = create_train_state(jax.random.PRNGKey(cfg.train.seed), cfg)
+    return state.params, cfg
+
+
+@pytest.fixture()
+def corpus():
+    rng = np.random.default_rng(11)
+    seqs = ["".join(rng.choice(list(ALPHABET), size=int(n)))
+            for n in rng.integers(5, 30, size=18)]
+    return [f"p{i}" for i in range(len(seqs))], seqs
+
+
+MAP_KW = dict(num_shards=2, block_size=4, rows_per_batch=2,
+              max_segments=4, buckets=BUCKETS,
+              stop_flag=lambda: False)
+
+
+class TestEngine:
+    def test_completes_verifies_and_matches_bucketed_offline(
+            self, trunk, corpus, tmp_path):
+        params, cfg = trunk
+        ids, seqs = corpus
+        out = run_map(params, cfg, ids, seqs, str(tmp_path / "store"),
+                      **MAP_KW)
+        assert out["outcome"] == "completed"
+        assert out["seqs"] == len(seqs) and out["quarantined"] == 0
+        rep = verify_store(str(tmp_path / "store"))
+        assert rep["ok"] and rep["complete"]
+        assert rep["embedded"] == len(seqs)
+
+        # Numbers match the bucketed OFFLINE surface within the
+        # documented jitted tolerance — the store is not a third
+        # numerics regime (the spans are serving-rule quantized).
+        from proteinbert_tpu import inference
+        from proteinbert_tpu.mapper import iter_embeddings
+
+        ref = inference.embed(params, cfg, seqs, batch_size=8,
+                              bucketed=True, buckets=BUCKETS)
+        got = dict(iter_embeddings(str(tmp_path / "store")))
+        for k, rid in enumerate(ids):
+            np.testing.assert_allclose(got[rid]["global"],
+                                       ref["global"][k], atol=1e-5)
+            np.testing.assert_allclose(got[rid]["local_mean"],
+                                       ref["local_mean"][k], atol=1e-5)
+
+    def test_resume_after_tearing_is_byte_identical(
+            self, trunk, corpus, tmp_path):
+        params, cfg = trunk
+        ids, seqs = corpus
+        control = str(tmp_path / "control")
+        chaos = str(tmp_path / "chaos")
+        run_map(params, cfg, ids, seqs, control, **MAP_KW)
+
+        kw = dict(MAP_KW, max_blocks=3)
+        out = run_map(params, cfg, ids, seqs, chaos, **kw)
+        assert out["outcome"] == "preempted"
+        # Hostile storage while "down": tear shard 0's main cursor AND
+        # shard 1's tail block object.
+        cur0 = ShardCursor(chaos, 0)
+        with open(cur0.path, "r+b") as f:
+            f.truncate(30)
+        s1, _ = ShardCursor(chaos, 1).load()
+        if s1["blocks"]:
+            tail = s1["blocks"][-1]["digest"]
+            with open(EmbeddingStore(chaos).object_path(tail),
+                      "r+b") as f:
+                f.truncate(12)
+        out = run_map(params, cfg, ids, seqs, chaos, **MAP_KW)
+        assert out["outcome"] == "completed"
+        # The resume's own stats own BOTH torn-artifact re-works: the
+        # prev-generation cursor fallback (shard 0) and the dropped
+        # tail object (shard 1) — what diagnose counts from the stream.
+        assert out["rework"] == 2
+        assert store_digests(chaos) == store_digests(control)
+        rep = verify_store(chaos)
+        assert rep["ok"] and rep["complete"]
+
+    def test_events_stream_validates_and_diagnoses(
+            self, trunk, corpus, tmp_path):
+        from proteinbert_tpu.obs import Telemetry, read_events
+        from proteinbert_tpu.obs.diagnose import summarize_map
+
+        params, cfg = trunk
+        ids, seqs = corpus
+        ev = str(tmp_path / "events.jsonl")
+        tele = Telemetry(events_path=ev)
+        run_map(params, cfg, ids, seqs, str(tmp_path / "store"),
+                telemetry=tele, **MAP_KW)
+        tele.close()
+        recs = read_events(ev, strict=True)  # raises on schema drift
+        kinds = {r["event"] for r in recs}
+        assert {"map_start", "map_shard", "map_block",
+                "map_end"} <= kinds
+        s = summarize_map(recs)
+        assert s["outcome"] == "completed" and s["rework_blocks"] == 0
+        # Metrics surfaced (progress/throughput/counters).
+        snap = tele.metrics.snapshot()
+        names = set(snap["counters"]) | set(snap["gauges"])
+        assert any(n.startswith("map_blocks_total") for n in names)
+        assert any(n.startswith("map_seqs_per_s") for n in names)
+
+    def test_poison_quarantined_typed_not_fatal(self, trunk, tmp_path):
+        params, cfg = trunk
+        seqs = ["ACDEFGH", "", "AC DEF", 12345, "MKLVWY"]
+        ids = [f"p{i}" for i in range(len(seqs))]
+        out = run_map(params, cfg, ids, seqs, str(tmp_path / "store"),
+                      num_shards=1, block_size=8, rows_per_batch=2,
+                      max_segments=4, buckets=BUCKETS,
+                      stop_flag=lambda: False)
+        assert out["outcome"] == "completed"
+        assert out["quarantined"] == 3 and out["seqs"] == 2
+        recs = ShardCursor(str(tmp_path / "store"), 0).read_quarantine()
+        assert {r["id"]: r["reason"] for r in recs} == {
+            "p1": "empty", "p2": "invalid_char", "p3": "non_string"}
+        rep = verify_store(str(tmp_path / "store"))
+        assert rep["ok"] and rep["complete"] and rep["quarantined"] == 3
+
+    def test_non_ascii_ids_round_trip(self, trunk, tmp_path):
+        # Real-world FASTA headers carry non-ASCII; an ID must never be
+        # able to kill a run (np.array(dtype="S") on str would raise).
+        from proteinbert_tpu.mapper import iter_embeddings
+
+        params, cfg = trunk
+        ids = ["prötein/1", "βeta_2"]
+        out = run_map(params, cfg, ids, ["ACDEFGH", "MKLVWY"],
+                      str(tmp_path / "store"), num_shards=1,
+                      block_size=4, rows_per_batch=2, max_segments=4,
+                      buckets=BUCKETS, stop_flag=lambda: False)
+        assert out["outcome"] == "completed" and out["seqs"] == 2
+        got = dict(iter_embeddings(str(tmp_path / "store")))
+        assert set(got) == set(ids)
+
+    def test_overlong_sequence_truncates_not_poison(self, trunk,
+                                                    tmp_path):
+        params, cfg = trunk
+        seqs = ["A" * (SEQ_LEN * 3), "MKLVWY"]
+        out = run_map(params, cfg, ["long", "ok"], seqs,
+                      str(tmp_path / "store"), num_shards=1,
+                      block_size=4, rows_per_batch=2, max_segments=4,
+                      buckets=BUCKETS, stop_flag=lambda: False)
+        assert out["outcome"] == "completed"
+        assert out["quarantined"] == 0 and out["seqs"] == 2
+
+    def test_nan_halts_shard_with_flight_dump(self, trunk, corpus,
+                                              tmp_path):
+        from proteinbert_tpu.obs import Telemetry, read_events
+
+        params, cfg = trunk
+        ids, seqs = corpus
+        ev = str(tmp_path / "events.jsonl")
+        tele = Telemetry(events_path=ev)
+        out = run_map(params, cfg, ids, seqs, str(tmp_path / "store"),
+                      telemetry=tele,
+                      faults=MapFaults.parse("nan=0:0"),
+                      **MAP_KW)
+        tele.close()
+        assert out["outcome"] == "halted"
+        assert out["halted_shards"] == [0]
+        # The OTHER shard still completed — containment, not collapse.
+        assert [s for s in out["shards"] if s["shard"] == 1][0]["done"]
+        halts = [r for r in read_events(ev, strict=True)
+                 if r["event"] == "map_shard" and r["state"] == "halted"]
+        assert halts and halts[0]["reason"] == "non_finite_embeddings"
+        assert halts[0]["flight"] and os.path.exists(halts[0]["flight"])
+
+    def test_transient_failures_retry_then_succeed(self, trunk, corpus,
+                                                   tmp_path):
+        params, cfg = trunk
+        ids, seqs = corpus
+        control = str(tmp_path / "control")
+        run_map(params, cfg, ids, seqs, control, **MAP_KW)
+        out = run_map(params, cfg, ids, seqs, str(tmp_path / "store"),
+                      faults=MapFaults.parse("fail=0:1:2"),
+                      backoff_base_s=0.001, **MAP_KW)
+        assert out["outcome"] == "completed" and out["retries"] == 2
+        assert store_digests(str(tmp_path / "store")) \
+            == store_digests(control)
+
+    def test_retry_exhaustion_fails_shard_typed(self, trunk, corpus,
+                                                tmp_path):
+        params, cfg = trunk
+        ids, seqs = corpus
+        out = run_map(params, cfg, ids, seqs, str(tmp_path / "store"),
+                      faults=MapFaults.parse("fail=0:0:99"),
+                      retry_limit=2, backoff_base_s=0.001, **MAP_KW)
+        assert out["outcome"] == "error"
+        assert out["failed_shards"] == [0]
+        # The healthy shard still finished.
+        assert [s for s in out["shards"] if s["shard"] == 1][0]["done"]
+
+    def test_manifest_pins_geometry(self, trunk, corpus, tmp_path):
+        params, cfg = trunk
+        ids, seqs = corpus
+        store = str(tmp_path / "store")
+        run_map(params, cfg, ids, seqs, store, **MAP_KW)
+        with pytest.raises(StoreConfigError, match="block_size"):
+            run_map(params, cfg, ids, seqs, store,
+                    **dict(MAP_KW, block_size=5))
+        with pytest.raises(StoreConfigError, match="corpus"):
+            run_map(params, cfg, ids, list(reversed(seqs)), store,
+                    **MAP_KW)
+
+    def test_stop_flag_preempts_resumably(self, trunk, corpus, tmp_path):
+        params, cfg = trunk
+        ids, seqs = corpus
+        calls = [0]
+
+        def stop():
+            calls[0] += 1
+            return calls[0] > 2  # allow two blocks, then "SIGTERM"
+
+        out = run_map(params, cfg, ids, seqs, str(tmp_path / "store"),
+                      **dict(MAP_KW, stop_flag=stop))
+        assert out["outcome"] == "preempted" and out["blocks"] == 2
+        out = run_map(params, cfg, ids, seqs, str(tmp_path / "store"),
+                      **MAP_KW)
+        assert out["outcome"] == "completed"
+        assert verify_store(str(tmp_path / "store"))["complete"]

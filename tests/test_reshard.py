@@ -297,6 +297,9 @@ class TestTornRestoreFallback:
         assert len(notes) == 1
         assert notes[0]["kind"] == "restore_fallback"
         assert notes[0]["bad_step"] == 2
+        # ISSUE 14 satellite: the payload names BOTH ends of the skip —
+        # the torn step and the step the restore landed on.
+        assert notes[0]["landed_step"] == 1
         # The note payload is emittable as a schema-valid `note` event.
         from proteinbert_tpu.obs.events import make_record, validate_record
 
@@ -350,6 +353,9 @@ class TestTornRestoreFallback:
         ck.close()
         assert not isinstance(ei.value, AssertionError)
         assert len(notes) == 1 and notes[0]["bad_step"] == 3
+        # The fallback TARGET is on the note even when restoring it
+        # then fails too (the note reports where the fallback aimed).
+        assert notes[0]["landed_step"] == 2
 
     def test_empty_dir_still_returns_none(self, tmp_path):
         ck = Checkpointer(str(tmp_path), async_save=False)

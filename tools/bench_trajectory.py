@@ -182,6 +182,10 @@ _EVENT_METRICS = (
     ("serve_quant_capture", "parity_max", "serve_quant_parity_max"),
     ("heads_capture", "eval_score_min_quant",
      "heads_eval_score_min_quant"),
+    # Offline batch inference (ISSUE 14): the map drill's control-run
+    # throughput (tools/map_drill.py --bench-events) — a regression
+    # here means the pod-scale UniRef90 embedding job got slower.
+    ("map_capture", "map_seqs_per_s", "map_seqs_per_s"),
 )
 
 # Series (by base name, before the /platform suffix) where a LOWER

@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""Shared fault-injection surface for the chaos drills (ISSUE 14
+satellite): ONE module both tools/fleet_drill.py and tools/map_drill.py
+import for their injection needs, so the drills cannot drift apart in
+how they kill, tear, delay, or error-inject.
+
+What lives here:
+
+- `FaultInjector` — the in-process router injector (latency spikes,
+  simulated connection kills, torn health) re-exported from
+  serve/fleet.py and EXTENDED with generic error hooks
+  (`fail(key, times)` / `check(key)`) so a drill can make any
+  instrumented call site raise N times.
+- Torn-file helpers (`tear_file`, `flip_byte`) — simulate a crash
+  mid-write / bit rot on cursors, health responses, and store objects.
+- `sigkill` — the hardest process landing, for subprocess drills.
+- `map_fault_spec` — builder for the PBT_MAP_FAULTS env spec the map
+  engine consumes (proteinbert_tpu/mapper/faults.py is the parser; the
+  format is documented there and round-tripped by `MapFaults.parse`).
+
+Scripts in tools/ put the repo root on sys.path and import this as
+`faults` (after inserting the tools dir) or via importlib.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import threading
+from typing import Dict, List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from proteinbert_tpu.mapper.faults import (  # noqa: E402,F401
+    FAULT_ENV, CRASH_POINTS, MapFaults, TransientDispatchError,
+)
+from proteinbert_tpu.serve.fleet import (  # noqa: E402
+    FaultInjector as _RouterFaultInjector,
+)
+
+
+class FaultInjector(_RouterFaultInjector):
+    """The fleet router's injector (latency / kill / torn health) plus
+    keyed error hooks: `fail(key, n)` arms `check(key)` to raise
+    `exc_type` on the next n calls. Thread-safe like the base."""
+
+    def __init__(self):
+        super().__init__()
+        self._fail_lock = threading.Lock()
+        self._fail: Dict[str, Tuple[int, type]] = {}
+
+    def fail(self, key: str, times: int,
+             exc_type: type = TransientDispatchError) -> None:
+        with self._fail_lock:
+            self._fail[key] = (int(times), exc_type)
+
+    def check(self, key: str) -> None:
+        """Raise the armed exception for `key` (consuming one count);
+        no-op otherwise — safe to leave in production code paths."""
+        with self._fail_lock:
+            left, exc_type = self._fail.get(key, (0, None))
+            if left <= 0:
+                return
+            self._fail[key] = (left - 1, exc_type)
+        raise exc_type(f"injected failure ({key})")
+
+
+def map_fault_spec(crash: Optional[Tuple[int, int, str]] = None,
+                   fail: Optional[Tuple[int, int, int]] = None,
+                   nan: Optional[Tuple[int, int]] = None,
+                   latency_s: float = 0.0) -> str:
+    """Build a PBT_MAP_FAULTS spec string (see mapper/faults.py for the
+    grammar); validated by round-tripping through the real parser so a
+    drill can never ship a spec the engine will not honor."""
+    parts: List[str] = []
+    if crash is not None:
+        parts.append("crash=%d:%d:%s" % crash)
+    if fail is not None:
+        parts.append("fail=%d:%d:%d" % fail)
+    if nan is not None:
+        parts.append("nan=%d:%d" % nan)
+    if latency_s > 0:
+        parts.append(f"latency={latency_s}")
+    spec = ";".join(parts)
+    MapFaults.parse(spec)  # raises on a malformed spec
+    return spec
+
+
+def tear_file(path: str, keep_bytes: Optional[int] = None,
+              keep_frac: float = 0.5) -> int:
+    """Truncate a file the way a crash mid-write leaves it (keep the
+    first `keep_bytes`, default `keep_frac` of it). Returns the bytes
+    kept; refuses to 'tear' by keeping everything."""
+    with open(path, "rb") as f:
+        data = f.read()
+    keep = keep_bytes if keep_bytes is not None \
+        else max(1, int(len(data) * keep_frac))
+    if keep >= len(data):
+        raise ValueError(f"tear_file would keep all {len(data)} bytes "
+                         f"of {path}")
+    with open(path, "wb") as f:
+        f.write(data[:keep])
+    return keep
+
+
+def flip_byte(path: str, offset: int = -1) -> None:
+    """XOR one byte in place (bit rot / torn sector simulation — the
+    `--verify` detection target)."""
+    with open(path, "rb") as f:
+        data = bytearray(f.read())
+    data[offset] ^= 0xFF
+    with open(path, "wb") as f:
+        f.write(bytes(data))
+
+
+def sigkill(proc_or_pid) -> None:
+    """SIGKILL a subprocess.Popen or raw pid — no drain, no handlers,
+    the landing the cursor protocol is built to survive."""
+    pid = getattr(proc_or_pid, "pid", proc_or_pid)
+    os.kill(int(pid), signal.SIGKILL)

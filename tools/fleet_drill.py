@@ -51,6 +51,7 @@ import urllib.request
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+sys.path.insert(1, os.path.dirname(os.path.abspath(__file__)))
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 os.environ.setdefault("PBT_DISABLE_DONATION", "1")
@@ -135,9 +136,11 @@ def _post(url: str, payload: dict, timeout: float = 60.0):
 def run_drill(args) -> dict:
     import numpy as np
 
+    from faults import FaultInjector  # tools/faults.py: the one shared
+    # injection surface of the fleet and map drills (ISSUE 14)
     from proteinbert_tpu.obs import Telemetry, read_events
     from proteinbert_tpu.serve.fleet import (
-        FaultInjector, FleetRouter, make_fleet_http_server,
+        FleetRouter, make_fleet_http_server,
     )
     from proteinbert_tpu.train import create_train_state
 

@@ -139,6 +139,19 @@ timeout -k 10 420 python "$(dirname "$0")/fleet_drill.py" --json \
 rcfd=$?
 [ "$rc" -eq 0 ] && rc=$rcfd
 
+# Map drill smoke (ISSUE 14): kill-anywhere offline inference through
+# real `pbt map` subprocesses — SIGKILL between a block's object write
+# and its cursor advance, a torn cursor, a torn block object, one
+# poisoned record, and an injected transient dispatch failure. GATED:
+# the resumed store is byte-identical to an uninterrupted control,
+# re-work <= 1 block per shard, quarantined == injected poison,
+# `pbt map --verify` detects a flipped byte (typed) and a deleted
+# block (hole), all events schema-valid.
+echo "=== map drill smoke (SIGKILL + torn artifacts, resume, verify) ==="
+timeout -k 10 480 python "$(dirname "$0")/map_drill.py" --json
+rcmd=$?
+[ "$rc" -eq 0 ] && rc=$rcmd
+
 # Quant smoke (ISSUE 12): tiny int8 ZeRO-1 steps on the 4x2 CPU-virtual
 # mesh vs the replicated fp32 reference + the quantized serve arm.
 # GATED: step-1 loss identity, param deviation within the documented

@@ -156,6 +156,43 @@ def self_test() -> int:
          "source": "bench", "kind": "pack_attn_capture",
          "attn_speedup_x": 1.1,
          "parity_max_abs_diff": float("nan")},  # finite when present
+        # offline batch inference (ISSUE 14): map_* rows are typed —
+        # the chaos drill audits streams with this validator, so a
+        # writer bug must fail here, not corrupt the drill's verdict.
+        {"v": 1, "event": "map_start", "seq": 0, "t": 0.0,
+         "config": {"num_shards": 2}},  # missing pid
+        {"v": 1, "event": "map_shard", "seq": 0, "t": 0.0,
+         "shard": 0, "state": "crawling"},  # unknown shard state
+        {"v": 1, "event": "map_shard", "seq": 0, "t": 0.0,
+         "shard": -1, "state": "start"},  # shard must be >= 0
+        {"v": 1, "event": "map_block", "seq": 0, "t": 0.0,
+         "shard": 0, "block": 0, "digest": "xyz",
+         "n": 8},  # digest must be a sha256 hex
+        {"v": 1, "event": "map_block", "seq": 0, "t": 0.0,
+         "shard": 0, "block": 0, "digest": "0" * 64,
+         "n": 8, "retries": -2},  # retries must be >= 0
+        {"v": 1, "event": "map_block", "seq": 0, "t": 0.0,
+         "shard": 0, "block": 0, "digest": "0" * 64, "n": 8,
+         "seqs_per_s": float("inf")},  # finite when present
+        {"v": 1, "event": "map_end", "seq": 0, "t": 0.0,
+         "outcome": "vanished", "stats": {}},  # unknown outcome
+        # the map_capture throughput note (tools/map_drill.py
+        # --bench-events): the sentinel's input series, typed+required.
+        {"v": 1, "event": "note", "seq": 0, "t": 0.0,
+         "source": "map_drill", "kind": "map_capture"},  # missing rate
+        {"v": 1, "event": "note", "seq": 0, "t": 0.0,
+         "source": "map_drill", "kind": "map_capture",
+         "map_seqs_per_s": 0.0},  # rate must be > 0
+        # the checkpointer's restore_fallback note: bad_step required,
+        # landed_step (ISSUE 14 satellite) typed when present.
+        {"v": 1, "event": "note", "seq": 0, "t": 0.0,
+         "source": "checkpoint", "kind": "restore_fallback"},  # no step
+        {"v": 1, "event": "note", "seq": 0, "t": 0.0,
+         "source": "checkpoint", "kind": "restore_fallback",
+         "bad_step": 3, "landed_step": -2},  # landed_step >= 0
+        {"v": 1, "event": "note", "seq": 0, "t": 0.0,
+         "source": "checkpoint", "kind": "restore_fallback",
+         "bad_step": 3, "landed_step": 2.5},  # landed_step is an int
     ]
     for rec in bad:
         try:

@@ -1212,7 +1212,7 @@ def _serve_ragged_ab(Server, params, cfg, seqs, max_batch, max_wait_s,
         deadline = time.monotonic() + 5.0
         prev = -1
         while time.monotonic() < deadline:  # quiesce (phase 2's rule)
-            cur = srv.scheduler.rows_total
+            cur = srv.scheduler.stats_counts()[1]  # locked read
             if (cur == prev and len(srv.queue) == 0
                     and srv.scheduler.pending_rows() == 0):
                 break
@@ -1792,7 +1792,7 @@ def run_serve(length_mix=None):
         deadline = time.monotonic() + 5.0
         prev = -1
         while time.monotonic() < deadline:
-            cur = srv.scheduler.rows_total
+            cur = srv.scheduler.stats_counts()[1]  # locked read
             pending = srv.scheduler.pending_rows()
             if cur == prev and len(srv.queue) == 0 and pending == 0:
                 break
@@ -2293,7 +2293,7 @@ def run_heads():
         deadline = time.monotonic() + 5.0
         prev = -1
         while time.monotonic() < deadline:
-            cur = srv.scheduler.rows_total
+            cur = srv.scheduler.stats_counts()[1]  # locked read
             if cur == prev and len(srv.queue) == 0 \
                     and srv.scheduler.pending_rows() == 0:
                 break
@@ -2396,10 +2396,11 @@ def run_heads():
         failures.append(
             f"parity batch expected exactly one shared trunk executable "
             f"(cold {n_trunk0} -> warm {n_trunk_parity})")
-    if psrv.scheduler.batches_total != 1:
+    mixed_batches = psrv.scheduler.stats_counts()[0]  # locked read
+    if mixed_batches != 1:
         failures.append(
             f"parity phase expected ONE mixed micro-batch, got "
-            f"{psrv.scheduler.batches_total}")
+            f"{mixed_batches}")
     heads_in_batch = len(set(gassign))
     if heads_in_batch < 3:
         failures.append(f"parity batch mixed only {heads_in_batch} heads")
@@ -2427,10 +2428,11 @@ def run_heads():
     if not parity_ok:
         failures.append("mixed-head micro-batch is not bit-identical "
                         "to per-head sequential serving")
-    if ssrv.scheduler.batches_total != heads_in_batch:
+    seq_batches = ssrv.scheduler.stats_counts()[0]  # locked read
+    if seq_batches != heads_in_batch:
         failures.append(
             f"partitioned parity server formed "
-            f"{ssrv.scheduler.batches_total} batches, expected "
+            f"{seq_batches} batches, expected "
             f"{heads_in_batch}")
     ssrv.abort()
 

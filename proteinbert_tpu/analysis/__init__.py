@@ -1,0 +1,40 @@
+"""`pbt check` — project-invariant static analysis (ISSUE 15).
+
+Six stdlib-`ast` rules derived from the repo's own correctness
+contracts, each with positive/negative fixture self-tests
+(tests/test_analysis.py) and a checked-in suppression baseline
+(tools/check_baseline.json — every entry carries a reason):
+
+- `jit-purity`       — no host clocks/randomness/env reads/global
+                       mutation reachable from jit/shard_map/
+                       pallas_call (analysis/purity.py);
+- `lock-discipline`  — `# guarded-by: _lock` attributes only touched
+                       under their lock, plus a static lock-order
+                       cycle check (analysis/locks.py);
+- `durability-protocol` — tmp→fsync→rename or nothing in the durable
+                       writers (analysis/durability.py);
+- `event-schema`     — every `emit("<name>", ...)` call site checked
+                       against EVENT_FIELDS, statically
+                       (analysis/schema_rule.py);
+- `obs-doc-drift`    — events/metrics vs docs/observability.md, both
+                       directions (analysis/docs_rule.py);
+- `dead-export`      — `__init__` exports nothing references
+                       (analysis/exports_rule.py).
+
+This package imports NOTHING from the rest of the repo (the event
+schema is parsed off the AST, never imported) so `tools/pbt_check.py`
+can run it without jax — see docs/analysis.md.
+"""
+
+from proteinbert_tpu.analysis.context import CheckConfig, CheckContext
+from proteinbert_tpu.analysis.findings import (
+    BaselineError, Finding, load_baseline, report_dict, save_baseline,
+    split_by_baseline,
+)
+from proteinbert_tpu.analysis.runner import RULES, main, run_check
+
+__all__ = [
+    "CheckConfig", "CheckContext", "Finding", "BaselineError",
+    "load_baseline", "save_baseline", "split_by_baseline",
+    "report_dict", "RULES", "run_check", "main",
+]

@@ -1,0 +1,123 @@
+"""Rule 3 — `durability-protocol`: tmp → fsync → rename, or nothing.
+
+`mapper/store.py` owns the crash-safety story (PR 13: objects and
+cursors are absent-or-complete because every write goes tmp → flush →
+fsync → atomic rename) and `train/checkpoint.py` rides orbax's
+equivalent. This rule pins the protocol in the durability files
+(`cfg.durability_files`):
+
+1. **rename-without-fsync**: an `os.replace`/`os.rename` whose SOURCE
+   expression was opened for write in the same function must have an
+   `os.fsync` between the open and the rename — otherwise the rename
+   can land before the data and a crash leaves a "complete" name with
+   torn bytes (precisely the torn-survivor class the drills hunt).
+2. **bare-final-write**: opening a path for (over)write whose handle is
+   never the source of a rename in that function writes bytes straight
+   to a FINAL path — a crash mid-write leaves a torn file under its
+   real name. Append mode is exempt (the quarantine/event sidecars are
+   append-only by design, torn-tail-tolerant at read time).
+
+Matching is per-function and textual on the path expression
+(`ast.unparse`), which is exactly how the real code is shaped: every
+atomic write in this repo opens `tmp` and replaces `tmp → path` within
+one function (`_atomic_write`).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Tuple
+
+from proteinbert_tpu.analysis.context import CheckContext, dotted
+from proteinbert_tpu.analysis.findings import Finding
+
+RULE = "durability-protocol"
+
+_WRITE_MODES = ("w", "wb", "w+", "wb+", "w+b", "x", "xb")
+
+
+def _open_write_target(node: ast.Call) -> Optional[str]:
+    """The unparsed path expression of an `open(path, "w*")` /
+    `os.fdopen(fd, "w*")` call, or None when not a write-mode open."""
+    name = dotted(node.func)
+    if name not in ("open", "os.fdopen"):
+        return None
+    mode: Optional[str] = None
+    if len(node.args) >= 2 and isinstance(node.args[1], ast.Constant):
+        mode = node.args[1].value
+    for kw in node.keywords:
+        if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+            mode = kw.value.value
+    if not isinstance(mode, str) or mode not in _WRITE_MODES:
+        return None
+    if not node.args:
+        return None
+    return ast.unparse(node.args[0])
+
+
+def check(ctx: CheckContext) -> List[Finding]:
+    import os
+
+    findings: List[Finding] = []
+    for rel in ctx.cfg.durability_files:
+        if not os.path.exists(ctx.cfg.abspath(rel)):
+            continue  # tree without this subsystem (fixture roots)
+        pf = ctx.load(rel)
+        if pf is None or pf.tree is None:
+            continue
+        for node in ast.walk(pf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                findings.extend(_check_function(pf.path, node))
+    return findings
+
+
+def _check_function(path: str, fn: ast.AST) -> List[Finding]:
+    opens: List[Tuple[int, str]] = []     # (line, path expr)
+    fsyncs: List[int] = []                # lines
+    renames: List[Tuple[int, str, ast.Call]] = []  # (line, src expr)
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        target = _open_write_target(node)
+        if target is not None:
+            opens.append((node.lineno, target))
+            continue
+        name = dotted(node.func)
+        if name in ("os.fsync", "fsync"):
+            fsyncs.append(node.lineno)
+        elif name in ("os.replace", "os.rename") and node.args:
+            renames.append((node.lineno, ast.unparse(node.args[0]),
+                            node))
+
+    out: List[Finding] = []
+    fname = getattr(fn, "name", "<fn>")
+    renamed_exprs = {src for _, src, _ in renames}
+    for rline, src, _node in renames:
+        matching = [(oline, t) for oline, t in opens
+                    if t == src and oline <= rline]
+        if not matching:
+            continue  # source not opened here (caller's durable bytes)
+        oline = max(o for o, _ in matching)
+        if not any(oline <= f <= rline for f in fsyncs):
+            out.append(Finding(
+                rule=RULE, path=path, line=rline,
+                symbol=f"{fname}:rename-without-fsync:{src}",
+                message=(f"`os.replace({src}, ...)` in `{fname}` renames "
+                         "a file opened for write in this function with "
+                         "no os.fsync between write and rename — the "
+                         "rename can land before the data (torn "
+                         "survivor); fsync before renaming"),
+            ))
+    for oline, target in opens:
+        if target in renamed_exprs:
+            continue  # tmp half of a tmp→rename pair
+        out.append(Finding(
+            rule=RULE, path=path, line=oline,
+            symbol=f"{fname}:bare-final-write:{target}",
+            message=(f"`open({target}, 'w…')` in `{fname}` writes bytes "
+                     "directly to a final path (no tmp→fsync→rename in "
+                     "this function) — a crash mid-write leaves a torn "
+                     "file under its real name; write a tmp sibling and "
+                     "os.replace it"),
+        ))
+    return out

@@ -1,0 +1,256 @@
+"""Rule 1 — `jit-purity`: no host nondeterminism at trace time.
+
+The two nastiest bugs in this repo's history were trace-time invariant
+violations: the PR 1 donation bug and the PR 11 "XLA hoists the dequant
+converts" bug both came from host state leaking into a traced function.
+This rule makes the invariant mechanical: any function reachable (via
+module-local calls) from a `jax.jit` / `shard_map` / `pallas_call`
+entry point must not
+
+- read a host clock (`time.time` / `perf_counter` / `monotonic`),
+- draw host randomness (`random.*`, `np.random.*` — `jax.random` is of
+  course fine: it's traced),
+- read the environment (`os.environ` / `os.getenv`) outside the
+  sanctioned trace-time readers (`cfg.sanctioned_env_readers`, e.g.
+  `force_reference_requested`, the documented
+  `PBT_FORCE_REFERENCE_KERNEL` reader), or
+- declare `global` (mutating a captured module global from inside a
+  trace runs once per TRACE, not per step — a silent cache-keyed bug).
+
+Reachability is intra-module and name-based: `f(x)` resolves to a
+module-level `def f`, `self.m()` to a method of the lexically
+enclosing class. Cross-module reachability is deliberately out of
+scope (documented in docs/analysis.md) — the high-value sites (kernel
+dispatch, train-step factories) keep their helpers module-local.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from proteinbert_tpu.analysis.context import (
+    CheckContext, ParsedFile, dotted,
+)
+from proteinbert_tpu.analysis.findings import Finding
+
+RULE = "jit-purity"
+
+# Call heads that make their function argument a trace root. Matched on
+# the final attribute (or bare imported name), so `jax.jit`, `pl.jit`…
+# all hit; `pallas_call`'s kernel and `shard_map`'s f are arg 0 too.
+_TRACE_ENTRY_HEADS = {"jit", "shard_map", "pallas_call"}
+_CLOCK_CALLS = {"time.time", "time.perf_counter", "time.monotonic",
+                "time.monotonic_ns", "time.time_ns",
+                "time.perf_counter_ns"}
+
+
+def _head(name: Optional[str]) -> Optional[str]:
+    return name.rsplit(".", 1)[-1] if name else None
+
+
+class _FnInfo:
+    """One function/method definition and where it lives."""
+
+    def __init__(self, node: ast.AST, cls: Optional[str],
+                 qual: str) -> None:
+        self.node = node
+        self.cls = cls      # enclosing class name, if a method
+        self.qual = qual    # "Class.method" or "func" (nesting flattened)
+
+
+def _collect_functions(tree: ast.AST) -> List[_FnInfo]:
+    out: List[_FnInfo] = []
+
+    def visit(node: ast.AST, cls: Optional[str], prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}{child.name}"
+                out.append(_FnInfo(child, cls, qual))
+                # Nested defs keep the class scope of their enclosing
+                # method (self.x inside them still binds that class).
+                visit(child, cls, f"{qual}.")
+            elif isinstance(child, ast.ClassDef):
+                visit(child, child.name, f"{child.name}.")
+            else:
+                visit(child, cls, prefix)
+
+    visit(tree, None, "")
+    return out
+
+
+def _trace_roots(tree: ast.AST, fns: List[_FnInfo]) -> List[_FnInfo]:
+    """Functions handed to jit/shard_map/pallas_call — as a call
+    argument or via decorators (@jax.jit, @partial(jax.jit, ...))."""
+    by_name: Dict[str, List[_FnInfo]] = {}
+    by_method: Dict[Tuple[str, str], _FnInfo] = {}
+    for fi in fns:
+        by_name.setdefault(fi.node.name, []).append(fi)
+        if fi.cls is not None:
+            by_method[(fi.cls, fi.node.name)] = fi
+
+    roots: List[_FnInfo] = []
+    seen: Set[int] = set()
+
+    def add(fi: Optional[_FnInfo]) -> None:
+        if fi is not None and id(fi.node) not in seen:
+            seen.add(id(fi.node))
+            roots.append(fi)
+
+    def resolve_arg(arg: ast.AST, cls_hint: Optional[str]) -> None:
+        if isinstance(arg, ast.Name):
+            for fi in by_name.get(arg.id, []):
+                add(fi)
+        elif isinstance(arg, ast.Attribute) and \
+                isinstance(arg.value, ast.Name) and arg.value.id == "self":
+            if cls_hint is not None:
+                add(by_method.get((cls_hint, arg.attr)))
+            else:
+                for fi in by_name.get(arg.attr, []):
+                    if fi.cls is not None:
+                        add(fi)
+        elif isinstance(arg, ast.Lambda):
+            # Treat the lambda body as an anonymous root.
+            add(_FnInfo(arg, cls_hint, "<lambda>"))
+
+    def is_entry(call: ast.Call) -> bool:
+        return _head(dotted(call.func)) in _TRACE_ENTRY_HEADS
+
+    def is_partial_entry(call: ast.Call) -> bool:
+        # partial(jax.jit, ...) / functools.partial(jax.jit, ...)
+        return (_head(dotted(call.func)) == "partial" and call.args
+                and _head(dotted(call.args[0])) in _TRACE_ENTRY_HEADS)
+
+    # A function whose body CONTAINS a pallas_call/shard_map dispatch
+    # is itself executed at trace time of whatever (possibly
+    # cross-module) jit wraps it — the kernel-dispatch wrappers in
+    # kernels/ are the canonical case — so its body is held to the
+    # same purity bar.
+    for fi in fns:
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Call) and _head(dotted(node.func)) \
+                    in ("pallas_call", "shard_map"):
+                add(fi)
+                break
+
+    # Walk with class context so `jax.jit(self._fn)` resolves.
+    def walk(node: ast.AST, cls: Optional[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            child_cls = child.name if isinstance(child, ast.ClassDef) \
+                else cls
+            if isinstance(child, ast.Call) and is_entry(child):
+                if child.args:
+                    resolve_arg(child.args[0], cls)
+                for kw in child.keywords:
+                    if kw.arg in ("fun", "f", "kernel"):
+                        resolve_arg(kw.value, cls)
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in child.decorator_list:
+                    if (_head(dotted(dec)) in _TRACE_ENTRY_HEADS
+                        or (isinstance(dec, ast.Call)
+                            and (is_entry(dec) and not dec.args
+                                 or is_partial_entry(dec)))):
+                        for fi in by_name.get(child.name, []):
+                            if fi.node is child:
+                                add(fi)
+            walk(child, child_cls)
+
+    walk(tree, None)
+    return roots
+
+
+def _reachable(roots: List[_FnInfo], fns: List[_FnInfo]) -> List[_FnInfo]:
+    by_name: Dict[str, List[_FnInfo]] = {}
+    by_method: Dict[Tuple[str, str], _FnInfo] = {}
+    for fi in fns:
+        by_name.setdefault(fi.node.name, []).append(fi)
+        if fi.cls is not None:
+            by_method[(fi.cls, fi.node.name)] = fi
+
+    out: List[_FnInfo] = []
+    seen: Set[int] = set()
+    stack = list(roots)
+    while stack:
+        fi = stack.pop()
+        if id(fi.node) in seen:
+            continue
+        seen.add(id(fi.node))
+        out.append(fi)
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            if isinstance(node.func, ast.Name):
+                stack.extend(by_name.get(node.func.id, []))
+            elif isinstance(node.func, ast.Attribute) and \
+                    isinstance(node.func.value, ast.Name) and \
+                    node.func.value.id == "self" and fi.cls is not None:
+                target = by_method.get((fi.cls, node.func.attr))
+                if target is not None:
+                    stack.append(target)
+    return out
+
+
+def _has_import(tree: ast.AST, module: str) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            if any(a.name == module or (a.asname or a.name) == module
+                   for a in node.names):
+                return True
+    return False
+
+
+def check(ctx: CheckContext) -> List[Finding]:
+    findings: List[Finding] = []
+    sanctioned = set(ctx.cfg.sanctioned_env_readers)
+    for pf in ctx.files:
+        if pf.tree is None:
+            continue
+        fns = _collect_functions(pf.tree)
+        roots = _trace_roots(pf.tree, fns)
+        if not roots:
+            continue
+        host_random = _has_import(pf.tree, "random")
+        for fi in _reachable(roots, fns):
+            name = getattr(fi.node, "name", "<lambda>")
+            if name in sanctioned:
+                continue
+            findings.extend(
+                _check_body(pf, fi, host_random=host_random))
+    return findings
+
+
+def _check_body(pf: ParsedFile, fi: _FnInfo, *,
+                host_random: bool) -> List[Finding]:
+    out: List[Finding] = []
+
+    def flag(node: ast.AST, what: str) -> None:
+        out.append(Finding(
+            rule=RULE, path=pf.path, line=node.lineno,
+            symbol=f"{fi.qual}:{what}",
+            message=(f"{what} inside jit-reachable function "
+                     f"`{fi.qual}` — host state read/mutated at trace "
+                     "time; hoist it to the call site or use a "
+                     "sanctioned trace-time reader"),
+        ))
+
+    for node in ast.walk(fi.node):
+        if isinstance(node, ast.Call):
+            name = dotted(node.func)
+            if name in _CLOCK_CALLS:
+                flag(node, name)
+            elif name is not None and host_random and \
+                    name.startswith("random."):
+                flag(node, name)
+            elif name is not None and (name.startswith("np.random.")
+                                       or name.startswith(
+                                           "numpy.random.")):
+                flag(node, name)
+            elif name in ("os.getenv", "getenv"):
+                flag(node, "os.getenv")
+        elif isinstance(node, ast.Attribute) and node.attr == "environ":
+            base = dotted(node)
+            if base == "os.environ":
+                flag(node, "os.environ")
+        elif isinstance(node, ast.Global):
+            flag(node, f"global {','.join(node.names)}")
+    return out

@@ -1441,6 +1441,36 @@ def cmd_reshard(args) -> int:
     return 0
 
 
+def cmd_check(args) -> int:
+    """Project-invariant static analyzer (ISSUE 15 tentpole): six
+    stdlib-ast rules derived from the repo's own contracts — jit
+    purity, lock discipline, durability protocol, event-schema call
+    sites, obs-doc drift, dead exports — with a checked-in suppression
+    baseline. Same runner as the jax-free tier-1 entry
+    (tools/pbt_check.py); exit 0 = clean, 1 = findings, 2 = config
+    error. docs/analysis.md is the rule catalog."""
+    from proteinbert_tpu.analysis.runner import main as check_main
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    argv = []
+    if args.json:
+        argv.append("--json")
+    if args.json_artifact:
+        argv.extend(["--json-artifact", args.json_artifact])
+    for rule in args.rule or ():
+        argv.extend(["--rule", rule])
+    if args.events_jsonl:
+        argv.extend(["--events-jsonl", args.events_jsonl])
+    if args.baseline:
+        argv.extend(["--baseline", args.baseline])
+    if args.root:
+        argv.extend(["--root", args.root])
+    if args.write_baseline:
+        argv.append("--write-baseline")
+    return check_main(argv, repo_root=repo_root)
+
+
 def cmd_fleet(args) -> int:
     """Fault-tolerant serve fleet (ISSUE 11 tentpole): N `pbt serve`
     replica subprocesses behind the FleetRouter (serve/fleet.py) —
@@ -2137,6 +2167,32 @@ def build_parser() -> argparse.ArgumentParser:
                          "replica writes its own stream beside its "
                          "log)")
     fl.set_defaults(fn=cmd_fleet)
+
+    ck = sub.add_parser(
+        "check",
+        help="project-invariant static analyzer (jit purity, lock "
+             "discipline, durability protocol, event schema, doc "
+             "drift, dead exports) — docs/analysis.md")
+    ck.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout")
+    ck.add_argument("--json-artifact", type=creatable_path,
+                    help="also write the JSON report here (the "
+                         "bench-trajectory check_findings_total input)")
+    ck.add_argument("--rule", action="append", metavar="NAME",
+                    help="run only this rule (repeatable)")
+    ck.add_argument("--events-jsonl", type=creatable_path,
+                    help="mirror the counts as a note(kind="
+                         "check_capture) event — the trajectory "
+                         "sentinel's suppression-creep series")
+    ck.add_argument("--baseline",
+                    help="suppression baseline JSON (default: "
+                         "tools/check_baseline.json)")
+    ck.add_argument("--root", help="tree to analyze (default: the "
+                                   "installed repo root)")
+    ck.add_argument("--write-baseline", action="store_true",
+                    help="record current findings as suppressions for "
+                         "human review")
+    ck.set_defaults(fn=cmd_check)
 
     return p
 

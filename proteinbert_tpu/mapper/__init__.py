@@ -33,13 +33,12 @@ __all__ = [
     "iter_embeddings", "next_offset", "resume_shard", "serialize_block",
     "shard_ranges", "store_digests", "verify_store",
     # lazy (jax-importing) engine surface:
-    "run_map", "MapError", "ShardHaltedError", "poison_reason",
+    "run_map", "poison_reason",
 ]
 
 
 def __getattr__(name):  # PEP 562: keep --verify jax-free
-    if name in ("run_map", "MapError", "ShardHaltedError",
-                "poison_reason"):
+    if name in ("run_map", "poison_reason"):
         from proteinbert_tpu.mapper import engine
 
         return getattr(engine, name)

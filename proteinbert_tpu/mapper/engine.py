@@ -53,13 +53,10 @@ logger = logging.getLogger(__name__)
 POISON_REASONS = ("non_string", "empty", "invalid_char")
 
 
-class MapError(Exception):
-    """Base class for typed map-run failures."""
-
-
-class ShardHaltedError(MapError):
-    """A shard halted on non-finite output or an exhausted retry
-    budget; the run's outcome reflects it."""
+# Typed map-run failures are reported through the run OUTCOME —
+# "halted"/"error" on the map_end record — never as exceptions; the
+# once-exported MapError/ShardHaltedError hierarchy was dead API and
+# was removed by the ISSUE 15 dead-export sweep.
 
 
 def poison_reason(seq: Any) -> Optional[str]:

@@ -54,7 +54,7 @@ from proteinbert_tpu.obs.slo import (
     ExemplarHistogram, ProfileTrigger, SLObjective, SLOEvaluator,
     parse_slo, parse_slos,
 )
-from proteinbert_tpu.obs.tracing import SpanCollector, span, step_span
+from proteinbert_tpu.obs.tracing import SpanCollector, span
 
 _NULL_CTX = contextlib.nullcontext()
 
@@ -82,8 +82,8 @@ class Telemetry:
         self.flight = FlightRecorder(capacity=flight_capacity,
                                      directory=flight_dir)
         self.spans = SpanCollector() if spans else None
-        self._seq = 0
-        self._last_t = 0.0
+        self._seq = 0          # guarded-by: _lock
+        self._last_t = 0.0     # guarded-by: _lock
         self._lock = _threading.Lock()
 
     def emit(self, event: str, **fields) -> Optional[Dict[str, Any]]:
@@ -163,6 +163,6 @@ __all__ = [
     "MetricsRegistry", "QuantileWindow",
     "SLObjective", "SLOEvaluator", "ExemplarHistogram", "ProfileTrigger",
     "parse_slo", "parse_slos",
-    "SpanCollector", "span", "step_span",
+    "SpanCollector", "span",
     "FlightRecorder", "flight_path", "validate_flight_dump",
 ]

@@ -440,6 +440,22 @@ def validate_record(rec: Any) -> None:
             raise ValueError(
                 f"note(kind=map_capture).map_seqs_per_s must be a "
                 f"positive finite number, got {v!r}")
+    if event == "note" and rec.get("kind") == "check_capture":
+        # The static-analyzer capture (`pbt check --events-jsonl`,
+        # ISSUE 15): check_findings_total (new + baselined findings) is
+        # the trajectory sentinel's suppression-creep series, so a
+        # writer bug must fail validation, not poison the series.
+        for name in ("check_findings_total", "check_baselined_total"):
+            v = rec.get(name)
+            if name == "check_findings_total" and v is None:
+                raise ValueError(
+                    "note(kind=check_capture): missing required field "
+                    "'check_findings_total'")
+            if v is not None and (not isinstance(v, int)
+                                  or isinstance(v, bool) or v < 0):
+                raise ValueError(
+                    f"note(kind=check_capture).{name} must be a "
+                    f"non-negative int, got {v!r}")
     if event == "note" and rec.get("kind") == "restore_fallback":
         # The checkpointer's torn-final-checkpoint fallback report
         # (train/checkpoint.py): bad_step (the skipped torn step) is
@@ -558,9 +574,9 @@ class EventLog:
             os.makedirs(d, exist_ok=True)
         self._fh = open(self.path, "a", buffering=1)
         self._lock = threading.Lock()
-        self._seq = 0
-        self._last_t = 0.0
-        self._dead = False
+        self._seq = 0          # guarded-by: _lock
+        self._last_t = 0.0     # guarded-by: _lock
+        self._dead = False     # guarded-by: _lock
 
     def emit(self, event: str, **fields) -> Optional[Dict[str, Any]]:
         """Validate + append one record; returns it (also handed to the

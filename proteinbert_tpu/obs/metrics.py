@@ -98,7 +98,7 @@ class QuantileWindow:
 
     def __init__(self, capacity: int = 2048):
         self._ring: "collections.deque[float]" = collections.deque(
-            maxlen=capacity)
+            maxlen=capacity)               # guarded-by: _lock
         self._lock = threading.Lock()
 
     def observe(self, seconds: float) -> None:

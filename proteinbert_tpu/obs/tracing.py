@@ -8,8 +8,8 @@
   parses host-span dumps and device traces with one parser;
 - when jax is already imported, the span body also runs under
   `jax.profiler.TraceAnnotation`, so spans appear on the host lane of a
-  live device trace (and under `step_span`, `StepTraceAnnotation` gives
-  the profiler step boundaries for its per-step views);
+  live device trace (and with `step=`, `StepTraceAnnotation` gives the
+  profiler step boundaries for its per-step views);
 - nesting depth is tracked per-thread, so a collector dump renders as a
   flame graph (perfetto nests by timestamps; depth is kept as an arg
   for flat consumers).
@@ -124,9 +124,6 @@ def span(name: str, collector: Optional[SpanCollector] = None,
                 args["step"] = step
             collector.add(name, wall0, dur, depth, **args)
 
-
-def step_span(step: int, collector: Optional[SpanCollector] = None,
-              name: str = "train_step"):
-    """Span for one training step: uses StepTraceAnnotation so a live
-    device trace gets proper step boundaries."""
-    return span(name, collector=collector, step=step)
+# (A step_span(step, …) convenience wrapper used to live here; nothing
+# referenced it — removed by the ISSUE 15 dead-export sweep. Pass
+# `step=` to span() for StepTraceAnnotation boundaries.)

@@ -92,9 +92,9 @@ class FaultInjector:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._latency: Dict[str, float] = {}
-        self._dead: set = set()
-        self._torn_health: set = set()
+        self._latency: Dict[str, float] = {}  # guarded-by: _lock
+        self._dead: set = set()               # guarded-by: _lock
+        self._torn_health: set = set()        # guarded-by: _lock
 
     def set_latency(self, replica: str, seconds: float) -> None:
         with self._lock:
@@ -221,11 +221,13 @@ class FleetRouter:
         self._lock = threading.Lock()
         self._rr = itertools.count()
         # Exactly-once seal accounting: accepted == sealed at drain is
-        # the router-level invariant the drill asserts.
-        self.accepted_total = 0
-        self.sealed_total = 0
-        self.retries_spent = 0
-        self.outcomes: Dict[str, int] = {}
+        # the router-level invariant the drill asserts. Declared in the
+        # `pbt check` lock-discipline registry: any unlocked touch of
+        # these fails the tier-1 gate (docs/analysis.md).
+        self.accepted_total = 0           # guarded-by: _lock
+        self.sealed_total = 0             # guarded-by: _lock
+        self.retries_spent = 0            # guarded-by: _lock
+        self.outcomes: Dict[str, int] = {}  # guarded-by: _lock
         metrics = self.tele.metrics
         from proteinbert_tpu.obs.events import FLEET_REQUEST_OUTCOMES
 
@@ -240,7 +242,7 @@ class FleetRouter:
         self._admitting_g = metrics.gauge("fleet_replicas_admitting")
         self._health_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
-        self._ended = False
+        self._ended = False               # guarded-by: _lock
         self._req_ids = itertools.count(1)
         self._id_prefix = f"f{os.getpid():x}-"
 
@@ -275,10 +277,15 @@ class FleetRouter:
         self._stop.set()
         if self._health_thread is not None:
             self._health_thread.join(timeout=5.0)
-        if not self._ended:
+        # The ended latch is lock-guarded (a concurrent double-drain
+        # must emit exactly one terminal record); the emit itself runs
+        # OUTSIDE the lock because stats() re-acquires it.
+        with self._lock:
+            if self._ended:
+                return
             self._ended = True
-            self.tele.emit("fleet_end", outcome="drained",
-                           stats=self.stats())
+        self.tele.emit("fleet_end", outcome="drained",
+                       stats=self.stats())
 
     # -------------------------------------------------------- health loop
 

@@ -93,18 +93,23 @@ class MicroBatchScheduler:
         # error if any, and batch context) — the trace/SLO hook.
         self._on_complete = complete_observer or (
             lambda req, outcome, now, err, ctx: None)
+        # Guarded by _pending_lock (declared below): normally
+        # scheduler-thread-private, but fail_pending (abort with a
+        # still-live thread stuck in a long jitted call) and
+        # pending_rows (bench quiesce poll) touch it from other
+        # threads.
         self._pending: "collections.OrderedDict[GroupKey, collections.deque]" \
-            = collections.OrderedDict()
-        # Guards _pending: normally scheduler-thread-private, but
-        # fail_pending (abort with a still-live thread stuck in a long
-        # jitted call) and pending_rows (bench quiesce poll) touch it
-        # from other threads.
+            = collections.OrderedDict()      # guarded-by: _pending_lock
         self._pending_lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
         self._stopped = threading.Event()
-        self.batches_total = 0
-        self.rows_total = 0
-        self.expired_total = 0
+        # Dispatch counters: written by the scheduler thread, read by
+        # Server.stats() from client/HTTP threads — lock-guarded (the
+        # unlocked stats()-path read ISSUE 15's lock rule was built to
+        # catch) and read through stats_counts().
+        self.batches_total = 0               # guarded-by: _pending_lock
+        self.rows_total = 0                  # guarded-by: _pending_lock
+        self.expired_total = 0               # guarded-by: _pending_lock
         self._occupancy_g = self.tele.metrics.gauge("serve_batch_occupancy")
         self._rows_h = self.tele.metrics.histogram("serve_batch_rows")
         self._batch_h = self.tele.metrics.histogram("serve_batch_seconds")
@@ -127,6 +132,14 @@ class MicroBatchScheduler:
     def pending_rows(self) -> int:
         with self._pending_lock:
             return sum(len(d) for d in self._pending.values())
+
+    def stats_counts(self) -> Tuple[int, int, int]:
+        """(batches_total, rows_total, expired_total) under the lock —
+        the one coherent read for Server.stats()/bench, so a stats
+        scrape mid-dispatch can never see a half-updated pair."""
+        with self._pending_lock:
+            return (self.batches_total, self.rows_total,
+                    self.expired_total)
 
     def _ingest(self, now: float) -> None:
         items = self.queue.pop_all()
@@ -171,8 +184,9 @@ class MicroBatchScheduler:
         # (queued + formed-but-undispatched), AFTER dropping the
         # expired rows themselves.
         depth = self.pending_rows() + len(self.queue)
+        with self._pending_lock:
+            self.expired_total += len(expired)
         for req in expired:
-            self.expired_total += 1
             self._observe_wait(req, now)
             req.future.set_exception(DeadlineExceededError(
                 f"deadline passed after "
@@ -309,8 +323,9 @@ class MicroBatchScheduler:
                     prep_s=ctx.get("prep_s"),
                     device_s=ctx.get("device_s"))
             self._on_complete(req, outcome, self.clock(), err, ctx)
-        self.batches_total += 1
-        self.rows_total += len(batch)
+        with self._pending_lock:
+            self.batches_total += 1
+            self.rows_total += len(batch)
         self._occupancy_g.set(len(batch) / cls)
         self._rows_h.observe(len(batch))
         # Quant fields ride only when the arm set them: the documented
@@ -460,10 +475,9 @@ class PackedBatchScheduler(MicroBatchScheduler):
         self.max_segments = int(max_segments)
         self.seq_len = int(dispatcher.cfg.data.seq_len)
         # kind -> OnlinePacker of open rows (payloads are Requests).
-        # Guarded by the inherited _pending_lock, same contract as the
-        # base class's _pending map.
+        # Same contract as the base class's _pending map.
         self._packers: "collections.OrderedDict[str, object]" = \
-            collections.OrderedDict()
+            collections.OrderedDict()        # guarded-by: _pending_lock
 
     # -------------------------------------------------------- formation
 
@@ -491,8 +505,9 @@ class PackedBatchScheduler(MicroBatchScheduler):
         if not expired:
             return
         depth = self.pending_rows() + len(self.queue)
+        with self._pending_lock:
+            self.expired_total += len(expired)
         for req in expired:
-            self.expired_total += 1
             self._observe_wait(req, now)
             req.future.set_exception(DeadlineExceededError(
                 f"deadline passed after "
@@ -648,8 +663,9 @@ class PackedBatchScheduler(MicroBatchScheduler):
                     segments_per_row=ctx["segments_per_row"],
                     mode="ragged")
             self._on_complete(req, outcome, self.clock(), err, ctx)
-        self.batches_total += 1
-        self.rows_total += n_riders
+        with self._pending_lock:
+            self.batches_total += 1
+            self.rows_total += n_riders
         # Occupancy for a packed grid is token occupancy (1 - pad
         # fraction) when the batch was timed, else segment-slot fill.
         pad = ctx.get("pad_fraction")

@@ -750,6 +750,11 @@ class Server:
         from proteinbert_tpu.kernels.fused_block import PATH_TOTAL
 
         qw = self.scheduler.queue_wait
+        # One coherent locked read of the dispatch counters: the
+        # scheduler thread updates them under its lock (ISSUE 15
+        # lock-discipline rule), so an unlocked field read here could
+        # see a torn batches/rows pair mid-dispatch.
+        batches, rows, expired = self.scheduler.stats_counts()
         out = {
             "completed": self.completed_total,
             **mirrors,
@@ -777,11 +782,11 @@ class Server:
             "quant": ({"mode": self.quant, **self.dispatcher.quant_report}
                       if self.quant != "fp32" else None),
             "heads": len(self.dispatcher.heads),
-            "batches": self.scheduler.batches_total,
-            "batched_rows": self.scheduler.rows_total,
+            "batches": batches,
+            "batched_rows": rows,
             "queue_depth": len(self.queue),
             "evicted": self.queue.evicted_total,
-            "expired": self.scheduler.expired_total,
+            "expired": expired,
             "cache": self.cache.stats(),
             "latency": self.latencies.summary(),
             "queue_wait": {

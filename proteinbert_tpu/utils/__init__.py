@@ -17,8 +17,6 @@ from proteinbert_tpu.utils.stats import (
     liftover_positions,
     manhattan_plot,
     one_hot,
-    qq_plot,
-    scatter_plot,
     write_excel,
 )
 from proteinbert_tpu.utils.sharding import (
@@ -38,6 +36,6 @@ __all__ = [
     "shard_file_name", "all_shard_file_names",
     "benjamini_hochberg", "benjamini_hochberg_with_nulls",
     "drop_redundant_columns", "fisher_enrichment",
-    "one_hot", "qq_plot", "scatter_plot", "manhattan_plot",
+    "one_hot", "manhattan_plot",
     "write_excel", "liftover_positions",
 ]

@@ -174,6 +174,24 @@ def test_disabled_registry_is_a_noop():
                               "histograms": {}}
 
 
+def test_null_facade_is_inert_and_is_the_default():
+    # obs.NULL is the do-nothing telemetry every instrumented call
+    # site runs through when none is configured: emit returns None,
+    # span is a shared nullcontext, the registry is disabled, and
+    # as_telemetry(None) hands back exactly this object.
+    assert obs.as_telemetry(None) is obs.NULL
+    assert obs.NULL.enabled is False
+    assert obs.NULL.emit("step", step=1, metrics={}) is None
+    with obs.NULL.span("anything"):
+        pass
+    assert obs.NULL.dump_flight("reason") is None
+    obs.NULL.metrics.counter("c").inc()
+    assert obs.NULL.metrics.snapshot() == {
+        "counters": {}, "gauges": {}, "histograms": {}}
+    tele = obs.Telemetry(metrics=False)
+    assert obs.as_telemetry(tele) is tele
+
+
 def test_profiler_shim_keeps_api_and_feeds_registry():
     from proteinbert_tpu.utils.profiling import Profiler
 

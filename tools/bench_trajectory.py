@@ -88,13 +88,18 @@ def judge_series(values: List[float],
     delta = newest - center
     regressed = (delta < -band) if higher_is_better else (delta > band)
     improved = (delta > band) if higher_is_better else (delta < -band)
+    # A center of exactly 0 is legitimate (e.g. a clean
+    # check_findings_total history is all zeros) — report absolute
+    # deltas there instead of dividing by it.
+    def rel(x: float) -> str:
+        return (f"{abs(x) / abs(center) * 100:.1f}%" if center
+                else f"{abs(x):.6g} (absolute; baseline is 0)")
+
     if regressed:
         out["verdict"] = "regression"
-        out["reason"] = (f"newest {newest:.6g} is "
-                         f"{abs(delta) / abs(center) * 100:.1f}% "
+        out["reason"] = (f"newest {newest:.6g} is {rel(delta)} "
                          f"{'below' if higher_is_better else 'above'} "
-                         f"baseline {center:.6g} (band "
-                         f"{band / abs(center) * 100:.1f}%)")
+                         f"baseline {center:.6g} (band {rel(band)})")
     elif improved:
         out["verdict"] = "improved"
         out["reason"] = (f"newest {newest:.6g} beats baseline "
@@ -186,11 +191,17 @@ _EVENT_METRICS = (
     # throughput (tools/map_drill.py --bench-events) — a regression
     # here means the pod-scale UniRef90 embedding job got slower.
     ("map_capture", "map_seqs_per_s", "map_seqs_per_s"),
+    # Static-analyzer findings (ISSUE 15): new + baselined `pbt check`
+    # findings per capture (`--events-jsonl` mirror, or the fresh
+    # artifact via --check-json) — suppression creep moves this series
+    # even while the gate stays green. LOWER is better.
+    ("check_capture", "check_findings_total", "check_findings_total"),
 )
 
 # Series (by base name, before the /platform suffix) where a LOWER
 # value is the good direction — ratios and error bounds.
-_LOWER_IS_BETTER = {"comm_bytes_int8_ratio", "serve_quant_parity_max"}
+_LOWER_IS_BETTER = {"comm_bytes_int8_ratio", "serve_quant_parity_max",
+                    "check_findings_total"}
 
 
 def series_direction(name: str) -> bool:
@@ -227,12 +238,41 @@ def series_from_events(path: str,
 
 # -------------------------------------------------------------- verdict
 
+def check_findings_from_artifact(path: str,
+                                 errors: List[str]) -> Optional[int]:
+    """check_findings_total out of one `pbt check --json-artifact`
+    report (the tier-1 stage hands its fresh artifact here so the
+    current round's point rides the series without touching the
+    checked-in history)."""
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+    except (OSError, ValueError) as e:
+        errors.append(f"{path}: unreadable check artifact: {e}")
+        return None
+    if not isinstance(rec, dict) or rec.get("kind") != "pbt_check_report":
+        errors.append(f"{path}: not a pbt_check_report artifact")
+        return None
+    v = (rec.get("counts") or {}).get("check_findings_total")
+    if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+        errors.append(f"{path}: counts.check_findings_total must be a "
+                      f"non-negative int, got {v!r}")
+        return None
+    return v
+
+
 def build_verdict(bench_paths: List[str],
-                  events_path: Optional[str]) -> Dict[str, Any]:
+                  events_path: Optional[str],
+                  check_json: Optional[str] = None) -> Dict[str, Any]:
     errors: List[str] = []
     series = series_from_bench_files(bench_paths, errors)
     if events_path and os.path.exists(events_path):
         series.update(series_from_events(events_path, errors))
+    if check_json:
+        v = check_findings_from_artifact(check_json, errors)
+        if v is not None:
+            series.setdefault("check_findings_total/static",
+                              []).append(float(v))
     judged = {name: judge_series(values,
                                  higher_is_better=series_direction(name))
               for name, values in sorted(series.items())}
@@ -273,6 +313,10 @@ def main(argv=None) -> int:
     ap.add_argument("--events-jsonl", default=None,
                     help="ALSO mirror the overall verdict as a `note` "
                          "event on this stream (obs integration)")
+    ap.add_argument("--check-json", default=None,
+                    help="a fresh `pbt check --json-artifact` report; "
+                         "its check_findings_total rides the "
+                         "suppression-creep series as the newest point")
     ap.add_argument("--fail-on-regression", action="store_true",
                     help="exit 1 on a flagged regression (default: "
                          "report-only — only input errors fail)")
@@ -282,7 +326,8 @@ def main(argv=None) -> int:
                                                 args.bench_glob)))
     events_path = args.events or os.path.join(args.repo,
                                               "bench_events.jsonl")
-    verdict = build_verdict(bench_paths, events_path)
+    verdict = build_verdict(bench_paths, events_path,
+                            check_json=args.check_json)
 
     if args.output:
         with open(args.output, "w") as f:

@@ -1,0 +1,36 @@
+#!/usr/bin/env python
+"""Jax-free entry point for `pbt check` (ISSUE 15) — the tier-1 stage.
+
+The analyzer package (`proteinbert_tpu/analysis/`) is stdlib-only, but
+a plain `import proteinbert_tpu.analysis` would execute the package
+root `__init__.py`, which imports jax (it pins the threefry flag at
+import time). A pre-test lint gate must not pay — or require — jax
+device init, so this wrapper registers a STUB parent package whose
+`__path__` points at the real directory before importing the
+submodule: the import system finds the parent in sys.modules and never
+runs the real root `__init__`. The `pbt check` CLI verb runs the same
+`runner.main` with the package imported normally.
+
+Usage (identical flags to `pbt check`):
+  python tools/pbt_check.py [--json] [--json-artifact PATH]
+      [--rule NAME] [--baseline FILE] [--root DIR] [--write-baseline]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import types
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+if "proteinbert_tpu" not in sys.modules:
+    stub = types.ModuleType("proteinbert_tpu")
+    stub.__path__ = [os.path.join(REPO, "proteinbert_tpu")]
+    sys.modules["proteinbert_tpu"] = stub
+sys.path.insert(0, REPO)
+
+from proteinbert_tpu.analysis.runner import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main(repo_root=REPO))

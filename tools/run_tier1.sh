@@ -33,12 +33,30 @@ echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd
 
 # Events-schema validator self-test (ISSUE 3 satellite): every telemetry
 # event type must round-trip the validator, and garbage must be
-# rejected. Stdlib-only (<2 s, no jax) — runs even when the pytest tier
-# timed out, and its failure fails the gate.
-echo "=== telemetry events-schema validator self-test ==="
-python "$(dirname "$0")/validate_events.py" --self-test
+# rejected. --schema-sync (ISSUE 15) additionally asserts the negative
+# suite covers every event type, so a new event cannot ship without a
+# validator negative. Stdlib-only (<2 s, no jax) — runs even when the
+# pytest tier timed out, and its failure fails the gate.
+echo "=== telemetry events-schema validator self-test + schema-sync ==="
+python "$(dirname "$0")/validate_events.py" --self-test --schema-sync
 rcv=$?
 [ "$rc" -eq 0 ] && rc=$rcv
+
+# Project-invariant static analyzer (ISSUE 15 tentpole): six AST rules
+# (jit purity, lock discipline, durability protocol, event-schema call
+# sites, obs-doc drift, dead exports) over the whole tree, GATED — a
+# non-baselined finding fails tier-1. Pure python, no jax import
+# (tools/pbt_check.py stub-imports the analysis package past the jax-
+# importing package root); the JSON artifact feeds the trajectory
+# sentinel's suppression-creep series below. docs/analysis.md is the
+# rule catalog + suppression format.
+echo "=== pbt check (project-invariant static analyzer, gated) ==="
+check_json=$(mktemp /tmp/_pbt_check.XXXXXX.json)
+timeout -k 10 120 python "$(dirname "$0")/pbt_check.py" \
+  --json-artifact "$check_json"
+rcc=$?
+echo "check artifact: $check_json"
+[ "$rc" -eq 0 ] && rc=$rcc
 
 # Perf-regression sentinel (ISSUE 6 satellite): fit per-metric
 # baselines over the checked-in bench trajectory (BENCH_r*.json +
@@ -47,7 +65,8 @@ rcv=$?
 # in the inputs do (exit 2). Stdlib+obs only, <2 s, no jax.
 echo "=== bench trajectory sentinel (report-only) ==="
 verdict_json=$(mktemp /tmp/_bench_verdict.XXXXXX.json)
-python "$(dirname "$0")/bench_trajectory.py" --output "$verdict_json"
+python "$(dirname "$0")/bench_trajectory.py" --output "$verdict_json" \
+  --check-json "$check_json"
 rct=$?
 echo "verdict artifact: $verdict_json"
 [ "$rc" -eq 0 ] && rc=$rct

@@ -32,20 +32,25 @@ from proteinbert_tpu.obs.events import (  # noqa: E402
 from proteinbert_tpu.obs.flight import validate_flight_dump  # noqa: E402
 
 
-def self_test() -> int:
-    for event in sorted(EVENT_FIELDS):
-        rec = make_example(event)
-        try:
-            validate_record(rec)
-            # And through a JSON round trip, like real consumers see it.
-            validate_record(json.loads(json.dumps(rec)))
-        except ValueError as e:
-            print(f"SELF-TEST FAIL: example {event!r} does not validate: {e}")
-            return 1
-    # Negative control: the validator must actually reject garbage.
-    bad = [
+# Negative control: records the validator MUST reject, at least one
+# per event type in EVENT_FIELDS (the --schema-sync mode asserts that
+# coverage — a new event type cannot ship without a validator
+# negative). Module-level so self_test and schema_sync share one list.
+NEGATIVE_CASES = [
         {"v": 99, "event": "step", "seq": 0, "t": 0.0,
          "step": 1, "metrics": {}},
+        {"v": 1, "event": "run_start", "seq": 0, "t": 0.0,
+         "config": {}, "jax_version": "0.0.0"},  # missing pid
+        {"v": 1, "event": "eval", "seq": 0, "t": 0.0,
+         "step": -1, "metrics": {}},  # step must be >= 0
+        {"v": 1, "event": "requeue", "seq": 0, "t": 0.0,
+         "step": 1},  # missing reason
+        {"v": 1, "event": "nan_halt", "seq": 0, "t": 0.0,
+         "step": 1},  # missing metrics
+        {"v": 1, "event": "serve_start", "seq": 0, "t": 0.0,
+         "config": {}},  # missing pid
+        {"v": 1, "event": "serve_end", "seq": 0, "t": 0.0,
+         "outcome": "collapsed", "stats": {}},  # outcome drained|aborted
         {"v": 1, "event": "no_such_event", "seq": 0, "t": 0.0},
         {"v": 1, "event": "step", "seq": 0, "t": 0.0},  # missing fields
         {"v": 1, "event": "ckpt_stage", "seq": 0, "t": 0.0,
@@ -193,8 +198,31 @@ def self_test() -> int:
         {"v": 1, "event": "note", "seq": 0, "t": 0.0,
          "source": "checkpoint", "kind": "restore_fallback",
          "bad_step": 3, "landed_step": 2.5},  # landed_step is an int
-    ]
-    for rec in bad:
+        # the check_capture note (`pbt check --events-jsonl`, ISSUE
+        # 15): the suppression-creep series, typed + required.
+        {"v": 1, "event": "note", "seq": 0, "t": 0.0,
+         "source": "pbt_check", "kind": "check_capture"},  # no count
+        {"v": 1, "event": "note", "seq": 0, "t": 0.0,
+         "source": "pbt_check", "kind": "check_capture",
+         "check_findings_total": -1},  # count must be >= 0
+        {"v": 1, "event": "note", "seq": 0, "t": 0.0,
+         "source": "pbt_check", "kind": "check_capture",
+         "check_findings_total": 2,
+         "check_baselined_total": 1.5},  # typed when present
+]
+
+
+def self_test() -> int:
+    for event in sorted(EVENT_FIELDS):
+        rec = make_example(event)
+        try:
+            validate_record(rec)
+            # And through a JSON round trip, like real consumers see it.
+            validate_record(json.loads(json.dumps(rec)))
+        except ValueError as e:
+            print(f"SELF-TEST FAIL: example {event!r} does not validate: {e}")
+            return 1
+    for rec in NEGATIVE_CASES:
         try:
             validate_record(rec)
         except ValueError:
@@ -202,7 +230,32 @@ def self_test() -> int:
         print(f"SELF-TEST FAIL: accepted invalid record {rec!r}")
         return 1
     print(f"self-test OK: {len(EVENT_FIELDS)} event types round-trip, "
-          f"{len(bad)} invalid records rejected")
+          f"{len(NEGATIVE_CASES)} invalid records rejected")
+    return 0
+
+
+def schema_sync() -> int:
+    """--schema-sync (ISSUE 15 satellite): every event type in
+    EVENT_FIELDS must have at least one negative case above — adding
+    an event without teaching the validator's negative suite what a
+    BROKEN record of it looks like fails the `pbt check` tier-1 stage,
+    so schema growth and validator coverage move together."""
+    covered = {rec.get("event") for rec in NEGATIVE_CASES}
+    covered.discard(None)
+    missing = sorted(set(EVENT_FIELDS) - covered)
+    if missing:
+        print("SCHEMA-SYNC FAIL: event type(s) with no negative case "
+              f"in tools/validate_events.py: {missing} — add at least "
+              "one deliberately-broken record per type")
+        return 1
+    extra = sorted(c for c in covered
+                   if c not in EVENT_FIELDS and c != "no_such_event")
+    if extra:
+        print(f"SCHEMA-SYNC FAIL: negative cases reference unknown "
+              f"event type(s) {extra}")
+        return 1
+    print(f"schema-sync OK: all {len(EVENT_FIELDS)} event types have "
+          "validator negatives")
     return 0
 
 
@@ -263,12 +316,22 @@ def main(argv=None) -> int:
     ap.add_argument("--flight", help="flight-recorder dump to validate")
     ap.add_argument("--self-test", action="store_true",
                     help="validate the schema fixtures themselves")
+    ap.add_argument("--schema-sync", action="store_true",
+                    help="assert the negative-case list covers every "
+                         "event type in EVENT_FIELDS (the `pbt check` "
+                         "stage's coverage gate)")
     args = ap.parse_args(argv)
-    if args.self_test:
-        return self_test()
-    if not args.events and not args.flight:
-        ap.error("give an events JSONL, --flight, or --self-test")
+    if not any((args.events, args.flight, args.self_test,
+                args.schema_sync)):
+        ap.error("give an events JSONL, --flight, --self-test, or "
+                 "--schema-sync")
+    # All requested checks COMPOSE — combining --schema-sync with an
+    # events file must validate both, never silently skip one.
     rc = 0
+    if args.schema_sync:
+        rc |= schema_sync()
+    if args.self_test:
+        rc |= self_test()
     if args.events:
         rc |= validate_file(args.events)
     if args.flight:

@@ -60,13 +60,15 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from proteinbert_tpu.kernels import vmem_budget as _vb
 from proteinbert_tpu.kernels.fused_block import (
-    _LANE,
-    _VMEM_BUDGET,
-    MAX_TILED_DIM,
+    dequant_params,
     force_reference_requested,
+    is_quant_leaf,
+    weight_leaf,
 )
 from proteinbert_tpu.kernels.path_counter import KernelPathCounter
+from proteinbert_tpu.kernels.vmem_budget import lanes as _lanes
 
 Params = Dict[str, jax.Array]
 
@@ -103,13 +105,6 @@ def note_attention_path(path: str, reason: str,
     _COUNTER.note(path, reason, shape)
 
 
-def _lanes(n: int) -> int:
-    """Mosaic pads the lane (last) dim of a VMEM block up to the next
-    multiple of 128 — a ROUND-UP, not a floor (a 192-lane block
-    occupies 256 lanes)."""
-    return -(-n // _LANE) * _LANE
-
-
 def pallas_attention_supported(
     local_dim: int, global_dim: int, seq_len: int, max_segments: int,
     key_dim: int, num_heads: int, dtype: str = "bfloat16",
@@ -121,28 +116,22 @@ def pallas_attention_supported(
     Large C=1024 — prices in; the budget is dominated by the (L, C)
     activation row and the per-head fp32 temporaries. `max_segments` is
     1 for the dense entry."""
-    if (local_dim % _LANE or local_dim > MAX_TILED_DIM or seq_len < 8
-            or max_segments < 1):
+    if not _vb.shape_prechecks(local_dim, seq_len, max_segments):
         return False
     if global_dim < 1 or global_dim % num_heads:
         return False
-    itemsize = jnp.dtype(dtype).itemsize
+    item = _vb.itemsize(dtype)
     C, G, L, S, H, k = (local_dim, global_dim, seq_len, max_segments,
                         num_heads, key_dim)
-    v = G // H
     # Blocks whose index map varies with b are double-buffered by the
     # pipeline; weight blocks are whole (single buffer).
-    row = 2 * L * C * itemsize
-    oh = 2 * L * _lanes(S) * itemsize
-    gseg = 2 * S * _lanes(G) * itemsize
-    out = 2 * S * _lanes(G) * itemsize
-    weights = (H * G * _lanes(k) + H * C * _lanes(k)
-               + H * C * _lanes(v)) * itemsize
-    # Live fp32 temporaries of one head iteration: K, V, scores + exp
-    # copy, plus the accumulating (S, G) output.
-    temps = (L * _lanes(k) + L * _lanes(v) + 2 * L * _lanes(S)
-             + S * _lanes(G)) * 4
-    return row + oh + gseg + out + weights + temps <= _VMEM_BUDGET
+    row = 2 * L * C * item
+    oh = 2 * L * _lanes(S) * item
+    gseg = 2 * S * _lanes(G) * item
+    out = 2 * S * _lanes(G) * item
+    weights = _vb.attention_weight_bytes(C, G, k, H, item)
+    temps = _vb.attention_temp_bytes(L, S, G, k, H)
+    return _vb.fits(row, oh, gseg, out, weights, temps)
 
 
 def attention_oh_reference(
@@ -187,29 +176,32 @@ def attention_oh_reference(
     return out.reshape(b, s, h * vd)
 
 
-def _attention_kernel(
-    x_ref, oh_ref, g_ref, wq_ref, wk_ref, wv_ref,
-    out_ref,
+def _attention_body(
+    x, oh, g, wq, wk, wv,
     *, key_dim, num_heads, zero_empty,
 ):
-    dtype = x_ref.dtype
-    x = x_ref[0]    # (L, C)
-    oh = oh_ref[0]  # (L, S) — 1.0 in-segment real positions, else 0.0
-    g = g_ref[0]    # (S, G)
+    """The whole VMEM-resident attention chain on VALUES: `x` (L, C)
+    activations, `oh` (L, S) one-hot mask, `g` (S, G) global rows,
+    `wq`/`wk`/`wv` the (H, ·, ·) projections (refs or arrays — only
+    indexed). Factored out of `_attention_kernel` so the one-pass trunk
+    kernel (kernels/one_pass.py, ISSUE 16) can feed it the local-track
+    output it just computed WITHOUT an HBM round-trip. Returns the
+    (S, G) output in x's dtype."""
+    dtype = x.dtype
     inv_scale = 1.0 / jnp.sqrt(jnp.asarray(key_dim, jnp.float32))
 
     heads = []
     for h in range(num_heads):
         q_h = jnp.tanh(lax.dot_general(
-            g, wq_ref[h], (((1,), (0,)), ((), ())),
+            g, wq[h], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         ).astype(dtype))  # (S, k)
         k_h = jnp.tanh(lax.dot_general(
-            x, wk_ref[h], (((1,), (0,)), ((), ())),
+            x, wk[h], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         ).astype(dtype))  # (L, k)
         v_h = jax.nn.gelu(lax.dot_general(
-            x, wv_ref[h], (((1,), (0,)), ((), ())),
+            x, wv[h], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         ).astype(dtype))  # (L, v)
 
@@ -257,7 +249,30 @@ def _attention_kernel(
                              keepdims=True) > 0  # (1, S)
         out = jnp.where(seg_exists.reshape(-1, 1), out,
                         jnp.float32(0.0))
-    out_ref[0] = out.astype(dtype)
+    return out.astype(dtype)
+
+
+def _attention_kernel(
+    x_ref, oh_ref, g_ref, wq_ref, wk_ref, wv_ref,
+    *rest,
+    key_dim, num_heads, zero_empty, quantized=False,
+):
+    out_ref = rest[-1]
+    dtype = x_ref.dtype
+    if quantized:
+        # int8 projections + per-channel scales are VMEM-resident; the
+        # q·scale dequant (fp32 multiply, cast to the activation dtype)
+        # runs per grid step inside the kernel — bit-identical numerics
+        # to the HLO dequant, int8 bytes on the HBM wire (ISSUE 16).
+        wqs_ref, wks_ref, wvs_ref = rest[0], rest[1], rest[2]
+        wq = (wq_ref[:].astype(jnp.float32) * wqs_ref[:]).astype(dtype)
+        wk = (wk_ref[:].astype(jnp.float32) * wks_ref[:]).astype(dtype)
+        wv = (wv_ref[:].astype(jnp.float32) * wvs_ref[:]).astype(dtype)
+    else:
+        wq, wk, wv = wq_ref, wk_ref, wv_ref
+    out_ref[0] = _attention_body(
+        x_ref[0], oh_ref[0], g_ref[0], wq, wk, wv,
+        key_dim=key_dim, num_heads=num_heads, zero_empty=zero_empty)
 
 
 def _pallas_attention_forward(
@@ -267,9 +282,20 @@ def _pallas_attention_forward(
     B, L, C = local.shape
     S, G = global_seg.shape[1], global_seg.shape[2]
     dtype = local.dtype
-    wq = params["wq"].astype(dtype)  # (H, G, k)
-    wk = params["wk"].astype(dtype)  # (H, C, k)
-    wv = params["wv"].astype(dtype)  # (H, C, v)
+    quantized = is_quant_leaf(params["wq"])
+    if quantized:
+        wq, wk, wv = (params[n]["q"] for n in ("wq", "wk", "wv"))
+        # (H, k)/(H, v) scales reshaped to (H, 1, ·) so the in-kernel
+        # q·scale multiply broadcasts per output channel exactly like
+        # dequantize_params' scale[..., None, :].
+        scales = tuple(
+            params[n]["scale"][:, None, :].astype(jnp.float32)
+            for n in ("wq", "wk", "wv"))
+    else:
+        wq = params["wq"].astype(dtype)  # (H, G, k)
+        wk = params["wk"].astype(dtype)  # (H, C, k)
+        wv = params["wv"].astype(dtype)  # (H, C, v)
+        scales = ()
     H, _, key_dim = wq.shape
 
     def whole(a):
@@ -288,7 +314,7 @@ def _pallas_attention_forward(
     )
     kernel = functools.partial(
         _attention_kernel, key_dim=key_dim, num_heads=H,
-        zero_empty=zero_empty,
+        zero_empty=zero_empty, quantized=quantized,
     )
     return pl.pallas_call(
         kernel,
@@ -301,13 +327,15 @@ def _pallas_attention_forward(
             pl.BlockSpec((1, S, G), lambda b: (b, 0, 0),
                          memory_space=pltpu.VMEM),
             whole(wq), whole(wk), whole(wv),
+            *[whole(s) for s in scales],
         ],
         out_specs=pl.BlockSpec((1, S, G), lambda b: (b, 0, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((B, S, G), dtype),
         cost_estimate=cost,
         interpret=interpret,
-    )(local, seg_oh.astype(dtype), global_seg.astype(dtype), wq, wk, wv)
+    )(local, seg_oh.astype(dtype), global_seg.astype(dtype), wq, wk, wv,
+      *scales)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
@@ -383,7 +411,8 @@ def fused_packed_attention(
 
     B, L, C = local.shape
     S, G = global_.shape[1], global_.shape[2]
-    H, _, key_dim = params["wq"].shape
+    H, _, key_dim = weight_leaf(params["wq"]).shape
+    quantized = is_quant_leaf(params["wq"])
     shape_key = (B, L, C, S, G, str(jnp.dtype(local.dtype)))
     if force_reference_requested():
         reason = "forced"
@@ -397,9 +426,16 @@ def fused_packed_attention(
         oh = _segment_one_hot(segment_ids, S, local.dtype, real_mask)
         if interpret is None:
             interpret = jax.default_backend() != "tpu"
+        if quantized:
+            # Inference-only int8 path: in-kernel dequant, no VJP
+            # (quantized params carry no gradient contract).
+            return _pallas_attention_forward(params, local, global_, oh,
+                                             True, interpret)
         return _fused_attention(params, local, global_, oh, True,
                                 interpret)
     note_attention_path("reference", reason, shape_key)
+    if quantized:
+        params = dequant_params(params)
     return packed_global_attention_apply(params, local, global_,
                                          segment_ids, real_mask)
 
@@ -423,7 +459,8 @@ def fused_global_attention(
 
     B, L, C = local.shape
     G = global_.shape[-1]
-    H, _, key_dim = params["wq"].shape
+    H, _, key_dim = weight_leaf(params["wq"]).shape
+    quantized = is_quant_leaf(params["wq"])
     shape_key = (B, L, C, 1, G, str(jnp.dtype(local.dtype)))
     if force_reference_requested():
         reason = "forced"
@@ -440,8 +477,15 @@ def fused_global_attention(
             oh = pad_mask[..., None].astype(local.dtype)
         if interpret is None:
             interpret = jax.default_backend() != "tpu"
-        out = _fused_attention(params, local, global_[:, None, :], oh,
-                               False, interpret)
+        if quantized:
+            out = _pallas_attention_forward(params, local,
+                                            global_[:, None, :], oh,
+                                            False, interpret)
+        else:
+            out = _fused_attention(params, local, global_[:, None, :],
+                                   oh, False, interpret)
         return out.reshape(B, G)
     note_attention_path("reference", reason, shape_key)
+    if quantized:
+        params = dequant_params(params)
     return global_attention_apply(params, local, global_, pad_mask)

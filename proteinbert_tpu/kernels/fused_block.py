@@ -88,6 +88,13 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from proteinbert_tpu.kernels.path_counter import KernelPathCounter
+from proteinbert_tpu.kernels import vmem_budget as _vb
+from proteinbert_tpu.kernels.vmem_budget import (  # noqa: F401
+    LANE as _LANE,
+    MAX_PALLAS_DIM,
+    MAX_TILED_DIM,
+    VMEM_BUDGET as _VMEM_BUDGET,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -158,16 +165,49 @@ def note_kernel_path(path: str, reason: str,
     (reason, call-site shape)."""
     _COUNTER.note(path, reason, shape)
 
-# Largest feature dim whose weights fit the VMEM budget whole (see
-# module doc); larger dims use the channel-tiled kernel.
-MAX_PALLAS_DIM = 512
-MAX_TILED_DIM = 2048  # upper bound for the channel-tiled variant
-_LANE = 128  # TPU lane width; C must be a multiple for clean tiling
-_VMEM_BUDGET = 13 * 1024 * 1024  # per-core VMEM we allow the kernel to plan for
+# The VMEM constants (MAX_PALLAS_DIM, MAX_TILED_DIM, _LANE,
+# _VMEM_BUDGET) are owned by kernels/vmem_budget.py since ISSUE 16 and
+# re-exported above under their historical names.
 
 
 def _gelu(x):
     return jax.nn.gelu(x)
+
+
+# ------------------------------------------- int8 weight leaves (ISSUE 16)
+# parallel/quant.quantize_params turns every >= 2-D float leaf into
+# {"q": int8, "scale": fp32} (symmetric per-output-channel, scale
+# reduced over axis -2). The kernel dispatches accept those leaves
+# directly so the quantized serving arm loads int8 weights into VMEM
+# and dequantizes per-tile INSIDE the kernel. The predicates are
+# duplicated from parallel/quant (they must match bit-for-bit) because
+# kernels/ cannot import parallel/ without a cycle.
+
+
+def is_quant_leaf(x) -> bool:
+    """Whether `x` is a quantize_params leaf ({"q": int8, "scale":
+    fp32}) rather than a plain weight array."""
+    return isinstance(x, dict) and set(x) == {"q", "scale"}
+
+
+def weight_leaf(x):
+    """The array carrying a (possibly quantized) weight's SHAPE."""
+    return x["q"] if is_quant_leaf(x) else x
+
+
+def dequant_leaf(x):
+    """HLO dequant of one quant leaf — the exact
+    parallel/quant.dequantize_params formula, used on kernel paths
+    that do not dequantize in-kernel (XLA reference fallbacks and the
+    channel-tiled variants)."""
+    if is_quant_leaf(x):
+        return x["q"].astype(jnp.float32) * x["scale"][..., None, :]
+    return x
+
+
+def dequant_params(params):
+    """Dequantize every quant leaf of a param subtree in HLO."""
+    return jax.tree.map(dequant_leaf, params, is_leaf=is_quant_leaf)
 
 
 def local_track_reference(
@@ -354,8 +394,9 @@ def fused_local_track_segments(
     conv_out remat tag intact inside the recompute."""
     B, L, C = x.shape
     S = broadcast_seg.shape[1]
-    nk = params["narrow_conv"]["kernel"]
-    wk = params["wide_conv"]["kernel"]
+    quantized = is_quant_leaf(params["narrow_conv"]["kernel"])
+    nk = weight_leaf(params["narrow_conv"]["kernel"])
+    wk = weight_leaf(params["wide_conv"]["kernel"])
     shape_key = (B, L, C, S, str(jnp.dtype(x.dtype)))
     if force_reference_requested():
         reason = "forced"
@@ -370,9 +411,21 @@ def fused_local_track_segments(
         seg_oh = (segment_ids[..., None]
                   == jnp.arange(1, S + 1, dtype=segment_ids.dtype)
                   ).astype(x.dtype)
+        if quantized:
+            if C <= MAX_PALLAS_DIM:
+                # int8 weights dequantize per-tile IN the kernel
+                # (inference-only: the quantized arm never
+                # differentiates, so the custom-VJP wrapper is skipped).
+                return _pallas_segments_forward(
+                    params, x, broadcast_seg, seg_oh,
+                    narrow_dilation, wide_dilation, interpret)
+            # Channel-tiled range: HLO dequant, still the Pallas path.
+            params = dequant_params(params)
         return _fused_segments(params, x, broadcast_seg, seg_oh,
                                narrow_dilation, wide_dilation, interpret)
     note_kernel_path("reference", reason, shape_key)
+    if quantized:
+        params = dequant_params(params)
     broadcast_pos = gather_segment_broadcast(broadcast_seg, segment_ids)
     return local_track_segment_reference(
         params, x, broadcast_pos, segment_ids, narrow_dilation,
@@ -857,19 +910,19 @@ def pallas_supported(
     `seq_len` is the PER-SHARD length the kernel actually sees — under
     sequence parallelism a long global L divides down to supportable
     shards."""
-    if local_dim % _LANE or local_dim > MAX_TILED_DIM or seq_len < 8:
+    if not _vb.shape_prechecks(local_dim, seq_len):
         return False
-    itemsize = jnp.dtype(dtype).itemsize
+    item = _vb.itemsize(dtype)
     C = local_dim
     halo = max((narrow_taps - 1) // 2, (wide_taps - 1) // 2 * wide_dilation)
     tile = _pick_tile(seq_len)
     if C > MAX_PALLAS_DIM:
         return _plan_tiled(C, seq_len, dtype, narrow_taps, wide_taps,
                            wide_dilation)[0] > 0
-    weights = (narrow_taps + wide_taps + 1) * C * C * itemsize
-    row = (seq_len + 2 * halo) * C * itemsize
-    temps = 3 * tile * C * 4
-    return weights + row + temps <= _VMEM_BUDGET
+    weights = _vb.track_weight_bytes(C, narrow_taps, wide_taps, item)
+    row = (seq_len + 2 * halo) * C * item
+    temps = _vb.track_temp_bytes(tile, C)
+    return _vb.fits(weights, row, temps)
 
 
 # ------------------------------------------------ segment-aware kernel
@@ -925,19 +978,32 @@ def _fused_segment_kernel(
     x_ref, oh_ref, bcast_ref,
     nk_ref, nb_ref, wk_ref, wb_ref,
     s1_ref, b1_ref, dk_ref, db_ref, s2_ref, b2_ref,
-    out_ref,
-    *, tile, halo, narrow_taps, wide_taps, narrow_dilation, wide_dilation,
+    *rest,
+    tile, halo, narrow_taps, wide_taps, narrow_dilation, wide_dilation,
+    quantized=False,
 ):
+    out_ref = rest[-1]
     j = pl.program_id(1)
     dtype = x_ref.dtype
+    if quantized:
+        # int8 weights + per-channel scales are VMEM-resident; the
+        # per-tile dequant (q·scale in fp32, cast to the activation
+        # dtype) reproduces the HLO dequant's numerics bit-for-bit
+        # (ISSUE 16 second leg), but HBM ships int8 bytes.
+        nks_ref, wks_ref, dks_ref = rest[0], rest[1], rest[2]
+        nk = (nk_ref[:].astype(jnp.float32) * nks_ref[:]).astype(dtype)
+        wk = (wk_ref[:].astype(jnp.float32) * wks_ref[:]).astype(dtype)
+        dk = (dk_ref[:].astype(jnp.float32) * dks_ref[:]).astype(dtype)
+    else:
+        nk, wk, dk = nk_ref, wk_ref, dk_ref
     window = x_ref[0, pl.ds(j * tile, tile + 2 * halo), :]
     oh_window = oh_ref[0, pl.ds(j * tile, tile + 2 * halo), :]
     x_center = window[halo:halo + tile].astype(jnp.float32)
 
-    narrow = _seg_tap_matmuls(window, oh_window, nk_ref[:], narrow_taps,
+    narrow = _seg_tap_matmuls(window, oh_window, nk[:], narrow_taps,
                               narrow_dilation, halo, tile)
     narrow = _gelu(narrow + nb_ref[0].astype(jnp.float32))
-    wide = _seg_tap_matmuls(window, oh_window, wk_ref[:], wide_taps,
+    wide = _seg_tap_matmuls(window, oh_window, wk[:], wide_taps,
                             wide_dilation, halo, tile)
     wide = _gelu(wide + wb_ref[0].astype(jnp.float32))
 
@@ -949,7 +1015,7 @@ def _fused_segment_kernel(
         preferred_element_type=jnp.float32,
     )
     h = x_center + narrow + wide + bcast_pos
-    out_ref[0] = _finish_row(h, s1_ref, b1_ref, dk_ref, db_ref,
+    out_ref[0] = _finish_row(h, s1_ref, b1_ref, dk, db_ref,
                              s2_ref, b2_ref, dtype)
 
 
@@ -968,8 +1034,7 @@ def pallas_segments_supported(
     broadcast block. Beyond MAX_PALLAS_DIM the channel-tiled SEGMENT
     plan (`_plan_tiled(max_segments=)`, ISSUE 13) must find a tile
     width — ProteinBERT-Large C=1024 packed rows run the fast path."""
-    if (local_dim % _LANE or local_dim > MAX_TILED_DIM or seq_len < 8
-            or max_segments < 1):
+    if not _vb.shape_prechecks(local_dim, seq_len, max_segments):
         return False
     if narrow_taps % 2 == 0 or wide_taps % 2 == 0:
         return False
@@ -977,20 +1042,20 @@ def pallas_segments_supported(
         return _plan_tiled(local_dim, seq_len, dtype, narrow_taps,
                            wide_taps, wide_dilation,
                            max_segments=max_segments)[0] > 0
-    itemsize = jnp.dtype(dtype).itemsize
+    item = _vb.itemsize(dtype)
     C = local_dim
     halo = max((narrow_taps - 1) // 2 * narrow_dilation,
                (wide_taps - 1) // 2 * wide_dilation)
     tile = _pick_tile(seq_len)
     Lp = seq_len + 2 * halo
-    # Mosaic pads the lane dim UP to the next multiple of 128.
-    lanes = -(-max_segments // _LANE) * _LANE
-    weights = (narrow_taps + wide_taps + 1) * C * C * itemsize
-    row = Lp * C * itemsize
-    oh_row = Lp * lanes * itemsize
-    bcast = max_segments * C * itemsize
-    temps = 3 * tile * C * 4 + tile * lanes * 4
-    return weights + row + oh_row + bcast + temps <= _VMEM_BUDGET
+    weights = _vb.track_weight_bytes(C, narrow_taps, wide_taps, item)
+    row = Lp * C * item
+    # Mosaic pads the one-hot's lane dim UP to the next multiple of 128.
+    oh_row = Lp * _vb.lanes(max_segments) * item
+    bcast = max_segments * C * item
+    temps = (_vb.track_temp_bytes(tile, C)
+             + tile * _vb.lanes(max_segments) * 4)
+    return _vb.fits(weights, row, oh_row, bcast, temps)
 
 
 def _pallas_segments_forward(
@@ -1000,7 +1065,9 @@ def _pallas_segments_forward(
 ) -> jax.Array:
     nk = params["narrow_conv"]["kernel"]
     wk = params["wide_conv"]["kernel"]
-    narrow_taps, wide_taps = nk.shape[0], wk.shape[0]
+    quantized = is_quant_leaf(nk)
+    narrow_taps = weight_leaf(nk).shape[0]
+    wide_taps = weight_leaf(wk).shape[0]
     halo = max((narrow_taps - 1) // 2 * narrow_dilation,
                (wide_taps - 1) // 2 * wide_dilation)
     B, L, C = x.shape
@@ -1016,12 +1083,24 @@ def _pallas_segments_forward(
         return p.reshape(1, C)
 
     ln1, ln2, dn = params["local_ln1"], params["local_ln2"], params["local_dense"]
+    if quantized:
+        # int8 weight operands ride as-is; scales are reshaped so the
+        # in-kernel q·scale multiply broadcasts per output channel
+        # exactly like dequantize_params' scale[..., None, :].
+        nk_w, wk_w, dk_w = nk["q"], wk["q"], dn["kernel"]["q"]
+        scales = (nk["scale"][:, None, :].astype(jnp.float32),
+                  wk["scale"][:, None, :].astype(jnp.float32),
+                  dn["kernel"]["scale"].reshape(1, C).astype(jnp.float32))
+    else:
+        nk_w, wk_w = nk.astype(dtype), wk.astype(dtype)
+        dk_w = dn["kernel"].astype(dtype)
+        scales = ()
     inputs = (
         x_padded, oh_padded, broadcast_seg.astype(dtype),
-        nk.astype(dtype), vec(params["narrow_conv"]["bias"]),
-        wk.astype(dtype), vec(params["wide_conv"]["bias"]),
+        nk_w, vec(params["narrow_conv"]["bias"]),
+        wk_w, vec(params["wide_conv"]["bias"]),
         vec(ln1["scale"]), vec(ln1["bias"]),
-        dn["kernel"].astype(dtype), vec(dn["bias"]),
+        dk_w, vec(dn["bias"]),
         vec(ln2["scale"]), vec(ln2["bias"]),
     )
     # Masks add one (TL, S) VPU reduction per tap; the broadcast gather
@@ -1050,18 +1129,27 @@ def _pallas_segments_forward(
             _fused_segment_kernel, tile=tile, halo=halo,
             narrow_taps=narrow_taps, wide_taps=wide_taps,
             narrow_dilation=narrow_dilation, wide_dilation=wide_dilation,
+            quantized=quantized,
         )
         return pl.pallas_call(
             kernel,
             grid=grid,
             in_specs=[row_spec, oh_spec, bcast_spec]
-                     + [whole(a) for a in inputs[3:]],
+                     + [whole(a) for a in inputs[3:]]
+                     + [whole(a) for a in scales],
             out_specs=pl.BlockSpec((1, tile, C), lambda b, j: (b, j, 0),
                                    memory_space=pltpu.VMEM),
             out_shape=jax.ShapeDtypeStruct((B, L, C), dtype),
             cost_estimate=cost,
             interpret=interpret,
-        )(*inputs)
+        )(*inputs, *scales)
+
+    if quantized:
+        # The channel-tiled variant keeps its HLO dequant (the
+        # dispatch dequantizes before reaching it, docs/serving.md).
+        raise ValueError(
+            f"in-kernel int8 dequant has no channel-tiled plan "
+            f"(C={C} > {MAX_PALLAS_DIM}); dequantize first")
 
     # Channel-tiled SEGMENT variant for C > MAX_PALLAS_DIM (ISSUE 13
     # second leg — ProteinBERT-Large packed rows). Same grid orders as

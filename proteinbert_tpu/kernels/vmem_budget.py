@@ -1,0 +1,91 @@
+"""Shared VMEM pricing for the Pallas kernel guards (ISSUE 16).
+
+`pallas_supported`, `pallas_segments_supported`,
+`pallas_attention_supported` and the one-pass trunk guard
+(`pallas_onepass_supported`, kernels/one_pass.py) all answer the same
+question — does this shape's working set fit the per-core VMEM the
+kernel is allowed to plan for — and before this module each carried its
+own copy of the arithmetic (lane round-up, itemsize lookup, the weight
+and temporary byte formulas). The primitives live here once; each
+guard keeps its OWN composition of them, because the kernels genuinely
+differ in what they hold resident (the budget test pins every guard's
+decisions on the existing shape grid, tests/test_vmem_budget.py).
+
+Conventions the formulas encode (docs/performance.md):
+- Mosaic pads the lane (last) dim of a VMEM block UP to the next
+  multiple of 128 (`lanes`) — a (L, 4) one-hot block occupies
+  (L, 128) lanes;
+- blocks whose index map varies with the batch grid axis are
+  double-buffered by the pipeline (x2); whole-array weight blocks are
+  single-buffered;
+- fp32 temporaries price at 4 bytes regardless of activation dtype.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+# Largest feature dim whose weights fit the VMEM budget whole; larger
+# dims need a channel-tiled plan (fused_block._plan_tiled).
+MAX_PALLAS_DIM = 512
+MAX_TILED_DIM = 2048  # upper bound for the channel-tiled variants
+LANE = 128  # TPU lane width; C must be a multiple for clean tiling
+VMEM_BUDGET = 13 * 1024 * 1024  # per-core VMEM the kernels plan within
+
+
+def lanes(n: int) -> int:
+    """Mosaic pads the lane (last) dim of a VMEM block up to the next
+    multiple of 128 — a ROUND-UP, not a floor (a 192-lane block
+    occupies 256 lanes)."""
+    return -(-n // LANE) * LANE
+
+
+def itemsize(dtype) -> int:
+    return jnp.dtype(dtype).itemsize
+
+
+def fits(*byte_costs: int) -> bool:
+    """Whether the summed costs fit the shared per-core budget."""
+    return sum(byte_costs) <= VMEM_BUDGET
+
+
+def track_weight_bytes(local_dim: int, narrow_taps: int, wide_taps: int,
+                       item: int) -> int:
+    """Whole-resident local-track weight set: both conv stacks plus the
+    (C, C) dense kernel (biases and LN vectors are noise)."""
+    return (narrow_taps + wide_taps + 1) * local_dim * local_dim * item
+
+
+def attention_weight_bytes(local_dim: int, global_dim: int, key_dim: int,
+                           num_heads: int, item: int) -> int:
+    """Whole-resident attention projections wq (H, G, k), wk (H, C, k),
+    wv (H, C, v) with the lane round-up on each last dim."""
+    v_dim = global_dim // num_heads
+    return (num_heads * global_dim * lanes(key_dim)
+            + num_heads * local_dim * lanes(key_dim)
+            + num_heads * local_dim * lanes(v_dim)) * item
+
+
+def attention_temp_bytes(seq_len: int, max_segments: int, global_dim: int,
+                         key_dim: int, num_heads: int) -> int:
+    """Live fp32 temporaries of one attention head iteration: K, V,
+    scores + exp copy, plus the accumulating (S, G) output."""
+    v_dim = global_dim // num_heads
+    return (seq_len * lanes(key_dim) + seq_len * lanes(v_dim)
+            + 2 * seq_len * lanes(max_segments)
+            + max_segments * lanes(global_dim)) * 4
+
+
+def track_temp_bytes(tile: int, local_dim: int) -> int:
+    """Live fp32 temporaries of one local-track tile: narrow, wide and
+    the accumulated residual row."""
+    return 3 * tile * local_dim * 4
+
+
+def shape_prechecks(local_dim: int, seq_len: int,
+                    max_segments: int = 1) -> bool:
+    """The structural preconditions shared by every kernel family:
+    lane-aligned C within the tiled ceiling, enough rows for a Mosaic
+    sublane tile, a positive segment count."""
+    return not (local_dim % LANE or local_dim > MAX_TILED_DIM
+                or seq_len < 8 or max_segments < 1)

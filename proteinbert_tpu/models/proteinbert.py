@@ -127,93 +127,72 @@ def block_apply(
     # Local track (reference modules.py:201-217).
     broadcast = jax.nn.gelu(dense_apply(params["global_to_local"], global_))
     from proteinbert_tpu.kernels import (
-        fused_local_track, fused_local_track_segments,
         gather_segment_broadcast, local_track_reference,
-        local_track_segment_reference, note_kernel_path, pallas_supported,
+        local_track_segment_reference,
     )
 
     track_params = {k: params[k] for k in ("narrow_conv", "wide_conv",
                                            "local_ln1", "local_dense",
                                            "local_ln2")}
-    if packed:
-        if cfg.use_pallas:
-            # Fused segment dispatch (kernels/fused_block.py, ISSUE 10):
-            # the Pallas fast path with boundary masks AND the own-
-            # segment broadcast gather folded into the kernel on
-            # supported shapes, the XLA reference otherwise — every
-            # dispatch counted in fused_kernel_path_total{path=,reason=}.
-            # The per-segment (B, S, C) broadcast goes in as-is; the
-            # (B, L, C) gather is only materialised on the fallback.
-            local = fused_local_track_segments(
-                track_params, local, broadcast, segment_ids,
-                1, cfg.wide_dilation, jax.default_backend() != "tpu",
+    # Under use_pallas BOTH tracks route through the one-pass trunk
+    # dispatch (kernels/one_pass.py, ISSUE 16): on supported shapes the
+    # local conv track and the global attention run as ONE VMEM-resident
+    # grid program (the inter-track activations never round-trip through
+    # HBM, and the segment one-hot is built once for both masks);
+    # otherwise the dispatch falls back to the existing two-kernel
+    # composition, each leg with its own guard + counter family. Every
+    # decision is counted in onepass_kernel_path_total{path=,reason=}.
+    # `attn` comes back alongside `local`; it attends over the NEW local
+    # track with the OLD global track, exactly like the split path.
+    if cfg.use_pallas:
+        from proteinbert_tpu.kernels import (
+            fused_onepass_dense, fused_onepass_segments,
+        )
+
+        interp = jax.default_backend() != "tpu"
+        if packed:
+            # pad_mask is the REAL-token mask: for training packs it
+            # equals segment_ids > 0 (segments hold no pad); the ragged
+            # serving path packs bucket-quantized spans with <pad>
+            # tails, which are excluded from the attention softmax but
+            # DO participate in the convs (two-kernel semantics).
+            local, attn = fused_onepass_segments(
+                track_params, params["attention"], local, broadcast,
+                global_, segment_ids, real_mask=pad_mask,
+                narrow_dilation=1, wide_dilation=cfg.wide_dilation,
+                interpret=interp,
             )
         else:
-            # Gather each position's own segment's broadcast vector:
-            # (B, S, C) → (B, L, C), zero at pad so nothing row-wide
-            # leaks into the masked conv taps.
-            local = local_track_segment_reference(
-                track_params, local,
-                gather_segment_broadcast(broadcast, segment_ids),
-                segment_ids, 1, cfg.wide_dilation,
+            local, attn = fused_onepass_dense(
+                track_params, params["attention"], local, broadcast,
+                global_, pad_mask=pad_mask,
+                narrow_dilation=1, wide_dilation=cfg.wide_dilation,
+                interpret=interp,
             )
-    elif cfg.use_pallas:
-        shape_key = (local.shape[0], local.shape[1], cfg.local_dim,
-                     str(jnp.dtype(cfg.dtype)))
-        if pallas_supported(
-            cfg.local_dim, local.shape[1], cfg.dtype,
-            cfg.narrow_kernel, cfg.wide_kernel, cfg.wide_dilation,
-        ):
-            # Fused Pallas kernel (kernels/fused_block.py); interpreted
-            # off-TPU so tests and CPU runs exercise the same code path.
-            note_kernel_path("pallas", "dense", shape_key)
-            local = fused_local_track(
-                track_params, local, broadcast, 1, cfg.wide_dilation,
-                jax.default_backend() != "tpu",
-            )
-        else:
-            note_kernel_path("reference", "unsupported_shape", shape_key)
-            local = local_track_reference(
-                track_params, local, broadcast, 1, cfg.wide_dilation
-            )
+    elif packed:
+        # Gather each position's own segment's broadcast vector:
+        # (B, S, C) → (B, L, C), zero at pad so nothing row-wide
+        # leaks into the masked conv taps.
+        local = local_track_segment_reference(
+            track_params, local,
+            gather_segment_broadcast(broadcast, segment_ids),
+            segment_ids, 1, cfg.wide_dilation,
+        )
+        attn = packed_global_attention_apply(
+            params["attention"], local, global_, segment_ids,
+            real_mask=pad_mask)
     else:
         local = local_track_reference(
             track_params, local, broadcast, 1, cfg.wide_dilation
         )
+        attn = global_attention_apply(
+            params["attention"], local, global_, pad_mask)
 
     # Global track (reference modules.py:219-229) — per segment when
     # packed: every dense/LN is feature-last and shape-agnostic over the
-    # leading (B, S) axes, only attention needs the segment mask. Under
-    # use_pallas attention routes through the ragged Pallas kernel
-    # (kernels/attention.py, ISSUE 13) on supported shapes — packed AND
-    # dense, so bucketed serving and unpacked training share it — with
-    # the masked-XLA reference as fallback; every dispatch is counted
-    # in attention_kernel_path_total{path=,reason=}.
+    # leading (B, S) axes; `attn` was computed above against the OLD
+    # global track.
     dense1 = jax.nn.gelu(dense_apply(params["global_dense1"], global_))
-    if packed:
-        # pad_mask is the REAL-token mask: for training packs it equals
-        # segment_ids > 0 (segments hold no pad), so this is a no-op
-        # there; the ragged serving path packs bucket-quantized spans
-        # with <pad> tails and passes tokens != PAD_ID, which must be
-        # excluded from the softmax like the bucketed path excludes it.
-        if cfg.use_pallas:
-            from proteinbert_tpu.kernels import fused_packed_attention
-
-            attn = fused_packed_attention(
-                params["attention"], local, global_, segment_ids,
-                real_mask=pad_mask)
-        else:
-            attn = packed_global_attention_apply(
-                params["attention"], local, global_, segment_ids,
-                real_mask=pad_mask)
-    elif cfg.use_pallas:
-        from proteinbert_tpu.kernels import fused_global_attention
-
-        attn = fused_global_attention(
-            params["attention"], local, global_, pad_mask)
-    else:
-        attn = global_attention_apply(
-            params["attention"], local, global_, pad_mask)
     global_ = layer_norm_apply(params["global_ln1"], global_ + dense1 + attn)
     global_ = layer_norm_apply(
         params["global_ln2"],
@@ -252,9 +231,13 @@ def _cast_blocks(blocks: Params, dtype) -> Params:
     the scan xs ARE the bf16 tensors — nothing new is saved per step, the
     warning disappears, and the f32→bf16 convert runs once per step
     instead of once per block. LN leaves stay f32: layer_norm_apply
-    consumes them in f32 statistics space."""
+    consumes them in f32 statistics space. int8 quant leaves
+    ({"q", "scale"} from parallel/quant.partial_dequantize_params, the
+    in-kernel-dequant serving arm) pass through untouched — the kernels
+    consume the int8 weights + fp32 scales directly."""
     def cast(path, leaf):
-        if any(getattr(p, "key", None) in _LN_NAMES for p in path):
+        if any(getattr(p, "key", None) in _LN_NAMES + ("q", "scale")
+               for p in path):
             return leaf
         return leaf.astype(dtype)
 

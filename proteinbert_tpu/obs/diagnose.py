@@ -290,6 +290,7 @@ def summarize_serve(records: List[Dict[str, Any]],
             "warmup_seconds": end_stats.get("warmup_seconds"),
             "fused_path": end_stats.get("fused_path"),
             "attention_path": end_stats.get("attention_path"),
+            "onepass_path": end_stats.get("onepass_path"),
             "fused_fallback": end_stats.get("fused_fallback"),
         }
 
@@ -491,7 +492,8 @@ def render_serve(summary: Dict[str, Any]) -> str:
             f"(mode {ex.get('serve_mode')}, warmup "
             f"{ex.get('warmup_seconds')}s)")
         for stats_key, label in (("fused_path", "fused-kernel"),
-                                 ("attention_path", "attention-kernel")):
+                                 ("attention_path", "attention-kernel"),
+                                 ("onepass_path", "one-pass-trunk")):
             cov = ex.get(stats_key) or {}
             if not cov:
                 continue

@@ -510,6 +510,29 @@ def validate_record(rec: Any) -> None:
                 raise ValueError(
                     f"note(kind=pack_attn_capture).{name} must be a "
                     f"non-negative finite number, got {v!r}")
+    if event == "note" and rec.get("kind") == "onepass_capture":
+        # The one-pass trunk A/B capture (bench.py --pack, ISSUE 16):
+        # single fused block-pass kernel vs the two-kernel composition.
+        # Its speedup/MFU fields feed trajectory-sentinel series, so a
+        # writer bug must fail validation, not poison the series.
+        v = rec.get("onepass_speedup_x")
+        if v is None:
+            raise ValueError(
+                "note(kind=onepass_capture): missing required field "
+                "'onepass_speedup_x'")
+        if (isinstance(v, bool) or not isinstance(v, (int, float))
+                or not math.isfinite(v) or v <= 0):
+            raise ValueError(
+                f"note(kind=onepass_capture).onepass_speedup_x must be "
+                f"a positive finite number, got {v!r}")
+        for name in ("mfu_effective", "mfu_raw", "parity_max_abs_diff"):
+            v = rec.get(name)
+            if v is not None and (isinstance(v, bool)
+                                  or not isinstance(v, (int, float))
+                                  or not math.isfinite(v) or v < 0):
+                raise ValueError(
+                    f"note(kind=onepass_capture).{name} must be a "
+                    f"non-negative finite number, got {v!r}")
 
 
 def make_example(event: str) -> Dict[str, Any]:

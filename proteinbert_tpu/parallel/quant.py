@@ -512,6 +512,46 @@ def dequantize_params(qparams: Any) -> Any:
     return jax.tree.map(deq, qparams, is_leaf=_is_quant_leaf)
 
 
+# The block weights the Pallas kernels dequantize IN-KERNEL (ISSUE 16):
+# per-tile q·scale inside the one-pass / fused-segment / attention
+# programs, so HBM ships int8 bytes on the serving fast path. Everything
+# else (embeddings, heads, the block's global-side denses — consumed by
+# plain XLA ops) keeps the HLO dequant.
+_INKERNEL_QUANT_KEYS = (
+    ("narrow_conv", "kernel"),
+    ("wide_conv", "kernel"),
+    ("local_dense", "kernel"),
+    ("attention", "wq"),
+    ("attention", "wk"),
+    ("attention", "wv"),
+)
+
+
+def partial_dequantize_params(qparams: Any, use_pallas: bool = True) -> Any:
+    """Quantized tree → the form the in-kernel-dequant serving arm
+    consumes: every quant leaf is HLO-dequantized EXCEPT the block
+    kernel weights the Pallas dispatches accept natively
+    (`_INKERNEL_QUANT_KEYS` under "blocks"), which stay {"q": int8,
+    "scale": fp32} so the kernels load int8 into VMEM and dequantize
+    per-tile. With `use_pallas=False` no kernel ever sees the tree, so
+    this degenerates to the full `dequantize_params` (the XLA reference
+    path computes from HLO-dequantized weights either way — the kernel
+    dispatch fallbacks do the same dequant themselves)."""
+    if not use_pallas:
+        return dequantize_params(qparams)
+
+    def deq(path, x):
+        if not _is_quant_leaf(x):
+            return x
+        keys = tuple(getattr(p, "key", None) for p in path)
+        if "blocks" in keys and keys[-2:] in _INKERNEL_QUANT_KEYS:
+            return x
+        return x["q"].astype(jnp.float32) * x["scale"][..., None, :]
+
+    return jax.tree_util.tree_map_with_path(deq, qparams,
+                                            is_leaf=_is_quant_leaf)
+
+
 def param_bytes(params: Any) -> int:
     """Total bytes of every array leaf — the HBM-footprint evidence for
     the quantized trunk (quant leaves count q + scale)."""
@@ -547,7 +587,8 @@ def fake_quant_act(x: jax.Array) -> jax.Array:
 def _q_encode_batch(qparams, tokens, annotations, cfg: ModelConfig):
     from proteinbert_tpu import inference
 
-    return inference._encode_batch(dequantize_params(qparams), tokens,
+    return inference._encode_batch(
+        partial_dequantize_params(qparams, cfg.use_pallas), tokens,
                                    annotations, cfg)
 
 
@@ -555,7 +596,8 @@ def _q_encode_batch(qparams, tokens, annotations, cfg: ModelConfig):
 def _q_go_probs_batch(qparams, tokens, annotations, cfg: ModelConfig):
     from proteinbert_tpu import inference
 
-    return inference._go_probs_batch(dequantize_params(qparams), tokens,
+    return inference._go_probs_batch(
+        partial_dequantize_params(qparams, cfg.use_pallas), tokens,
                                      annotations, cfg)
 
 
@@ -564,7 +606,8 @@ def _q_residue_probs_batch(qparams, tokens, annotations,
                            cfg: ModelConfig):
     from proteinbert_tpu import inference
 
-    return inference._residue_probs_batch(dequantize_params(qparams),
+    return inference._residue_probs_batch(
+        partial_dequantize_params(qparams, cfg.use_pallas),
                                           tokens, annotations, cfg)
 
 
@@ -623,7 +666,7 @@ def _q_packed_encode_batch(qparams, tokens, segment_ids, annotations,
     from proteinbert_tpu import inference
 
     return inference._packed_encode_batch(
-        dequantize_params(qparams), tokens, segment_ids, annotations,
+        partial_dequantize_params(qparams, cfg.use_pallas), tokens, segment_ids, annotations,
         cfg)
 
 
@@ -633,7 +676,7 @@ def _q_packed_go_probs_batch(qparams, tokens, segment_ids, annotations,
     from proteinbert_tpu import inference
 
     return inference._packed_go_probs_batch(
-        dequantize_params(qparams), tokens, segment_ids, annotations,
+        partial_dequantize_params(qparams, cfg.use_pallas), tokens, segment_ids, annotations,
         cfg)
 
 
@@ -643,7 +686,7 @@ def _q_packed_residue_probs_batch(qparams, tokens, segment_ids,
     from proteinbert_tpu import inference
 
     return inference._packed_residue_probs_batch(
-        dequantize_params(qparams), tokens, segment_ids, annotations,
+        partial_dequantize_params(qparams, cfg.use_pallas), tokens, segment_ids, annotations,
         cfg)
 
 
@@ -651,7 +694,8 @@ def _q_packed_residue_probs_batch(qparams, tokens, segment_ids,
 def _q_trunk_batch(qparams, tokens, annotations, cfg: ModelConfig):
     from proteinbert_tpu.heads import apply as heads_apply
 
-    return heads_apply.trunk_batch(dequantize_params(qparams), tokens,
+    return heads_apply.trunk_batch(
+        partial_dequantize_params(qparams, cfg.use_pallas), tokens,
                                    annotations, cfg)
 
 
@@ -661,7 +705,7 @@ def _q_packed_trunk_batch(qparams, tokens, segment_ids, annotations,
     from proteinbert_tpu.heads import apply as heads_apply
 
     return heads_apply.packed_trunk_batch(
-        dequantize_params(qparams), tokens, segment_ids, annotations,
+        partial_dequantize_params(qparams, cfg.use_pallas), tokens, segment_ids, annotations,
         cfg)
 
 

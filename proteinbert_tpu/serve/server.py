@@ -286,6 +286,9 @@ class Server:
         from proteinbert_tpu.kernels.fused_block import (
             register_path_observer,
         )
+        from proteinbert_tpu.kernels.one_pass import (
+            register_onepass_path_observer,
+        )
 
         self._path_c: Dict[Any, Any] = {}
 
@@ -307,10 +310,15 @@ class Server:
         def _mirror_attn_path(path: str, reason: str) -> None:
             _mirror("attention_kernel_path_total", path, reason)
 
+        def _mirror_onepass_path(path: str, reason: str) -> None:
+            _mirror("onepass_kernel_path_total", path, reason)
+
         self._path_cb = _mirror_path
         self._attn_path_cb = _mirror_attn_path
+        self._onepass_path_cb = _mirror_onepass_path
         register_path_observer(self._path_cb)
         register_attention_path_observer(self._attn_path_cb)
+        register_onepass_path_observer(self._onepass_path_cb)
 
     def _bump(self, mirror: str, reason: Optional[str] = None) -> None:
         with self._mirror_lock:
@@ -426,9 +434,13 @@ class Server:
         from proteinbert_tpu.kernels.fused_block import (
             unregister_path_observer,
         )
+        from proteinbert_tpu.kernels.one_pass import (
+            unregister_onepass_path_observer,
+        )
 
         unregister_path_observer(self._path_cb)
         unregister_attention_path_observer(self._attn_path_cb)
+        unregister_onepass_path_observer(self._onepass_path_cb)
 
     def abort(self) -> None:
         """Hard shutdown: fail all queued + pending work with
@@ -748,6 +760,7 @@ class Server:
             }
         from proteinbert_tpu.kernels.attention import ATTN_PATH_TOTAL
         from proteinbert_tpu.kernels.fused_block import PATH_TOTAL
+        from proteinbert_tpu.kernels.one_pass import ONEPASS_PATH_TOTAL
 
         qw = self.scheduler.queue_wait
         # One coherent locked read of the dispatch counters: the
@@ -776,6 +789,13 @@ class Server:
             "attention_path": {f"{p}/{r}": n
                                for (p, r), n
                                in sorted(ATTN_PATH_TOTAL.items())},
+            # One-pass trunk coverage (kernels/one_pass.py, ISSUE 16):
+            # "pallas/*" means the whole block — local track AND
+            # attention — ran as a single VMEM-resident kernel;
+            # "reference/*" is the two-kernel composition fallback.
+            "onepass_path": {f"{p}/{r}": n
+                             for (p, r), n
+                             in sorted(ONEPASS_PATH_TOTAL.items())},
             # Quantized executable arm (ISSUE 12): which arm serves,
             # the measured weight-HBM footprint, and the worst sampled
             # parity deviation vs the fp32 shadow (None = fp32 arm).

@@ -134,6 +134,45 @@ def test_jit_purity_pallas_dispatch_wrapper_is_a_root(tmp_path):
     assert any("os.environ" in k and "dispatch" in k for k in keys(res))
 
 
+def test_jit_purity_composed_dispatch_chain(tmp_path):
+    # The one-pass trunk pattern (ISSUE 16): a dispatch wrapper whose
+    # FALLBACK path calls another dispatch wrapper. Host state anywhere
+    # along the composed chain (onepass -> inner) is still trace-time
+    # state of the outer jit, so the rule must flag it through the
+    # chain — while the sanctioned force-override reader stays clean.
+    write_tree(tmp_path, {"pkg/k.py": """
+        import time
+
+        from jax.experimental import pallas as pl
+
+
+        def force_reference_requested():
+            import os
+            return bool(os.environ.get("FORCE_SLOW"))
+
+
+        def kernel(ref):
+            ref[...] = ref[...] * 2
+
+
+        def inner(x):
+            x = x * time.time()         # clock at trace time
+            return pl.pallas_call(kernel)(x)
+
+
+        def onepass(x):
+            if force_reference_requested():   # sanctioned: clean
+                return inner(x)
+            return pl.pallas_call(kernel)(x)
+    """})
+    cfg = fixture_cfg(
+        tmp_path, sanctioned_env_readers=("force_reference_requested",))
+    res = run_check(cfg, rules=["jit-purity"])
+    got = keys(res)
+    assert any("time.time" in k and "inner" in k for k in got)
+    assert not any("os.environ" in k for k in got)
+
+
 # ------------------------------------------------------------ rule 2
 
 LOCK_VIOLATION = """

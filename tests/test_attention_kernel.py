@@ -238,9 +238,13 @@ def test_supported_gating():
 
 
 def test_model_level_wiring_packed_and_dense(attn_inputs):
-    """block_apply routes BOTH attention forms through the kernel under
-    use_pallas: a packed forward and a dense forward each bump their
-    (path=pallas) counters and match the reference config ≤1e-5."""
+    """block_apply routes BOTH forms through the ONE-PASS trunk
+    dispatch under use_pallas (ISSUE 16): a packed forward and a dense
+    forward each bump the onepass (path=pallas) counters — NOT the
+    per-kernel families, which only count when the one-pass plan
+    doesn't fit — and match the reference config ≤1e-5."""
+    from proteinbert_tpu.kernels import one_pass as op
+
     cfg = ModelConfig(local_dim=C, global_dim=G, key_dim=KD, num_heads=H,
                       num_blocks=1, num_annotations=16, dtype="float32",
                       use_pallas=True)
@@ -251,9 +255,10 @@ def test_model_level_wiring_packed_and_dense(attn_inputs):
     seg = _seg_rows([(1, 100), (2, 80)], [(1, L)])
     tokens = jnp.where(seg > 0, tokens, 0)
     ann = jnp.asarray((rng.random((B, S, 16)) < 0.1).astype(np.float32))
-    before = dict(ka.ATTN_PATH_TOTAL)
+    assert op.pallas_onepass_supported(C, G, L, S, KD, H, "float32")
+    before = dict(op.ONEPASS_PATH_TOTAL)
     out_f = proteinbert.apply(params, tokens, ann, cfg, segment_ids=seg)
-    assert (ka.ATTN_PATH_TOTAL.get(("pallas", "packed"), 0)
+    assert (op.ONEPASS_PATH_TOTAL.get(("pallas", "packed"), 0)
             > before.get(("pallas", "packed"), 0))
     out_r = proteinbert.apply(params, tokens, ann, rcfg, segment_ids=seg)
     for a, b in zip(out_f, out_r):
@@ -261,9 +266,9 @@ def test_model_level_wiring_packed_and_dense(attn_inputs):
                                    atol=1e-5, rtol=1e-5)
     # Dense (unpacked) form — the bucketed-serving executable shape.
     ann_d = jnp.asarray((rng.random((B, 16)) < 0.1).astype(np.float32))
-    before = dict(ka.ATTN_PATH_TOTAL)
+    before = dict(op.ONEPASS_PATH_TOTAL)
     out_fd = proteinbert.apply(params, tokens, ann_d, cfg)
-    assert (ka.ATTN_PATH_TOTAL.get(("pallas", "dense"), 0)
+    assert (op.ONEPASS_PATH_TOTAL.get(("pallas", "dense"), 0)
             > before.get(("pallas", "dense"), 0))
     out_rd = proteinbert.apply(params, tokens, ann_d, rcfg)
     for a, b in zip(out_fd, out_rd):

@@ -554,13 +554,14 @@ class TestFusedPathCounter:
         assert a_pal.value == 1
 
     def test_ragged_packed_takes_pallas_path(self):
-        """THE ragged-serve fast-path smoke (ISSUE 10/13 acceptance):
-        on a shape the kernels support, the packed executable the
-        ragged dispatcher builds must land on the Pallas path for BOTH
-        the fused local track and the ragged attention — zero
-        reason=segments fallbacks on either counter."""
+        """THE ragged-serve fast-path smoke (ISSUE 10/13/16
+        acceptance): on a shape the kernels support, the packed
+        executable the ragged dispatcher builds must land on the
+        Pallas ONE-PASS path — the whole trunk block in one kernel —
+        with zero fallbacks on any of the three counter families."""
         from proteinbert_tpu.kernels import attention as ka
         from proteinbert_tpu.kernels import fused_block as fb
+        from proteinbert_tpu.kernels import one_pass as op
 
         pcfg = PretrainConfig(
             model=ModelConfig(local_dim=128, global_dim=32, key_dim=8,
@@ -573,23 +574,27 @@ class TestFusedPathCounter:
             train=TrainConfig(seed=0, max_steps=1),
             checkpoint=CheckpointConfig(),
         )
-        assert fb.pallas_segments_supported(128, SEQ_LEN, 4, "float32")
-        assert ka.pallas_attention_supported(128, 32, SEQ_LEN, 4, 8, 2,
-                                             "float32")
+        assert op.pallas_onepass_supported(128, 32, SEQ_LEN, 4, 8, 2,
+                                           "float32")
         params = create_train_state(jax.random.PRNGKey(0), pcfg).params
         disp = RaggedDispatcher(params, pcfg, rows_per_batch=2,
                                 max_segments=4)
-        before = dict(fb.PATH_TOTAL)
+        before = dict(op.ONEPASS_PATH_TOTAL)
+        fb_before = dict(fb.PATH_TOTAL)
         attn_before = dict(ka.ATTN_PATH_TOTAL)
         assert disp.warmup(("embed",)) == 1
-        delta = {k: fb.PATH_TOTAL.get(k, 0) - before.get(k, 0)
-                 for k in fb.PATH_TOTAL}
+        delta = {k: op.ONEPASS_PATH_TOTAL.get(k, 0) - before.get(k, 0)
+                 for k in op.ONEPASS_PATH_TOTAL}
         assert delta.get(("pallas", "packed"), 0) >= 1
         assert delta.get(("reference", "segments"), 0) == 0
+        # The supported shape never degrades to the two-kernel
+        # composition, so the per-kernel families stay silent too.
+        fb_delta = {k: fb.PATH_TOTAL.get(k, 0) - fb_before.get(k, 0)
+                    for k in fb.PATH_TOTAL}
+        assert fb_delta.get(("reference", "segments"), 0) == 0
         attn_delta = {k: ka.ATTN_PATH_TOTAL.get(k, 0)
                       - attn_before.get(k, 0)
                       for k in ka.ATTN_PATH_TOTAL}
-        assert attn_delta.get(("pallas", "packed"), 0) >= 1
         assert attn_delta.get(("reference", "segments"), 0) == 0
 
 

@@ -167,6 +167,13 @@ _EVENT_METRICS = (
     # masquerade as the hardware capture).
     ("pack_attn_capture", "attn_speedup_x", "pack_attn_speedup_x"),
     ("pack_attn_capture", "mfu_effective", "pack_mfu_effective"),
+    # One-pass trunk (ISSUE 16): the fused block-pass vs two-kernel
+    # composition A/B ratio, and its pad-adjusted MFU — the
+    # HBM-round-trip-elimination claim as a sentinel series (same
+    # platform split: CPU-interpret points never masquerade as the
+    # hardware capture).
+    ("onepass_capture", "onepass_speedup_x", "pack_onepass_speedup_x"),
+    ("onepass_capture", "mfu_effective", "onepass_mfu_effective"),
     # Multi-tenant heads (ISSUE 8): mixed-head throughput + the WORST
     # normalized downstream-eval score across heads — finetune-quality
     # regressions gate through the same sentinel as perf.

@@ -135,6 +135,19 @@ timeout -k 10 420 python "$(dirname "$0")/attn_smoke.py"
 rca=$?
 [ "$rc" -eq 0 ] && rc=$rca
 
+# One-pass trunk smoke (ISSUE 16 tentpole): the whole block pass —
+# local conv track + ragged attention — as ONE VMEM-resident Pallas
+# grid program through the real dispatch entries. GATED: packed/dense/
+# serving-real_mask BIT-identity vs the two-kernel composition, exactly
+# one pallas_call boundary in the one-pass trace (the HBM round-trip
+# is eliminated, not just faster), custom-VJP gradient parity, the
+# PBT_FORCE_REFERENCE_KERNEL override, int8 in-kernel dequant
+# bit-matching the HLO dequant, and the onepass_capture note schema.
+echo "=== one-pass trunk smoke (fused block pass + int8 dequant, CPU) ==="
+timeout -k 10 420 python "$(dirname "$0")/onepass_smoke.py"
+rco=$?
+[ "$rc" -eq 0 ] && rc=$rco
+
 # Reshard smoke (ISSUE 11): save a tiny ZeRO-1 train state on a 4x2
 # CPU-virtual mesh, reshard 4x2 -> 8x1 -> 1 -> 4x2 through the real
 # reshard verb. GATED: byte-identical round-trip parity (params + Adam

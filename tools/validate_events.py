@@ -161,6 +161,21 @@ NEGATIVE_CASES = [
          "source": "bench", "kind": "pack_attn_capture",
          "attn_speedup_x": 1.1,
          "parity_max_abs_diff": float("nan")},  # finite when present
+        # the onepass_capture note (bench --pack one-pass arm, ISSUE
+        # 16): sentinel-input fields are typed + required.
+        {"v": 1, "event": "note", "seq": 0, "t": 0.0,
+         "source": "bench", "kind": "onepass_capture"},  # no speedup
+        {"v": 1, "event": "note", "seq": 0, "t": 0.0,
+         "source": "bench", "kind": "onepass_capture",
+         "onepass_speedup_x": 0.0},  # speedup must be > 0
+        {"v": 1, "event": "note", "seq": 0, "t": 0.0,
+         "source": "bench", "kind": "onepass_capture",
+         "onepass_speedup_x": 1.3,
+         "mfu_effective": -0.1},  # MFU must be >= 0 when present
+        {"v": 1, "event": "note", "seq": 0, "t": 0.0,
+         "source": "bench", "kind": "onepass_capture",
+         "onepass_speedup_x": 1.3,
+         "parity_max_abs_diff": float("inf")},  # finite when present
         # offline batch inference (ISSUE 14): map_* rows are typed —
         # the chaos drill audits streams with this validator, so a
         # writer bug must fail here, not corrupt the drill's verdict.

@@ -1107,6 +1107,7 @@ def cmd_serve(args) -> int:
     import threading
     import time as _time
 
+    from proteinbert_tpu.heads import TrunkMismatchError
     from proteinbert_tpu.serve import Server
     from proteinbert_tpu.serve.http import make_http_server
     from proteinbert_tpu.train.resilience import GracefulShutdown
@@ -1203,27 +1204,50 @@ def cmd_serve(args) -> int:
     elif args.heads:
         raise SystemExit("--heads requires --registry")
 
-    server = Server(
-        params, cfg,
-        max_batch=args.max_batch,
-        max_wait_s=args.max_wait_ms / 1000.0,
-        queue_depth=args.queue_depth,
-        cache_size=args.cache_size,
-        default_deadline_s=(args.deadline_ms / 1000.0
-                            if args.deadline_ms is not None else None),
-        on_long=args.on_long,
-        mesh=mesh,
-        telemetry=tele,
-        trace_sample_rate=args.trace_sample_rate,
-        slos=slos,
-        slo_profile_dir=args.slo_profile_dir,
-        registry=registry,
-        heads=head_ids,
-        serve_mode=args.serve_mode,
-        pack_max_segments=args.pack_max_segments,
-        quant=args.quant,
-        quant_parity_every=args.quant_parity_every,
-    )
+    index = None
+    if args.index:
+        from proteinbert_tpu.index.scorer import NeighborIndex
+        from proteinbert_tpu.mapper import StoreError
+
+        try:
+            index = NeighborIndex.load(args.index)
+        except StoreError as e:
+            raise SystemExit(f"--index: {e}")
+        log(f"neighbor index: {index.num_vectors} vector(s), "
+            f"{index.centroids.shape[0]} centroid(s), dim {index.dim}, "
+            f"identity {index.digest[:16]}… (nprobe {args.nprobe}) — "
+            "serving /v1/neighbors")
+    elif args.nprobe != 8:
+        raise SystemExit("--nprobe requires --index")
+
+    try:
+        server = Server(
+            params, cfg,
+            max_batch=args.max_batch,
+            max_wait_s=args.max_wait_ms / 1000.0,
+            queue_depth=args.queue_depth,
+            cache_size=args.cache_size,
+            default_deadline_s=(args.deadline_ms / 1000.0
+                                if args.deadline_ms is not None else None),
+            on_long=args.on_long,
+            mesh=mesh,
+            telemetry=tele,
+            trace_sample_rate=args.trace_sample_rate,
+            slos=slos,
+            slo_profile_dir=args.slo_profile_dir,
+            registry=registry,
+            heads=head_ids,
+            serve_mode=args.serve_mode,
+            pack_max_segments=args.pack_max_segments,
+            quant=args.quant,
+            quant_parity_every=args.quant_parity_every,
+            index=index,
+            nprobe=args.nprobe,
+        )
+    except TrunkMismatchError as e:
+        # The index pins the trunk its embeddings came from; serving it
+        # over a different trunk would answer with garbage neighbors.
+        raise SystemExit(f"--index: {e}")
     if server.quant != "fp32":
         qr = server.dispatcher.quant_report
         log(f"quantized executable arm: {server.quant} — trunk weights "
@@ -1395,6 +1419,86 @@ def cmd_map(args) -> int:
             f"{out['halted_shards']} failed_shards="
             f"{out['failed_shards']}")
         return 1
+    return 0
+
+
+def cmd_index(args) -> int:
+    """Neighbor-index construction (ISSUE 17 tentpole): coarse k-means
+    centroids + per-block int8-quantized vectors over a COMPLETED
+    embedding store, built shard-by-shard through the mapper's
+    crash-safe cursor protocol — kill-anywhere, a resume loses at most
+    one block per shard, and re-runs converge on byte-identical
+    objects. `--verify` audits an existing index (digests, geometry,
+    coverage) and needs only the index directory — no model, no jax.
+    docs/neighbors.md has the format and lifecycle."""
+    from proteinbert_tpu.index import build_index, verify_index
+    from proteinbert_tpu.mapper import StoreConfigError, StoreError
+
+    if args.verify:
+        try:
+            report = verify_index(args.index)
+        except StoreConfigError as e:
+            raise SystemExit(f"--verify: {e}")
+        print(json.dumps(report))
+        if not report["ok"]:
+            problems = []
+            for rec in report["corrupt"]:
+                where = (f"shard {rec['shard']} block {rec['block']}"
+                         if "shard" in rec else rec.get("kind", "?"))
+                problems.append(f"corrupt {where} ({rec['reason']}, "
+                                f"{str(rec['digest'])[:16]}…)")
+            for rec in report["holes"]:
+                where = (f"shard {rec['shard']} block {rec['block']}"
+                         if "shard" in rec else rec.get("kind", "?"))
+                problems.append(f"hole: {where} object "
+                                f"{str(rec['digest'])[:16]}… is missing")
+            problems.extend(report["coverage_errors"])
+            log("index FAILED verification: " + "; ".join(problems))
+            return 1
+        log(f"index OK: {report['blocks_checked']} block(s) verified, "
+            f"{report['vectors']} vector(s)"
+            + ("" if report["complete"] else " (build incomplete)"))
+        return 0
+
+    if not args.store:
+        raise SystemExit("pbt index needs --store (or --verify to "
+                         "audit an existing index)")
+    from proteinbert_tpu.train.resilience import GracefulShutdown
+
+    tele = None
+    if args.events_jsonl:
+        from proteinbert_tpu.obs import Telemetry
+
+        tele = Telemetry(events_path=args.events_jsonl)
+        tele.flight.install_excepthook()
+    try:
+        with GracefulShutdown() as stop:
+            stats = build_index(
+                args.store, args.index,
+                num_centroids=args.centroids,
+                block_size=args.block_size,
+                seed=args.seed, kmeans_iters=args.kmeans_iters,
+                sample_cap=args.sample_cap, max_blocks=args.max_blocks,
+                stop_flag=lambda: stop.requested, telemetry=tele)
+    except (StoreError, ValueError) as e:
+        raise SystemExit(f"index build failed: {e}")
+    finally:
+        if tele is not None:
+            _export_metrics(tele)
+            tele.close()
+    if args.json:
+        print(json.dumps(stats))
+    log(f"index {stats['outcome']}: {stats['vectors']} vector(s) in "
+        f"{stats['blocks']} block(s) over {stats['shards']} shard(s), "
+        f"{stats['reworked_blocks']} re-worked; int8 index is "
+        f"{stats['bytes_ratio']:.3f}x the fp32 vector bytes")
+    if stats["outcome"] == "preempted":
+        # EX_TEMPFAIL, same contract as map/pretrain: not done —
+        # requeue; the cursors bound the requeue cost at one block
+        # per shard.
+        log("index build preempted; exiting 75 so a supervisor "
+            "requeues it")
+        return 75
     return 0
 
 
@@ -2020,6 +2124,18 @@ def build_parser() -> argparse.ArgumentParser:
                          "stats()['quant'], serve_batch events). "
                          "0 disables. Default: the run config's "
                          "serve.quant_parity_every")
+    sv.add_argument("--index",
+                    help="neighbor-index directory (pbt index) to "
+                         "serve /v1/neighbors from: query sequences "
+                         "embed through the trunk, then probe the "
+                         "int8 IVF index (docs/neighbors.md). The "
+                         "index must have been built from THIS "
+                         "trunk's embedding store (fingerprint "
+                         "enforced)")
+    sv.add_argument("--nprobe", type=int, default=8,
+                    help="with --index: centroid lists probed per "
+                         "query — the recall/latency dial (recall "
+                         "gate: bench.py --neighbors)")
     sv.set_defaults(fn=cmd_serve)
 
     mp = sub.add_parser("map",
@@ -2078,6 +2194,50 @@ def build_parser() -> argparse.ArgumentParser:
                          "events here (pbt diagnose --map reads them); "
                          "also arms the flight recorder for NaN halts")
     mp.set_defaults(fn=cmd_map)
+
+    ix = sub.add_parser("index",
+                        help="build an int8 IVF neighbor index over a "
+                             "completed embedding store (resumable, "
+                             "kill-anywhere; serves /v1/neighbors — "
+                             "docs/neighbors.md)")
+    ix.add_argument("--index", required=True,
+                    help="index directory (created on first run; an "
+                         "existing one RESUMES from its shard cursors)")
+    ix.add_argument("--store",
+                    help="COMPLETED embedding store (pbt map) to "
+                         "index; required unless --verify")
+    ix.add_argument("--verify", action="store_true",
+                    help="audit an existing index instead of building: "
+                         "recompute every referenced sha256, audit "
+                         "block geometry/coverage and the centroids "
+                         "pin (typed, nonzero exit). Needs only "
+                         "--index — no model, no jax")
+    ix.add_argument("--centroids", type=int, default=64,
+                    help="coarse k-means centroid count (clamped to "
+                         "the corpus size; pinned in the manifest)")
+    ix.add_argument("--block-size", type=int, default=256,
+                    help="vectors per durably-committed index block "
+                         "(the re-work unit: a kill loses at most one "
+                         "in-flight block per shard)")
+    ix.add_argument("--seed", type=int, default=0,
+                    help="k-means seed — same store + same knobs → "
+                         "byte-identical index (pinned in the manifest)")
+    ix.add_argument("--kmeans-iters", type=int, default=8,
+                    help="Lloyd iterations for the coarse centroids")
+    ix.add_argument("--sample-cap", type=int, default=4096,
+                    help="deterministic strided sample size the "
+                         "centroids are fit on")
+    ix.add_argument("--max-blocks", type=int,
+                    help="stop (resumably, exit 75) after this many "
+                         "blocks this invocation — smoke/drill knob")
+    ix.add_argument("--json", action="store_true",
+                    help="print the terminal build stats as one JSON "
+                         "line (drill/script consumption)")
+    ix.add_argument("--events-jsonl", type=creatable_path,
+                    help="append index_build/index_shard events here "
+                         "(pbt diagnose reads them); also arms the "
+                         "flight recorder")
+    ix.set_defaults(fn=cmd_index)
 
     rs = sub.add_parser("reshard",
                         help="restore a checkpoint onto a new mesh "

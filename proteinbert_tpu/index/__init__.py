@@ -1,0 +1,28 @@
+"""Neighbor index subsystem (`pbt index` + `/v1/neighbors`).
+
+- `index.store` — stdlib+numpy build/verify half: the resumable,
+  kill-anywhere `pbt index` builder on the mapper's cursor protocol,
+  `verify_index`, and the digest helpers (importable without jax, same
+  contract as `mapper.store`).
+- `index.scorer` — the jax half: `NeighborIndex.load` + the jitted
+  batched IVF-flat lookup, plus the exact brute-force recall helpers.
+
+Only the store half is re-exported here so `import proteinbert_tpu.index`
+stays jax-free; serving/bench code imports the scorer explicitly:
+`from proteinbert_tpu.index.scorer import NeighborIndex`.
+"""
+
+from proteinbert_tpu.index.store import (
+    CENTROIDS_POINTER, DEFAULT_BLOCK_SIZE, DEFAULT_CENTROIDS,
+    INDEX_BUILD_STATES, INDEX_FAULT_ENV, INDEX_KIND, IndexBuildError,
+    build_index, index_digests, index_identity, load_centroids,
+    verify_index,
+)
+
+__all__ = [
+    "CENTROIDS_POINTER", "DEFAULT_BLOCK_SIZE", "DEFAULT_CENTROIDS",
+    "INDEX_BUILD_STATES", "INDEX_FAULT_ENV", "INDEX_KIND",
+    "IndexBuildError",
+    "build_index", "index_digests", "index_identity", "load_centroids",
+    "verify_index",
+]

@@ -39,7 +39,8 @@ from typing import Any, Dict, Optional
 
 from proteinbert_tpu.obs.events import (
     CKPT_PHASES, EVENT_FIELDS, FLEET_REPLICA_STATES,
-    FLEET_REQUEST_OUTCOMES, MAP_OUTCOMES, MAP_SHARD_STATES, OUTCOMES,
+    FLEET_REQUEST_OUTCOMES, INDEX_BUILD_STATES, INDEX_SHARD_STATES,
+    MAP_OUTCOMES, MAP_SHARD_STATES, OUTCOMES,
     SCHEMA_VERSION,
     SERVE_OUTCOMES, SERVE_REJECT_REASONS, SERVE_REQUEST_OUTCOMES,
     EventLog,
@@ -159,6 +160,7 @@ __all__ = [
     "SCHEMA_VERSION", "EVENT_FIELDS", "CKPT_PHASES", "OUTCOMES",
     "SERVE_OUTCOMES", "SERVE_REJECT_REASONS", "SERVE_REQUEST_OUTCOMES",
     "FLEET_REPLICA_STATES", "FLEET_REQUEST_OUTCOMES",
+    "INDEX_BUILD_STATES", "INDEX_SHARD_STATES",
     "MAP_OUTCOMES", "MAP_SHARD_STATES",
     "MetricsRegistry", "QuantileWindow",
     "SLObjective", "SLOEvaluator", "ExemplarHistogram", "ProfileTrigger",

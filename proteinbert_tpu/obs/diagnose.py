@@ -294,6 +294,57 @@ def summarize_serve(records: List[Dict[str, Any]],
             "fused_fallback": end_stats.get("fused_fallback"),
         }
 
+    # ---- /v1/neighbors attribution (ISSUE 17) ----
+    # Neighbors requests carry a `lookup` stage between execute and
+    # finalize; the stage set still tiles e2e by construction, so the
+    # embed leg (submit..execute) and the lookup leg split each traced
+    # request's latency exactly — no extra instrumentation needed.
+    nreqs = [r for r in reqs if r.get("kind") == "neighbors"]
+    nqueries = [r for r in records if r["event"] == "neighbor_query"]
+    if nreqs or nqueries:
+        embed_names = ("submit", "queue", "batch_form", "dispatch",
+                       "execute")
+
+        def _leg(r: Dict[str, Any]) -> float:
+            return sum(v for k, v in (r.get("stages") or {}).items()
+                       if k in embed_names
+                       and isinstance(v, (int, float)))
+
+        served = [r for r in nreqs
+                  if isinstance((r.get("stages") or {}).get("lookup"),
+                                (int, float))]
+        embed_leg = sorted(_leg(r) for r in served)
+        lookup_leg = sorted(r["stages"]["lookup"] for r in served)
+        outcomes = collections.Counter(r["outcome"] for r in nreqs)
+        n_out = sum(outcomes.values())
+        lookups = [q["lookup_s"] for q in nqueries
+                   if isinstance(q.get("lookup_s"), (int, float))]
+        cands = [q["candidates"] for q in nqueries
+                 if isinstance(q.get("candidates"), int)]
+        nb: Dict[str, Any] = {
+            "requests_traced": len(nreqs),
+            "outcomes": dict(outcomes),
+            "cache_hit_rate": (round(outcomes.get("cache_hit", 0)
+                                     / n_out, 4) if n_out else None),
+            "embed_leg": {"n": len(embed_leg),
+                          "p50_s": _percentile(embed_leg, 0.50),
+                          "p99_s": _percentile(embed_leg, 0.99)},
+            "lookup_leg": {"n": len(lookup_leg),
+                           "p50_s": _percentile(lookup_leg, 0.50),
+                           "p99_s": _percentile(lookup_leg, 0.99)},
+            "queries": len(nqueries),
+            "mean_lookup_s": (round(sum(lookups) / len(lookups), 6)
+                              if lookups else None),
+            "mean_candidates": (round(sum(cands) / len(cands), 1)
+                                if cands else None),
+        }
+        if end_stats is not None \
+                and isinstance(end_stats.get("neighbors"), dict):
+            nb["final"] = end_stats["neighbors"]
+        out["neighbors"] = nb
+    else:
+        out["neighbors"] = None
+
     # ---- SLO breaches ----
     out["slo_breaches"] = [{
         "objective": b["objective"], "burn_rate": b["burn_rate"],
@@ -514,6 +565,34 @@ def render_serve(summary: Dict[str, Any]) -> str:
                 lines.append(f"  fused-kernel fallback ({reason}): "
                              f"{n} executable(s) on the XLA reference "
                              "path")
+    nb = summary.get("neighbors")
+    if nb:
+        outc = ", ".join(f"{k}={v}"
+                         for k, v in sorted(nb["outcomes"].items()))
+        hit = (f", cache hit rate {nb['cache_hit_rate']}"
+               if nb["cache_hit_rate"] is not None else "")
+        lines.append(f"neighbors: {nb['requests_traced']} traced "
+                     f"({outc}{hit})")
+        el, ll = nb["embed_leg"], nb["lookup_leg"]
+        if ll["n"]:
+            lines.append(
+                f"  embed leg: p50 {el['p50_s'] * 1e3:.2f}ms "
+                f"p99 {el['p99_s'] * 1e3:.2f}ms; lookup leg: "
+                f"p50 {ll['p50_s'] * 1e3:.2f}ms "
+                f"p99 {ll['p99_s'] * 1e3:.2f}ms (n={ll['n']})")
+        if nb.get("mean_lookup_s") is not None:
+            lines.append(
+                f"  probes: {nb['queries']} sampled, mean lookup "
+                f"{nb['mean_lookup_s'] * 1e3:.2f}ms over "
+                f"{nb['mean_candidates']} candidate(s)")
+        fin = nb.get("final")
+        if fin:
+            lines.append(
+                f"  index: {fin.get('num_vectors')} vector(s), "
+                f"nprobe {fin.get('nprobe')}, "
+                f"{fin.get('lookup_executables')} warm lookup "
+                f"executable(s), identity "
+                f"{str(fin.get('index_digest'))[:16]}…")
     for br in summary["slo_breaches"]:
         lines.append(f"SLO BREACH: {br['objective']} burn "
                      f"{br['burn_rate']:.2f} ({br['bad']}/{br['total']} "

@@ -141,6 +141,21 @@ EVENT_FIELDS: Dict[str, Dict[str, Any]] = {
     # Terminal mapping record; outcome in MAP_OUTCOMES, stats is the
     # run_map result (blocks/seqs/quarantined/retries/rework/...).
     "map_end": {"outcome": str, "stats": dict},
+    # ---- neighbor index (`pbt index` + /v1/neighbors, ISSUE 17) ----
+    # Build lifecycle: state in INDEX_BUILD_STATES ("start" opens the
+    # run with stats={} + extra config/pid; the terminal record carries
+    # the builder's stats dict — vectors/blocks/rework/bytes ratio).
+    "index_build": {"state": str, "stats": dict},
+    # One index-shard lifecycle transition: state in
+    # INDEX_SHARD_STATES. Typed optional fields: blocks, next, size,
+    # tail_reworked (non-negative ints), cursor_source.
+    "index_shard": {"shard": int, "state": str},
+    # One served /v1/neighbors lookup (sampled like serve_request —
+    # failures always sampled): k/nprobe are the executable's static
+    # shape. Typed optional fields: candidates (non-negative int),
+    # lookup_s (non-negative finite seconds, the ANN leg),
+    # outcome (SERVE_REQUEST_OUTCOMES).
+    "neighbor_query": {"k": int, "nprobe": int},
 }
 
 CKPT_PHASES = ("dispatch", "landed", "save")
@@ -176,6 +191,15 @@ MAP_SHARD_STATES = ("start", "resume", "done", "halted", "failed")
 # halted (a shard hit non-finite output), error (a shard exhausted its
 # retry budget).
 MAP_OUTCOMES = ("completed", "preempted", "halted", "error")
+# Index-build lifecycle states (index/store.py, duplicated here because
+# this module must stay import-light): start (run opened), completed,
+# preempted (SIGTERM/SIGINT or --max-blocks — resumable, CLI exits
+# 75), error.
+INDEX_BUILD_STATES = ("start", "completed", "preempted", "error")
+# Index shard lifecycle: start (fresh cursor), resume (existing cursor
+# picked up — incl. torn-tail / prev-generation fallback), done,
+# preempted (stopped mid-shard, resumable).
+INDEX_SHARD_STATES = ("start", "resume", "done", "preempted")
 
 
 def sanitize(value: Any) -> Any:
@@ -426,6 +450,40 @@ def validate_record(rec: Any) -> None:
     if event == "map_end" and rec["outcome"] not in MAP_OUTCOMES:
         raise ValueError(f"map_end.outcome {rec['outcome']!r} not in "
                          f"{MAP_OUTCOMES}")
+    if event == "index_build" and rec["state"] not in INDEX_BUILD_STATES:
+        raise ValueError(f"index_build.state {rec['state']!r} not in "
+                         f"{INDEX_BUILD_STATES}")
+    if event == "index_shard":
+        if rec["state"] not in INDEX_SHARD_STATES:
+            raise ValueError(f"index_shard.state {rec['state']!r} not "
+                             f"in {INDEX_SHARD_STATES}")
+        for name in ("shard", "blocks", "next", "size", "tail_reworked"):
+            v = rec.get(name)
+            if v is not None and (not isinstance(v, int)
+                                  or isinstance(v, bool) or v < 0):
+                raise ValueError(f"index_shard.{name} must be a "
+                                 f"non-negative int, got {v!r}")
+    if event == "neighbor_query":
+        for name in ("k", "nprobe"):
+            v = rec[name]
+            if isinstance(v, bool) or v < 1:
+                raise ValueError(f"neighbor_query.{name} must be a "
+                                 f"positive int, got {v!r}")
+        cand = rec.get("candidates")
+        if cand is not None and (not isinstance(cand, int)
+                                 or isinstance(cand, bool) or cand < 0):
+            raise ValueError(f"neighbor_query.candidates must be a "
+                             f"non-negative int, got {cand!r}")
+        ls = rec.get("lookup_s")
+        if ls is not None and (isinstance(ls, bool)
+                               or not isinstance(ls, (int, float))
+                               or not math.isfinite(ls) or ls < 0):
+            raise ValueError(f"neighbor_query.lookup_s must be a "
+                             f"non-negative finite number, got {ls!r}")
+        oc = rec.get("outcome")
+        if oc is not None and oc not in SERVE_REQUEST_OUTCOMES:
+            raise ValueError(f"neighbor_query.outcome {oc!r} not in "
+                             f"{SERVE_REQUEST_OUTCOMES}")
     if event == "note" and rec.get("kind") == "map_capture":
         # The map-throughput capture (tools/map_drill.py --bench-events):
         # its rate field is a trajectory-sentinel input, so a writer bug
@@ -533,6 +591,38 @@ def validate_record(rec: Any) -> None:
                 raise ValueError(
                     f"note(kind=onepass_capture).{name} must be a "
                     f"non-negative finite number, got {v!r}")
+    if event == "note" and rec.get("kind") == "neighbors_capture":
+        # The ANN serving capture (bench.py --neighbors, ISSUE 17):
+        # its QPS and recall fields feed trajectory-sentinel series
+        # (recall is HIGHER-is-better), so a writer bug must fail
+        # validation, not poison the series.
+        for name in ("neighbors_qps", "neighbors_recall_at_10"):
+            v = rec.get(name)
+            if v is None:
+                raise ValueError(
+                    f"note(kind=neighbors_capture): missing required "
+                    f"field {name!r}")
+        v = rec.get("neighbors_qps")
+        if (isinstance(v, bool) or not isinstance(v, (int, float))
+                or not math.isfinite(v) or v <= 0):
+            raise ValueError(
+                f"note(kind=neighbors_capture).neighbors_qps must be "
+                f"a positive finite number, got {v!r}")
+        r = rec.get("neighbors_recall_at_10")
+        if (isinstance(r, bool) or not isinstance(r, (int, float))
+                or not math.isfinite(r) or not 0.0 <= r <= 1.0):
+            raise ValueError(
+                f"note(kind=neighbors_capture).neighbors_recall_at_10 "
+                f"must be a number in [0, 1], got {r!r}")
+        for name in ("embed_qps", "neighbors_qps_ratio",
+                     "index_bytes_ratio"):
+            v = rec.get(name)
+            if v is not None and (isinstance(v, bool)
+                                  or not isinstance(v, (int, float))
+                                  or not math.isfinite(v) or v <= 0):
+                raise ValueError(
+                    f"note(kind=neighbors_capture).{name} must be a "
+                    f"positive finite number, got {v!r}")
 
 
 def make_example(event: str) -> Dict[str, Any]:
@@ -575,6 +665,11 @@ def make_example(event: str) -> Dict[str, Any]:
         "map_block": {"shard": 0, "block": 0, "digest": "0" * 64,
                       "n": 8, "seqs_per_s": 12.5},
         "map_end": {"outcome": "completed", "stats": {"blocks": 1}},
+        "index_build": {"state": "start", "stats": {}, "pid": 1},
+        "index_shard": {"shard": 0, "state": "start", "next": 0,
+                        "size": 16},
+        "neighbor_query": {"k": 10, "nprobe": 8, "candidates": 64,
+                           "lookup_s": 0.001, "outcome": "ok"},
     }
     return make_record(event, seq=0, t=0.0, **payloads[event])
 

@@ -71,7 +71,7 @@ DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
 # derives. A typo'd stage must fail at parse time, not silently judge
 # the wrong duration.
 VALID_STAGES = ("e2e", "submit", "queue", "batch_form", "dispatch",
-                "execute", "finalize", "pad_wasted")
+                "execute", "lookup", "finalize", "pad_wasted")
 
 
 @dataclasses.dataclass(frozen=True)

@@ -564,6 +564,38 @@ def param_bytes(params: Any) -> int:
     return total
 
 
+def quantize_rows_int8(x) -> Tuple["np.ndarray", "np.ndarray"]:
+    """Symmetric per-channel int8 quantization of a ROW BATCH — the
+    store-side counterpart of `quantize_params` (same convention:
+    amax/127 scales, deterministic round-to-nearest, zero-range
+    channels pinned to scale 1.0). Host numpy on purpose: the neighbor
+    index builder (proteinbert_tpu/index/) quantizes residual vectors
+    while serializing blocks, where byte-identical re-runs are part of
+    the durability contract and device nondeterminism would break the
+    chaos drill's byte-identity gate. Returns (codes int8 (n, d),
+    scales fp32 (d,))."""
+    import numpy as np
+    x = np.asarray(x, dtype=np.float32)
+    if x.ndim != 2:
+        raise QuantConfigError(
+            f"quantize_rows_int8 expects (rows, channels), got shape "
+            f"{x.shape}")
+    amax = np.max(np.abs(x), axis=0) if x.shape[0] else \
+        np.zeros(x.shape[1], np.float32)
+    scale = np.where(amax > 0, amax / 127.0, 1.0).astype(np.float32)
+    codes = np.clip(np.rint(x / scale), -127, 127).astype(np.int8)
+    return codes, scale
+
+
+def dequantize_rows_int8(codes, scale) -> "np.ndarray":
+    """Inverse of quantize_rows_int8 (up to rounding): codes * scale,
+    fp32. The offline/reference dequant — the jitted scorer fuses the
+    same arithmetic into its executable."""
+    import numpy as np
+    return (np.asarray(codes, np.float32)
+            * np.asarray(scale, np.float32)[None, :])
+
+
 def fake_quant_act(x: jax.Array) -> jax.Array:
     """Dynamic per-tensor symmetric int8 fake-quantization (the opt-in
     activation arm): quantize-dequantize in the activation dtype, so

@@ -57,6 +57,14 @@ KINDS = ("embed", "predict_go", "predict_residues")
 # tests/test_heads.py).
 TASK_KIND = "predict_task"
 
+# The ANN request kind (ISSUE 17): a `neighbors` request's DEVICE work
+# is exactly an embed — the query rides the same warm embed executables
+# (bucketed and packed) and only differs after host fetch, when the
+# server probes the neighbor index with the returned global embedding.
+# Both dispatchers therefore NORMALIZE it to "embed" on entry: same
+# jitted fn, same `_warm` key, so serving neighbors adds zero compiles.
+NEIGHBORS_KIND = "neighbors"
+
 
 def resolve_buckets(cfg: PretrainConfig, buckets=None) -> Tuple[int, ...]:
     """Serving bucket boundaries: the explicit argument, else the
@@ -500,6 +508,8 @@ class BucketDispatcher:
         shape), "pad_fraction": padding share of the (batch_class, L)
         grid the executable actually ran — row padding up to the class
         plus token padding within rows}."""
+        if kind == NEIGHBORS_KIND:
+            kind = "embed"  # identical device work, shared executable
         rows, L = tokens.shape
         if L not in self.buckets:
             raise ValueError(f"tokens length {L} is not one of the "
@@ -831,6 +841,8 @@ class RaggedDispatcher(BucketDispatcher):
         {"global" (G,), "local_mean" (C,)} / (A,) probs /
         (span, V) probs / the rider's head output.
         """
+        if kind == NEIGHBORS_KIND:
+            kind = "embed"  # identical device work, shared executable
         R, L = tokens.shape
         if (R, L) != (self.rows_per_batch, self.cfg.data.seq_len):
             raise ValueError(

@@ -72,6 +72,7 @@ ROUTE_KINDS = {
     "/v1/predict_go": "predict_go",
     "/v1/predict_residues": "predict_residues",
     "/v1/predict_task": "predict_task",
+    "/v1/neighbors": "neighbors",
 }
 
 # 503 = the replica is closing/draining (ServerClosedError) — the work
@@ -185,6 +186,7 @@ class FleetRouter:
         request_timeout_s: float = 30.0,
         cache_size: int = 2048,
         fault_injector: Optional[FaultInjector] = None,
+        index_digest: Optional[str] = None,
     ):
         from proteinbert_tpu.obs import as_telemetry
 
@@ -217,6 +219,12 @@ class FleetRouter:
         self.retry_budget_floor = retry_budget_floor
         self.request_timeout_s = request_timeout_s
         self.injector = fault_injector
+        # Identity of the neighbor index the replicas serve (ISSUE 17,
+        # `index_identity(index_dir)`): it scopes cached /v1/neighbors
+        # responses to the exact index contents. Without it the router
+        # cannot prove two replicas hold the same index, so neighbor
+        # responses are simply not cached (forwarding still works).
+        self.index_digest = index_digest
         self.cache = EmbeddingCache(cache_size, metrics=self.tele.metrics)
         self._lock = threading.Lock()
         self._rr = itertools.count()
@@ -490,6 +498,15 @@ class FleetRouter:
             return None
         ann = body.get("annotations")
         scope = kind
+        if kind == "neighbors":
+            # Cacheable only when the router knows WHICH index the
+            # fleet serves — the digest + requested k scope the key
+            # exactly like the replica-side cache does.
+            if self.index_digest is None:
+                return None
+            scope += f":{self.index_digest[:16]}"
+            if body.get("k") is not None:
+                scope += f":k{body['k']}"
         if body.get("head_id") is not None:
             scope += f":{body['head_id']}"
         if body.get("top_k") is not None:

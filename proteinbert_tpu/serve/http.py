@@ -19,6 +19,11 @@ Routes (POST bodies and responses are JSON):
        → {"head_id", "outputs": [...]} — one registered head's float32
          logits/prediction, shaped by its task kind (multi-tenant
          serving, ISSUE 8); unknown/removed head → typed 404
+  POST /v1/neighbors         {"seq", "k"?, "deadline_ms"?}
+       → {"neighbors": [[corpus_id, cosine_score], ...]} best-first —
+         the sequence embeds through the trunk, then probes the
+         server's attached int8 IVF index (`pbt serve --index`,
+         ISSUE 17); no index attached → 400
   GET  /v1/heads             → {"heads": [{head_id, name, kind, ...}]}
   POST /v1/heads/add         {"head_id"} → load from the server's
                              registry (trunk-compat enforced; mismatch
@@ -74,6 +79,9 @@ def _result_payload(kind: str, value, top_k: Optional[int],
         return {"probs": [float(x) for x in value]}
     if kind == "predict_task":
         return {"head_id": head_id, "outputs": value.tolist()}
+    if kind == "neighbors":
+        return {"neighbors": [[i, float(s)]
+                              for i, s in value["neighbors"]]}
     filled, _probs = value
     return {"filled": filled}
 
@@ -163,7 +171,8 @@ def make_handler(server: Server):
             route = {"/v1/embed": "embed",
                      "/v1/predict_go": "predict_go",
                      "/v1/predict_residues": "predict_residues",
-                     "/v1/predict_task": "predict_task"}
+                     "/v1/predict_task": "predict_task",
+                     "/v1/neighbors": "neighbors"}
             kind = route.get(self.path)
             if kind is None:
                 self._reply(404, {"error": f"no such route {self.path}"})
@@ -181,8 +190,14 @@ def make_handler(server: Server):
                         or not isinstance(deadline_ms, (int, float))):
                     raise ValueError("'deadline_ms' must be a number")
                 top_k = body.get("top_k") if kind == "predict_go" else None
-                if top_k is not None and (isinstance(top_k, bool)
-                                          or not isinstance(top_k, int)):
+                if kind == "neighbors":
+                    top_k = body.get("k")
+                    if top_k is not None and (isinstance(top_k, bool)
+                                              or not isinstance(top_k, int)
+                                              or top_k < 1):
+                        raise ValueError("'k' must be a positive integer")
+                elif top_k is not None and (isinstance(top_k, bool)
+                                            or not isinstance(top_k, int)):
                     raise ValueError("'top_k' must be an integer")
                 if kind == "predict_task":
                     head_id = body["head_id"]

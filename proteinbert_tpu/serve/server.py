@@ -52,7 +52,9 @@ window (`serve_latency_p50_s`/`p99_s` at scrape time);
 `serve_requests_total{kind=}`, `serve_rejected_total{reason=}`,
 `serve_truncated_total`, `serve_cache_*_total` counters;
 `serve_latency_seconds`, `serve_queue_wait_seconds`,
-`serve_batch_seconds`, `serve_batch_rows` histograms.
+`serve_batch_seconds`, `serve_batch_rows` histograms. With a neighbor
+index attached (ISSUE 17): `neighbor_query` events (sampled) and the
+`neighbors_requests_total{outcome=}` per-outcome funnel.
 """
 
 from __future__ import annotations
@@ -69,11 +71,12 @@ import numpy as np
 from proteinbert_tpu import inference
 from proteinbert_tpu.configs import PretrainConfig
 from proteinbert_tpu.heads.registry import (
-    HeadRegistry, LoadedHead, UnknownHeadError, trunk_fingerprint,
+    HeadRegistry, LoadedHead, TrunkMismatchError, UnknownHeadError,
+    trunk_fingerprint,
 )
 from proteinbert_tpu.serve.cache import EmbeddingCache, content_key
 from proteinbert_tpu.serve.dispatch import (
-    KINDS, TASK_KIND, BucketDispatcher, RaggedDispatcher,
+    KINDS, NEIGHBORS_KIND, TASK_KIND, BucketDispatcher, RaggedDispatcher,
 )
 from proteinbert_tpu.serve.errors import (
     SequenceTooLongError, ServerClosedError,
@@ -85,6 +88,10 @@ from proteinbert_tpu.serve.scheduler import (
 from proteinbert_tpu.serve.trace import RequestTrace, stride_sampled
 
 SERVE_MODES = ("bucketed", "ragged")
+
+# Default result size for `/v1/neighbors` when the request carries no
+# `k` — matches the recall gate's k (bench.py --neighbors, recall@10).
+DEFAULT_NEIGHBORS_K = 10
 
 
 class Server:
@@ -118,6 +125,8 @@ class Server:
         pack_max_segments: int = 8,
         quant: Optional[str] = None,
         quant_parity_every: Optional[int] = None,
+        index=None,
+        nprobe: int = 8,
     ):
         from proteinbert_tpu.obs import as_telemetry
 
@@ -204,6 +213,25 @@ class Server:
         self._trunk_fp: Optional[str] = None
         for h in (heads or ()):
             self.add_head(h)
+        # Neighbor index (ISSUE 17): an optional scorer.NeighborIndex.
+        # `/v1/neighbors` requests ride the embed executable (dispatch
+        # normalizes the kind — zero new trunk compiles), then probe
+        # this index on the scheduler thread. The index pins the trunk
+        # it was built from; a fingerprint mismatch is the same class
+        # of error as a mis-trunked head, and gets the same typed
+        # refusal before the server can serve garbage neighbors.
+        self.index = index
+        self.nprobe = int(nprobe)
+        if index is not None:
+            if self.nprobe < 1:
+                raise ValueError(f"nprobe must be >= 1, got {nprobe}")
+            fp = self.trunk_fp()
+            if index.model_fingerprint != fp:
+                raise TrunkMismatchError(
+                    "neighbor index was built from embeddings of trunk "
+                    f"{index.model_fingerprint[:12]}…, but this server "
+                    f"holds trunk {fp[:12]}… — rebuild it with "
+                    "`pbt index` over this model's embedding store")
         # The p50/p99 ring lives in the obs registry (QuantileWindow):
         # /metrics scrapes, stats(), and serve_request events all read
         # the same ring. A disabled registry (NULL telemetry) returns a
@@ -252,11 +280,18 @@ class Server:
         self._latency_h = metrics.histogram("serve_latency_seconds")
         self._truncated_c = metrics.counter("serve_truncated_total")
         self._req_c = {k: metrics.counter("serve_requests_total", kind=k)
-                       for k in KINDS + (TASK_KIND,)}
-        from proteinbert_tpu.obs.events import SERVE_REJECT_REASONS
+                       for k in KINDS + (TASK_KIND, NEIGHBORS_KIND)}
+        from proteinbert_tpu.obs.events import (
+            SERVE_REJECT_REASONS, SERVE_REQUEST_OUTCOMES,
+        )
 
         self._rej_c = {r: metrics.counter("serve_rejected_total", reason=r)
                        for r in SERVE_REJECT_REASONS}
+        # Per-outcome `/v1/neighbors` funnel (ISSUE 17): every neighbors
+        # request lands in exactly one bucket via the _seal funnel.
+        self._nbr_c = {o: metrics.counter("neighbors_requests_total",
+                                          outcome=o)
+                       for o in SERVE_REQUEST_OUTCOMES}
         self.completed_total = 0
         self.cache_hit_returns = 0
         # Local mirrors of the labeled counters: stats() must report
@@ -267,6 +302,7 @@ class Server:
         self._mirror_lock = threading.Lock()
         self.truncated_total = 0
         self.rejected_total = {r: 0 for r in self._rej_c}
+        self.neighbors_total = {o: 0 for o in self._nbr_c}
         # Kernel fast-path COVERAGE (ISSUEs 10/13): mirror the
         # kernels/fused_block AND kernels/attention dispatch bumps —
         # both the Pallas fast path and the XLA reference path — into
@@ -334,6 +370,13 @@ class Server:
         if self._started:
             raise RuntimeError("server already started")
         warmed = self.dispatcher.warmup(self._warm_kinds)
+        if self.index is not None:
+            # Warm the one lookup executable every single-request probe
+            # uses — (Q=1, nprobe, k=DEFAULT_NEIGHBORS_K) — so the first
+            # /v1/neighbors request pays lookup time, not compile time.
+            self.index.lookup_rows(
+                np.zeros((1, self.index.dim), np.float32),
+                k=DEFAULT_NEIGHBORS_K, nprobe=self.nprobe)
         self.tele.emit("serve_start", pid=os.getpid(), config={
             "serve_mode": self.serve_mode,
             "buckets": list(self.dispatcher.buckets),
@@ -355,6 +398,9 @@ class Server:
             "warmup": self.dispatcher.warmup_report,
             "quant": self.quant,
             "quant_report": self.dispatcher.quant_report or None,
+            "neighbor_index": (self.index.digest
+                               if self.index is not None else None),
+            "nprobe": self.nprobe if self.index is not None else None,
         })
         self.scheduler.start()
         self._started = True
@@ -457,7 +503,8 @@ class Server:
             # Killed requests close their traces too — an abort must
             # not orphan spans (tests/test_serve_trace.py).
             self._seal(req.trace, "aborted", now, error=exc,
-                       e2e_fallback=max(0.0, now - req.enqueued_at))
+                       e2e_fallback=max(0.0, now - req.enqueued_at),
+                       kind=req.kind)
         n = len(failed)
         if not self._ended:
             self._ended = True
@@ -490,9 +537,14 @@ class Server:
         DeadlineExceededError land on futures (the evicted/expired
         request's, which may be an earlier caller's — never silently
         dropped)."""
-        if kind not in KINDS and kind != TASK_KIND:
+        if kind not in KINDS and kind not in (TASK_KIND, NEIGHBORS_KIND):
             raise ValueError(f"unknown request kind {kind!r}; have "
-                             f"{KINDS + (TASK_KIND,)}")
+                             f"{KINDS + (TASK_KIND, NEIGHBORS_KIND)}")
+        if kind == NEIGHBORS_KIND and self.index is None:
+            raise ValueError(
+                "this server has no neighbor index attached — start it "
+                "with index= (pbt serve --index DIR) to serve "
+                "/v1/neighbors")
         if not seq:
             raise ValueError("empty sequence")
         if (kind == TASK_KIND) != (head_id is not None):
@@ -524,7 +576,8 @@ class Server:
                 self.tele.emit("serve_reject", reason="unknown_head",
                                kind=kind, queue_depth=len(self.queue),
                                head_id=head_id)
-                self._seal(trace, "rejected", self.clock())
+                self._seal(trace, "rejected", self.clock(),
+                           kind=kind)
                 if trace is not None:
                     exc.pbt_request_id = trace.request_id
                 raise
@@ -537,7 +590,8 @@ class Server:
                 self._bump("rejected_total", "too_long")
                 self.tele.emit("serve_reject", reason="too_long",
                                kind=kind, queue_depth=len(self.queue))
-                self._seal(trace, "rejected", self.clock())
+                self._seal(trace, "rejected", self.clock(),
+                           kind=kind)
                 exc = SequenceTooLongError(
                     f"sequence of {len(seq)} residues exceeds the model "
                     f"window of {window}"
@@ -570,16 +624,27 @@ class Server:
             # A head id is content-addressed over its weights + task +
             # trunk, so including it keys cached task results to the
             # exact model that produced them.
-            key = content_key(kind if head is None
-                              else f"{kind}:{head.head_id}",
-                              seq, annotations)
+            if kind == NEIGHBORS_KIND:
+                # Neighbor results depend on the exact index contents
+                # (identity digest), the requested k, and the probe
+                # breadth — all three scope the key, so a rebuilt index
+                # or a different k can never alias a stale answer.
+                scope = (f"{kind}:{self.index.digest[:16]}"
+                         f":k{top_k or DEFAULT_NEIGHBORS_K}"
+                         f":p{self.nprobe}")
+            elif head is None:
+                scope = kind
+            else:
+                scope = f"{kind}:{head.head_id}"
+            key = content_key(scope, seq, annotations)
             hit = self.cache.get(key)
             if hit is not None:
                 self._bump("cache_hit_returns")
                 if trace is not None:
                     trace.cache = "hit"
                 future.set_result(self._present(kind, hit, top_k))
-                self._seal(trace, "cache_hit", self.clock())
+                self._seal(trace, "cache_hit", self.clock(),
+                           kind=kind)
                 return future
         bucket_len = self.dispatcher.bucket_len(len(seq))
         tokens = inference._tokenize_masked(
@@ -601,7 +666,7 @@ class Server:
             self._bump("rejected_total", "closed")
             self.tele.emit("serve_reject", reason="closed", kind=kind,
                            queue_depth=len(self.queue))
-            self._seal(trace, "rejected", self.clock())
+            self._seal(trace, "rejected", self.clock(), kind=kind)
             if trace is not None:
                 exc.pbt_request_id = trace.request_id
             raise
@@ -614,7 +679,8 @@ class Server:
                                kind=old.kind,
                                queue_depth=self.queue.max_depth)
                 self._seal(old.trace, "evicted", now2,
-                           e2e_fallback=max(0.0, now2 - old.enqueued_at))
+                           e2e_fallback=max(0.0, now2 - old.enqueued_at),
+                           kind=old.kind)
         self._depth_g.set(len(self.queue))
         return future
 
@@ -641,6 +707,17 @@ class Server:
         """(filled_seq, probs (bucket_len, V)) — '?' positions filled
         with the argmax amino acid, like inference.predict_residues."""
         return self.submit("predict_residues", seq,
+                           deadline_s=deadline_s).result(timeout)
+
+    def neighbors(self, seq: str, k: Optional[int] = None,
+                  timeout: Optional[float] = None,
+                  deadline_s: Optional[float] = None):
+        """{"neighbors": [(corpus_id, cosine_score), ...]} best-first
+        for one query sequence: the sequence embeds through the trunk
+        (riding whatever micro-batch is forming), then its global
+        vector probes the attached int8 IVF index. Requires a server
+        started with `index=`."""
+        return self.submit(NEIGHBORS_KIND, seq, top_k=k,
                            deadline_s=deadline_s).result(timeout)
 
     def predict_task(self, head_id: str, seq: str, annotations=None,
@@ -672,7 +749,28 @@ class Server:
     def _finalize(self, req: Request, row) -> None:
         """Scheduler callback: one request's raw model row → its result
         (+ cache insert). Runs on the scheduler thread."""
-        if req.kind == "embed":
+        if req.kind == NEIGHBORS_KIND:
+            # The embed leg already ran (dispatch served this request
+            # as an embed row); the lookup leg probes the resident
+            # index here, on the scheduler thread, and is timed into
+            # its own `lookup` trace stage.
+            g = np.asarray(row["global"])
+            k = req.top_k if req.top_k else DEFAULT_NEIGHBORS_K
+            t0 = self.clock()
+            pairs = self.index.lookup_one(g, k=k, nprobe=self.nprobe)
+            t1 = self.clock()
+            if req.trace is not None:
+                req.trace.mark_lookup(t1)
+            if req.trace is not None and req.trace.sampled:
+                self.tele.emit(
+                    "neighbor_query", k=int(k), nprobe=self.nprobe,
+                    candidates=min(
+                        self.index.num_vectors,
+                        self.nprobe * int(self.index.members.shape[1])),
+                    lookup_s=round(max(0.0, t1 - t0), 9),
+                    outcome="ok", request_id=req.trace.request_id)
+            value = {"neighbors": pairs}
+        elif req.kind == "embed":
             value = {"global": np.asarray(row["global"]),
                      "local_mean": np.asarray(row["local_mean"])}
         elif req.kind in ("predict_go", TASK_KIND):
@@ -710,18 +808,24 @@ class Server:
         """Scheduler callback per terminal request (ok/error/expired):
         seal the trace, emit, feed the SLO evaluator."""
         self._seal(req.trace, outcome, now, error=error,
-                   e2e_fallback=max(0.0, now - req.enqueued_at))
+                   e2e_fallback=max(0.0, now - req.enqueued_at),
+                   kind=req.kind)
 
     def _seal(self, trace: Optional[RequestTrace], outcome: str,
               now: float, error: Optional[BaseException] = None,
-              e2e_fallback: float = 0.0) -> None:
+              e2e_fallback: float = 0.0,
+              kind: Optional[str] = None) -> None:
         """The single terminal funnel: every request reaches this
         exactly once per outcome path. Emits the serve_request event +
         spans for sampled or failed requests; feeds every completion
-        (traced or not) to the SLO evaluator."""
+        (traced or not) to the SLO evaluator. `kind` lets untraced
+        requests still feed the per-kind outcome funnels (neighbors);
+        traced requests fall back to the trace's own kind."""
         stages = None
         e2e = e2e_fallback
         rid = None
+        if kind is None and trace is not None:
+            kind = trace.kind
         if trace is not None:
             if not trace.finish(outcome, now, error):
                 return  # already sealed by an earlier outcome path
@@ -738,6 +842,13 @@ class Server:
                                **trace.event_fields(stages=stages))
                 if self.tele.spans is not None:
                     trace.export_spans(self.tele.spans)
+        if kind == NEIGHBORS_KIND:
+            c = self._nbr_c.get(outcome)
+            if c is not None:
+                c.inc()
+            with self._mirror_lock:
+                self.neighbors_total[outcome] = \
+                    self.neighbors_total.get(outcome, 0) + 1
         if self.slo:
             if stages is not None and trace.pad_fraction \
                     and "execute" in stages:
@@ -758,6 +869,7 @@ class Server:
                 "truncated": self.truncated_total,
                 "rejected": dict(self.rejected_total),
             }
+            neighbors_by_outcome = dict(self.neighbors_total)
         from proteinbert_tpu.kernels.attention import ATTN_PATH_TOTAL
         from proteinbert_tpu.kernels.fused_block import PATH_TOTAL
         from proteinbert_tpu.kernels.one_pass import ONEPASS_PATH_TOTAL
@@ -816,6 +928,17 @@ class Server:
                 "max_s": (round(qw.max, 6) if qw.count else None),
             },
         }
+        # Neighbor-index arm (ISSUE 17): which index serves, its size,
+        # and how many distinct lookup shapes have compiled — the
+        # "one warm executable per (nprobe, k)" evidence.
+        out["neighbors"] = (None if self.index is None else {
+            "index_digest": self.index.digest,
+            "corpus_digest": self.index.corpus_digest,
+            "num_vectors": self.index.num_vectors,
+            "nprobe": self.nprobe,
+            "lookup_executables": self.index.executables(),
+            "by_outcome": neighbors_by_outcome,
+        })
         if self.slo:
             out["slo"] = self.slo.status()
         return out

@@ -19,7 +19,15 @@ Stage names, in request order:
 | `batch_form` | ingest → popped into a batch           | waiting for max_batch / max_wait |
 | `dispatch`   | popped → model call                    | stacking, padding, device_put (+compile on a cold shape) |
 | `execute`    | model call → outputs on host           | device execute + host fetch |
+| `lookup`     | outputs on host → ANN answer           | neighbor-index probe (ISSUE 17; `neighbors` requests only) |
 | `finalize`   | outputs on host → future resolved      | cache insert, result shaping |
+
+The `lookup` stage exists only on `/v1/neighbors` requests (the
+embed-leg stages before it are unchanged); when present it is inserted
+between `execute` and `finalize`, so the stage set still tiles the
+end-to-end interval by construction — `pbt diagnose --serve` splits
+neighbor latency into embed leg (everything before `lookup`) and
+lookup leg on exactly that property.
 
 A request that exits early (cache hit, eviction, rejection, abort)
 simply has fewer marks; its last present stage absorbs the remainder.
@@ -46,7 +54,7 @@ from typing import Any, Dict, List, Optional, Tuple
 _MIN_SPAN_S = 1e-7
 
 STAGES = ("submit", "queue", "batch_form", "dispatch", "execute",
-          "finalize")
+          "lookup", "finalize")
 
 
 def stride_sampled(seq: int, rate: float) -> bool:
@@ -65,7 +73,7 @@ class RequestTrace:
     __slots__ = (
         "request_id", "kind", "sampled", "wall0",
         "t_submit", "t_enqueued", "t_ingested", "t_popped",
-        "t_run0", "t_run1", "t_done",
+        "t_run0", "t_run1", "t_lookup", "t_done",
         "bucket_len", "batch_class", "rows", "pad_fraction",
         "prep_s", "device_s", "cache", "outcome", "error", "head_id",
         "segments", "segments_per_row", "mode", "quant",
@@ -85,6 +93,7 @@ class RequestTrace:
         self.t_popped: Optional[float] = None
         self.t_run0: Optional[float] = None
         self.t_run1: Optional[float] = None
+        self.t_lookup: Optional[float] = None
         self.t_done: Optional[float] = None
         self.bucket_len: Optional[int] = None
         self.batch_class: Optional[int] = None
@@ -123,6 +132,13 @@ class RequestTrace:
     def mark_run(self, t0: float, t1: float) -> None:
         self.t_run0 = t0
         self.t_run1 = t1
+
+    def mark_lookup(self, now: float) -> None:
+        """End of the neighbor-index probe (ISSUE 17). Setting it
+        splits the interval after `execute` into `lookup` (ANN) and
+        `finalize` (cache insert / result shaping); without it the
+        stage set is unchanged."""
+        self.t_lookup = now
 
     def mark_batch(self, bucket_len: int, batch_class: int, rows: int,
                    pad_fraction: Optional[float] = None,
@@ -178,8 +194,14 @@ class RequestTrace:
         by the thread-interleave gap."""
         marks = [("submit", self.t_submit), ("queue", self.t_enqueued),
                  ("batch_form", self.t_ingested),
-                 ("dispatch", self.t_popped), ("execute", self.t_run0),
-                 ("finalize", self.t_run1)]
+                 ("dispatch", self.t_popped), ("execute", self.t_run0)]
+        if self.t_lookup is not None:
+            # Neighbor request: the interval after the device run
+            # splits into the ANN probe and the true finalize tail.
+            marks += [("lookup", self.t_run1),
+                      ("finalize", self.t_lookup)]
+        else:
+            marks += [("finalize", self.t_run1)]
         present: List[Tuple[str, float]] = []
         prev = None
         for name, t in marks:

@@ -690,3 +690,55 @@ class TestRaggedDispatcherContracts:
         with pytest.raises(ValueError, match="batch_classes"):
             Server(params, cfg, serve_mode="ragged",
                    batch_classes=(2, 4))
+
+
+class TestNeighborsRideOnePass:
+    """ISSUE 17: the embed leg of a /v1/neighbors request is not a new
+    code path — it is the SAME packed one-pass executable the ragged
+    trunk serves embeds with. Proven the same way as the fast-path
+    smoke above: by counter delta, on a Pallas-supported shape."""
+
+    def test_neighbors_query_takes_pallas_onepass_path(self, tmp_path):
+        from proteinbert_tpu.heads import trunk_fingerprint
+        from proteinbert_tpu.index import build_index
+        from proteinbert_tpu.index.scorer import NeighborIndex
+        from proteinbert_tpu.kernels import one_pass as op
+        from tests.test_index import make_store
+
+        pcfg = PretrainConfig(
+            model=ModelConfig(local_dim=128, global_dim=32, key_dim=8,
+                              num_heads=2, num_blocks=1,
+                              num_annotations=32, dtype="float32",
+                              use_pallas=True),
+            data=DataConfig(seq_len=SEQ_LEN, batch_size=2,
+                            buckets=BUCKETS),
+            optimizer=OptimizerConfig(warmup_steps=5),
+            train=TrainConfig(seed=0, max_steps=1),
+            checkpoint=CheckpointConfig(),
+        )
+        assert op.pallas_onepass_supported(128, 32, SEQ_LEN, 4, 8, 2,
+                                           "float32")
+        params = create_train_state(jax.random.PRNGKey(0), pcfg).params
+        store = str(tmp_path / "store")
+        make_store(store, n=32, dim=pcfg.model.global_dim,
+                   fingerprint=trunk_fingerprint(params))
+        index_dir = str(tmp_path / "index")
+        build_index(store, index_dir, num_centroids=4, block_size=8,
+                    kmeans_iters=4)
+        index = NeighborIndex.load(index_dir)
+
+        srv = Server(params, pcfg, max_batch=4, max_wait_s=60.0,
+                     cache_size=0, warm_kinds=(), serve_mode="ragged",
+                     index=index, nprobe=4)
+        before = dict(op.ONEPASS_PATH_TOTAL)
+        fut = srv.submit("neighbors", "MKTAYIAKQRQISFVK", top_k=3)
+        got = _drain_poll(srv, [fut])[0]
+        delta = {k: op.ONEPASS_PATH_TOTAL.get(k, 0) - before.get(k, 0)
+                 for k in op.ONEPASS_PATH_TOTAL}
+        assert delta.get(("pallas", "packed"), 0) >= 1
+        assert delta.get(("reference", "segments"), 0) == 0
+        assert len(got["neighbors"]) == 3
+        # The lookup leg rides the trunk's packed executable — it must
+        # not have compiled a second trunk program.
+        assert srv.stats()["executables"] == 1
+        srv.drain(timeout=10)

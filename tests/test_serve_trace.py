@@ -91,7 +91,9 @@ class TestRequestTrace:
                       prep_s=0.4, device_s=0.5)
         assert tr.finish("ok", now=11.7)
         stages = tr.stages()
-        assert list(stages) == list(STAGES)
+        # `lookup` exists only on neighbors requests (ISSUE 17) — a
+        # plain embed tiles the remaining stages exactly.
+        assert list(stages) == [s for s in STAGES if s != "lookup"]
         assert stages["submit"] == pytest.approx(0.1)
         assert stages["queue"] == pytest.approx(0.2)
         assert stages["batch_form"] == pytest.approx(0.3)
@@ -101,6 +103,21 @@ class TestRequestTrace:
         # The acceptance property: contiguous intervals sum to e2e.
         assert sum(stages.values()) == pytest.approx(tr.e2e_s(), abs=1e-9)
         assert tr.e2e_s() == pytest.approx(1.7)
+
+    def test_lookup_mark_splits_tail_and_still_tiles(self):
+        tr = RequestTrace("r1n", "neighbors", now=10.0, wall=0.0)
+        tr.mark_enqueued(10.1)
+        tr.mark_ingested(10.3)
+        tr.mark_popped(10.6)
+        tr.mark_run(11.0, 11.5)
+        tr.mark_lookup(11.62)
+        assert tr.finish("ok", now=11.7)
+        stages = tr.stages()
+        assert list(stages) == list(STAGES)
+        assert stages["execute"] == pytest.approx(0.5)
+        assert stages["lookup"] == pytest.approx(0.12)
+        assert stages["finalize"] == pytest.approx(0.08)
+        assert sum(stages.values()) == pytest.approx(tr.e2e_s(), abs=1e-9)
 
     def test_early_exit_has_fewer_marks_still_tiles(self):
         tr = RequestTrace("r2", "embed", now=5.0, wall=0.0)

@@ -203,6 +203,14 @@ _EVENT_METRICS = (
     # artifact via --check-json) — suppression creep moves this series
     # even while the gate stays green. LOWER is better.
     ("check_capture", "check_findings_total", "check_findings_total"),
+    # ANN serving (ISSUE 17, bench --neighbors): sustained int8-index
+    # lookup QPS and recall@10 vs exact brute force — throughput AND
+    # answer-quality regressions gate through the same sentinel (a
+    # recall drop means quantization/probing broke what the index
+    # answers, even if it got faster doing it).
+    ("neighbors_capture", "neighbors_qps", "neighbors_qps"),
+    ("neighbors_capture", "neighbors_recall_at_10",
+     "neighbors_recall_at_10"),
 )
 
 # Series (by base name, before the /platform suffix) where a LOWER

@@ -1,0 +1,346 @@
+#!/usr/bin/env python
+"""Index chaos drill (ISSUE 17 satellite): prove `pbt index` builds are
+kill-anywhere resumable and `--verify` catches corruption.
+
+A synthetic embedding store (hand-written through the mapper's own
+commit_block protocol — no model, no jax in the writer) is indexed
+twice through REAL `pbt index` subprocesses:
+
+- the CHAOS line: run 1 is SIGKILLed deterministically in the worst
+  window (between an index block's object write and its cursor advance
+  — the PBT_INDEX_FAULTS crash hook at the exact seam the map drill
+  exercises); run 2 resumes and must complete;
+- the CONTROL line: one uninterrupted build over the same store into a
+  fresh index directory.
+
+Gates (exit nonzero on violation — tier-1 runs this as a smoke stage):
+  - the resumed chaos index is BYTE-IDENTICAL to the control index
+    (same {centroids, (shard, block)} → digest map via index_digests,
+    same object bytes, same index_identity);
+  - re-work is bounded: the resumed build reports at most ONE re-worked
+    block per shard;
+  - `pbt index --verify` (the real CLI) exits 0 on the intact chaos
+    index, DETECTS a deliberately flipped byte in a vector block
+    (typed digest_mismatch, nonzero exit), reports a deleted object as
+    a hole, and verifies clean again after restoration;
+  - rebuilding against a DIFFERENT store (stale corpus/model pins) is a
+    typed refusal before any write — the chaos index is unchanged;
+  - every emitted event validates against the schema (strict reader),
+    and the chaos line seals index_build/completed exactly once.
+
+Usage:
+  python tools/index_drill.py [--outdir DIR] [--json] [--seed N]
+      [--vectors N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(1, os.path.dirname(os.path.abspath(__file__)))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+NUM_SHARDS = 2
+STORE_BLOCK = 8        # store geometry (what `pbt map` would have cut)
+INDEX_BLOCK = 8        # index geometry: >= 2 blocks/shard at defaults
+DIM = 16
+CENTROIDS = 4
+CRASH = (0, 1, "after_object")  # shard 0 block 1, object durable,
+#                                 cursor NOT advanced — the worst window
+
+
+def make_store(store_dir: str, n: int, seed: int) -> None:
+    """A complete, verified embedding store written through the REAL
+    durability protocol (ensure_manifest + commit_block + done markers)
+    with synthetic vectors — the builder's input contract without a
+    trunk forward (jax-free, seconds not minutes)."""
+    import numpy as np
+
+    from proteinbert_tpu.mapper.store import (
+        EmbeddingStore, ShardCursor, block_digest, commit_block,
+        corpus_digest, serialize_block, shard_ranges,
+    )
+
+    rng = np.random.default_rng(seed)
+    ids = [f"syn{i:05d}" for i in range(n)]
+    seqs = ["A" * (10 + i % 7) for i in range(n)]  # identity only
+    # Clustered vectors (not isotropic noise) so the IVF shortlist is a
+    # meaningful structure, same shape the trunk would emit.
+    anchors = rng.standard_normal((CENTROIDS, DIM)).astype(np.float32)
+    vecs = (anchors[rng.integers(0, CENTROIDS, size=n)]
+            + 0.15 * rng.standard_normal((n, DIM))).astype(np.float32)
+
+    store = EmbeddingStore(store_dir)
+    fingerprint = "deadbeef" * 8  # a pinned trunk identity, not a model
+    store.ensure_manifest({
+        "kind": "embedding_store",
+        "corpus_n": n,
+        "corpus_digest": corpus_digest(ids, seqs),
+        "model_fingerprint": fingerprint,
+        "num_shards": NUM_SHARDS,
+        "block_size": STORE_BLOCK,
+        "rows_per_batch": 2,
+        "max_segments": 4,
+        "seq_len": 48,
+        "buckets": [16, 32, 48],
+    })
+    for shard, (lo, hi) in enumerate(shard_ranges(n, NUM_SHARDS)):
+        cursor = ShardCursor(store_dir, shard)
+        state = cursor.write_state(cursor.fresh_state())
+        for start in range(0, hi - lo, STORE_BLOCK):
+            end = min(start + STORE_BLOCK, hi - lo)
+            rows = slice(lo + start, lo + end)
+            arrays = {
+                "ids": np.array(ids[rows], dtype="S"),
+                "lengths": np.array([len(s) for s in seqs[rows]],
+                                    np.int32),
+                "global": vecs[rows],
+                "local_mean": np.zeros((end - start, DIM), np.float32),
+            }
+            meta = {"shard": shard, "block": start // STORE_BLOCK,
+                    "start": start, "end": end,
+                    "model_fingerprint": fingerprint}
+            payload = serialize_block(meta, arrays)
+            entry = {"block": start // STORE_BLOCK,
+                     "digest": block_digest(payload), "start": start,
+                     "end": end, "n": end - start, "quarantined": []}
+            state = commit_block(store, cursor, state, payload, entry)
+        cursor.write_state(dict(state, done=True))
+
+
+def _index_cmd(store: str, index: str, events: str):
+    return [sys.executable, "-m", "proteinbert_tpu", "--platform", "cpu",
+            "index", "--store", store, "--index", index,
+            "--centroids", str(CENTROIDS),
+            "--block-size", str(INDEX_BLOCK), "--json",
+            "--events-jsonl", events]
+
+
+def _run(cmd, env_extra=None, log_path=None, timeout=300):
+    env = dict(os.environ)
+    env.update(env_extra or {})
+    with open(log_path, "ab") as lf:
+        lf.write((" ".join(cmd) + "\n").encode())
+        proc = subprocess.run(cmd, stdout=subprocess.PIPE, stderr=lf,
+                              env=env, timeout=timeout)
+    return proc.returncode, proc.stdout.decode()
+
+
+def run_drill(args) -> dict:
+    from faults import flip_byte, map_fault_spec
+    from proteinbert_tpu.index import (
+        INDEX_FAULT_ENV, index_digests, index_identity, verify_index,
+    )
+    from proteinbert_tpu.mapper import EmbeddingStore, verify_store
+    from proteinbert_tpu.obs import read_events
+
+    outdir = args.outdir or tempfile.mkdtemp(prefix="pbt_index_drill_")
+    os.makedirs(outdir, exist_ok=True)
+    log_path = os.path.join(outdir, "drill.log")
+    store_dir = os.path.join(outdir, "store")
+    chaos_index = os.path.join(outdir, "chaos_index")
+    control_index = os.path.join(outdir, "control_index")
+    ev1 = os.path.join(outdir, "chaos_run1.events.jsonl")
+    ev2 = os.path.join(outdir, "chaos_run2.events.jsonl")
+    evc = os.path.join(outdir, "control.events.jsonl")
+    failures = []
+    t0 = time.monotonic()
+
+    make_store(store_dir, args.vectors, args.seed)
+    srep = verify_store(store_dir)
+    if not (srep["ok"] and srep["complete"]):
+        failures.append(f"synthetic store failed verify_store: {srep}")
+
+    # ---- chaos run 1: SIGKILL between block 1's object write and its
+    # cursor advance on shard 0 (block 0 of each shard already durable).
+    rc1 = out1 = None
+    if not failures:
+        rc1, out1 = _run(
+            _index_cmd(store_dir, chaos_index, ev1),
+            env_extra={INDEX_FAULT_ENV: map_fault_spec(crash=CRASH)},
+            log_path=log_path)
+        if rc1 not in (-9, 137):
+            failures.append(f"chaos run 1 exited {rc1}, expected a "
+                            "SIGKILL death (-9/137) — the crash hook "
+                            "never fired; see " + log_path)
+
+    # ---- chaos run 2: resume, must complete with bounded re-work.
+    stats2 = {}
+    if not failures:
+        rc2, out2 = _run(_index_cmd(store_dir, chaos_index, ev2),
+                         log_path=log_path)
+        if rc2 != 0:
+            failures.append(f"chaos run 2 (resume) exited {rc2}; see "
+                            f"{log_path}")
+        else:
+            stats2 = next(json.loads(ln) for ln in out2.splitlines()
+                          if ln.startswith("{"))
+            if stats2["outcome"] != "completed":
+                failures.append(f"resume outcome {stats2['outcome']!r}")
+            if stats2["reworked_blocks"] > NUM_SHARDS:
+                failures.append(
+                    f"resume re-worked {stats2['reworked_blocks']} "
+                    f"block(s) > bound of 1 per shard ({NUM_SHARDS})")
+
+    # ---- control: one uninterrupted build.
+    if not failures:
+        rcc, _outc = _run(_index_cmd(store_dir, control_index, evc),
+                          log_path=log_path)
+        if rcc != 0:
+            failures.append(f"control build exited {rcc}; see {log_path}")
+
+    rework = stats2.get("reworked_blocks")
+    if not failures:
+        # ---- byte identity: digest maps, object bytes, identity key.
+        dg_chaos = index_digests(chaos_index)
+        dg_control = index_digests(control_index)
+        if dg_chaos != dg_control:
+            failures.append(
+                f"indexes differ: chaos {sorted(dg_chaos.items())} vs "
+                f"control {sorted(dg_control.items())}")
+        else:
+            cst = EmbeddingStore(chaos_index)
+            kst = EmbeddingStore(control_index)
+            for dg in dg_chaos.values():
+                with open(cst.object_path(dg), "rb") as a, \
+                        open(kst.object_path(dg), "rb") as b:
+                    if a.read() != b.read():
+                        failures.append(f"object {dg[:16]}… bytes "
+                                        "differ between indexes")
+        if index_identity(chaos_index) != index_identity(control_index):
+            failures.append("index_identity (the cache-scoping key) "
+                            "differs between chaos and control")
+
+        # ---- events: schema-valid, chaos line seals completed once.
+        recs = []
+        for p in (ev1, ev2, evc):
+            recs.append(read_events(p, strict=True))
+        sealed = [r for r in recs[1] if r["event"] == "index_build"
+                  and r["state"] == "completed"]
+        if len(sealed) != 1:
+            failures.append(f"chaos resume sealed {len(sealed)} "
+                            "index_build/completed record(s), expected "
+                            "exactly 1")
+        if not any(r["event"] == "index_shard" and r["state"] == "resume"
+                   for r in recs[1]):
+            failures.append("chaos resume emitted no "
+                            "index_shard/resume record")
+
+        # ---- the --verify detection gates, through the REAL CLI ----
+        import contextlib
+        import io
+
+        from proteinbert_tpu.cli.main import main as cli_main
+
+        def cli_verify():
+            with contextlib.redirect_stdout(io.StringIO()):
+                try:
+                    return cli_main(["index", "--index", chaos_index,
+                                     "--verify"])
+                except SystemExit as e:
+                    return int(e.code or 0)
+
+        if cli_verify() != 0:
+            failures.append("pbt index --verify failed on the intact "
+                            "chaos index")
+        victim = sorted(v for k, v in dg_chaos.items()
+                        if k != "centroids")[0]
+        vpath = EmbeddingStore(chaos_index).object_path(victim)
+        backup = vpath + ".backup"
+        shutil.copyfile(vpath, backup)
+        flip_byte(vpath)
+        if cli_verify() == 0:
+            failures.append("pbt index --verify MISSED a flipped byte")
+        else:
+            rep = verify_index(chaos_index)
+            if not any(c.get("reason") == "digest_mismatch"
+                       for c in rep["corrupt"]):
+                failures.append("flipped byte not typed digest_mismatch:"
+                                f" {rep['corrupt']}")
+        os.replace(backup, vpath)
+        shutil.copyfile(vpath, backup)
+        os.remove(vpath)
+        if cli_verify() == 0:
+            failures.append("pbt index --verify MISSED a deleted block")
+        else:
+            rep = verify_index(chaos_index)
+            if not any(h["digest"] == victim for h in rep["holes"]):
+                failures.append(f"deleted block not reported as a hole: "
+                                f"{rep['holes']}")
+        os.replace(backup, vpath)
+        if cli_verify() != 0:
+            failures.append("chaos index did not verify clean after "
+                            "restoring the mauled object")
+
+        # ---- stale-pin refusal: a DIFFERENT store (new corpus/model)
+        # must be a typed refusal BEFORE any write to the chaos index.
+        other_store = os.path.join(outdir, "other_store")
+        make_store(other_store, args.vectors, args.seed + 1)
+        before = index_digests(chaos_index)
+        rcs, _ = _run(_index_cmd(other_store, chaos_index,
+                                 os.path.join(outdir, "stale.events.jsonl")),
+                      log_path=log_path)
+        if rcs == 0:
+            failures.append("rebuilding the chaos index against a "
+                            "different store succeeded — the manifest "
+                            "pin did not refuse")
+        if index_digests(chaos_index) != before:
+            failures.append("the refused rebuild MUTATED the chaos "
+                            "index — refusal must precede any write")
+
+    summary = {
+        "vectors": args.vectors,
+        "shards": NUM_SHARDS,
+        "index_blocks": stats2.get("blocks"),
+        "rework_blocks": rework,
+        "bytes_ratio": stats2.get("bytes_ratio"),
+        "wall_s": round(time.monotonic() - t0, 1),
+        "outdir": outdir,
+        "failures": failures,
+        "ok": not failures,
+    }
+    return summary
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--vectors", type=int, default=40,
+                    help="synthetic corpus size (2 shards x >= 2 index "
+                         "blocks at the default geometry)")
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--outdir", help="artifact dir (default: temp)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the summary as one JSON object only")
+    args = ap.parse_args(argv)
+    if args.vectors < NUM_SHARDS * 2 * INDEX_BLOCK:
+        ap.error(f"--vectors must give every shard >= 2 index blocks "
+                 f"(>= {NUM_SHARDS * 2 * INDEX_BLOCK})")
+    summary = run_drill(args)
+    if args.json:
+        print(json.dumps(summary))
+    else:
+        print(json.dumps(summary, indent=2))
+    if not summary["ok"]:
+        print("INDEX DRILL FAILED:", "; ".join(summary["failures"]),
+              file=sys.stderr)
+        return 1
+    print(f"index drill OK: SIGKILL between object write and cursor "
+          f"advance → byte-identical resume, "
+          f"{summary['rework_blocks']} re-worked block(s) "
+          f"(bound {NUM_SHARDS}), --verify catches flip/hole, stale "
+          f"store pin refused ({summary['wall_s']}s)", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -184,6 +184,21 @@ timeout -k 10 480 python "$(dirname "$0")/map_drill.py" --json
 rcmd=$?
 [ "$rc" -eq 0 ] && rc=$rcmd
 
+# Index drill smoke (ISSUE 17): kill-anywhere ANN index construction
+# through real `pbt index` subprocesses over a synthetic store —
+# SIGKILL between an index block's object write and its cursor advance,
+# then resume. GATED: the resumed index is byte-identical to an
+# uninterrupted control (digests + object bytes + index_identity),
+# re-work <= 1 block per shard, `pbt index --verify` detects a flipped
+# byte (typed digest_mismatch) and a deleted object (hole), a rebuild
+# against a different store is a typed refusal BEFORE any write, all
+# events schema-valid. Store is hand-written through commit_block (no
+# model forward) — seconds, not minutes.
+echo "=== index drill smoke (SIGKILL mid-build, resume, verify) ==="
+timeout -k 10 300 python "$(dirname "$0")/index_drill.py" --json
+rcid=$?
+[ "$rc" -eq 0 ] && rc=$rcid
+
 # Quant smoke (ISSUE 12): tiny int8 ZeRO-1 steps on the 4x2 CPU-virtual
 # mesh vs the replicated fp32 reference + the quantized serve arm.
 # GATED: step-1 loss identity, param deviation within the documented

@@ -224,6 +224,44 @@ NEGATIVE_CASES = [
          "source": "pbt_check", "kind": "check_capture",
          "check_findings_total": 2,
          "check_baselined_total": 1.5},  # typed when present
+        # the ANN index + /v1/neighbors subsystem (ISSUE 17): build
+        # lifecycle, shard durability, and served-lookup rows are
+        # typed — the index drill audits streams with this validator.
+        {"v": 1, "event": "index_build", "seq": 0, "t": 0.0,
+         "state": "running", "stats": {}},  # unknown build state
+        {"v": 1, "event": "index_build", "seq": 0, "t": 0.0,
+         "state": "completed"},  # missing stats
+        {"v": 1, "event": "index_shard", "seq": 0, "t": 0.0,
+         "shard": 0, "state": "crawling"},  # unknown shard state
+        {"v": 1, "event": "index_shard", "seq": 0, "t": 0.0,
+         "shard": -1, "state": "start"},  # shard must be >= 0
+        {"v": 1, "event": "index_shard", "seq": 0, "t": 0.0,
+         "shard": 0, "state": "resume",
+         "tail_reworked": -1},  # rework count must be >= 0
+        {"v": 1, "event": "neighbor_query", "seq": 0, "t": 0.0,
+         "k": 0, "nprobe": 8},  # k must be >= 1
+        {"v": 1, "event": "neighbor_query", "seq": 0, "t": 0.0,
+         "k": 10, "nprobe": 8,
+         "lookup_s": -0.001},  # lookup leg must be >= 0
+        {"v": 1, "event": "neighbor_query", "seq": 0, "t": 0.0,
+         "k": 10, "nprobe": 8,
+         "outcome": "vanished"},  # not a request outcome
+        # the neighbors_capture note (bench --neighbors): QPS + recall
+        # feed trajectory-sentinel series, typed + required.
+        {"v": 1, "event": "note", "seq": 0, "t": 0.0,
+         "source": "bench", "kind": "neighbors_capture"},  # no fields
+        {"v": 1, "event": "note", "seq": 0, "t": 0.0,
+         "source": "bench", "kind": "neighbors_capture",
+         "neighbors_qps": 0.0,
+         "neighbors_recall_at_10": 0.97},  # qps must be > 0
+        {"v": 1, "event": "note", "seq": 0, "t": 0.0,
+         "source": "bench", "kind": "neighbors_capture",
+         "neighbors_qps": 5000.0,
+         "neighbors_recall_at_10": 1.2},  # recall in [0, 1]
+        {"v": 1, "event": "note", "seq": 0, "t": 0.0,
+         "source": "bench", "kind": "neighbors_capture",
+         "neighbors_qps": 5000.0, "neighbors_recall_at_10": 0.97,
+         "index_bytes_ratio": -0.3},  # typed when present
 ]
 
 

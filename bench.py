@@ -1808,6 +1808,153 @@ def _mirror_quant_note(record):
         print(f"bench events stream unavailable: {e}", file=sys.stderr)
 
 
+def _serve_fleet_ab(Server, params, cfg, seqs, max_batch, max_wait_s,
+                    n_clients, failures):
+    """Phase 6 (ISSUE 18): trace-propagation overhead across a real
+    two-replica fleet — the SAME request population routed through two
+    identically configured routers over the SAME two HTTP replicas,
+    one with `propagate_trace=True` (X-PBT-Trace header + one
+    fleet_attempt record per try) and one with it off. Measured rounds
+    INTERLEAVE arm-by-arm (matched pairs, like the phase-2c tracing
+    A/B) and the per-arm MEDIAN is compared.
+
+    GATED (invariants, not wall-clock): every request on both arms
+    returns 200 through the router with an X-PBT-Request-Id header,
+    and a replica answers a directly injected X-PBT-Trace id back as
+    its X-PBT-Request-Id — the end-to-end join. REPORTED:
+    `fleet_trace_overhead_pct` (on-vs-off throughput delta, the
+    lower-is-better sentinel series — the PR 6 <1% per-request gate in
+    phase 2c prices the stamping itself deterministically)."""
+    import threading
+    import urllib.request
+
+    from proteinbert_tpu.obs import Telemetry
+    from proteinbert_tpu.serve.fleet import FleetRouter
+    from proteinbert_tpu.serve.http import make_http_server
+
+    rounds = int(os.environ.get("PBT_SERVE_BENCH_FLEET_ROUNDS", 3))
+    bodies = [json.dumps({"seq": s}).encode() for s in seqs]
+
+    replicas, httpds, urls = [], [], []
+    for i in range(2):
+        srv = Server(params, cfg, max_batch=max_batch,
+                     max_wait_s=max_wait_s, queue_depth=4 * len(seqs),
+                     cache_size=0, warm_kinds=("embed",),
+                     telemetry=Telemetry(), trace_sample_rate=0.0,
+                     replica_id=f"r{i}")
+        srv.start()  # shares the process-wide jit cache — cheap
+        httpd = make_http_server(srv, port=0)
+        threading.Thread(target=httpd.serve_forever,
+                         daemon=True).start()
+        replicas.append(srv)
+        httpds.append(httpd)
+        urls.append(f"http://127.0.0.1:{httpd.server_address[1]}")
+
+    # The end-to-end join, checked directly at one replica: an
+    # injected fleet id must come back as X-PBT-Request-Id.
+    probe = urllib.request.Request(
+        urls[0] + "/v1/embed", data=bodies[0],
+        headers={"Content-Type": "application/json",
+                 "X-PBT-Trace": "bench-fleet-probe"})
+    with urllib.request.urlopen(probe, timeout=60) as resp:
+        echoed = resp.headers.get("X-PBT-Request-Id")
+        resp.read()
+    if echoed != "bench-fleet-probe":
+        failures.append(
+            f"fleet A/B: replica answered X-PBT-Request-Id {echoed!r} "
+            "for an injected X-PBT-Trace 'bench-fleet-probe' — the "
+            "propagated join is broken")
+
+    arms = []
+    for arm, propagate in (("on", True), ("off", False)):
+        router = FleetRouter(
+            [(f"r{i}", urls[i]) for i in range(2)],
+            telemetry=Telemetry(), health_interval_s=0.0,
+            max_retries=1, cache_size=0, request_timeout_s=120.0,
+            propagate_trace=propagate).start()
+        arms.append((arm, router))
+
+    def run_round(router) -> float:
+        results = {}
+
+        def client(worker: int) -> None:
+            for i in range(worker, len(seqs), n_clients):
+                try:
+                    status, _body, hdrs = router.route("/v1/embed",
+                                                       bodies[i])
+                    results[i] = (status, hdrs.get("X-PBT-Request-Id"))
+                except Exception as e:  # noqa: BLE001 — report, not hang
+                    failures.append(f"fleet A/B request {i}: "
+                                    f"{type(e).__name__}: {e}")
+
+        threads = [threading.Thread(target=client, args=(w,))
+                   for w in range(n_clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(300)
+        dt = time.perf_counter() - t0
+        bad = [i for i, (status, rid) in results.items()
+               if status != 200 or not rid]
+        if len(results) != len(seqs) or bad:
+            failures.append(
+                f"fleet A/B: {len(seqs) - len(results)} lost, "
+                f"{len(bad)} non-200/unlabeled of {len(seqs)}")
+        return len(seqs) / dt
+
+    rps = {arm: [] for arm, _ in arms}
+    for arm, router in arms:
+        run_round(router)  # warm pass (connection setup, jit reuse)
+    for _ in range(rounds):
+        for arm, router in arms:
+            rps[arm].append(run_round(router))
+
+    for _, router in arms:
+        router.drain()
+    for httpd in httpds:
+        httpd.shutdown()
+        httpd.server_close()
+    for srv in replicas:
+        srv.drain(timeout=60)
+
+    from statistics import median as _median
+
+    rps_on = _median(rps["on"])
+    rps_off = _median(rps["off"])
+    overhead_pct = (1.0 - rps_on / max(rps_off, 1e-9)) * 100.0
+    return {
+        "rounds": rounds,
+        "rps_per_round": {a: [round(v, 2) for v in vals]
+                          for a, vals in rps.items()},
+        "fleet_rps_on": round(rps_on, 2),
+        "fleet_rps_off": round(rps_off, 2),
+        "fleet_trace_overhead_pct": round(overhead_pct, 3),
+    }
+
+
+def _mirror_fleet_note(record):
+    """Best-effort mirror of the fleet propagation A/B onto the shared
+    bench event stream (the sentinel fits fleet_trace_overhead_pct
+    from it, lower-is-better)."""
+    try:
+        from proteinbert_tpu.obs.events import EventLog
+
+        ab = record["fleet_ab"]
+        ev = EventLog(os.path.join(os.path.dirname(LAST_GOOD_PATH),
+                                   "bench_events.jsonl"))
+        ev.emit("note", source="bench", kind="fleet_trace_capture",
+                platform=record["platform"], seq_len=record["seq_len"],
+                n_requests=record["n_requests"],
+                fleet_trace_overhead_pct=ab["fleet_trace_overhead_pct"],
+                fleet_rps_on=ab["fleet_rps_on"],
+                fleet_rps_off=ab["fleet_rps_off"],
+                failures=len(record["failures"]))
+        ev.close()
+    except Exception as e:
+        print(f"bench events stream unavailable: {e}", file=sys.stderr)
+
+
 def run_serve(length_mix=None):
     """`bench.py --serve`: sustained-load online serving vs the
     one-request-at-a-time offline baseline — one JSON line, CPU-
@@ -1862,13 +2009,15 @@ def run_serve(length_mix=None):
 
     PBT_SERVE_BENCH_PHASES selects phases: "all" (default), "core"
     (1-3 only — the historical smoke), "ragged" (phase 4 only — the
-    tier-1 ragged stage).
+    tier-1 ragged stage), "quant" (phase 5), "fleet" (phase 6 — the
+    ISSUE 18 trace-propagation on-vs-off A/B over two HTTP replicas,
+    feeding the fleet_trace_overhead_pct sentinel series).
 
     Knobs: PBT_SERVE_BENCH_SEQ_LEN (512), PBT_SERVE_BENCH_DIM (64),
     PBT_SERVE_BENCH_REQUESTS (96), PBT_SERVE_BENCH_CLIENTS (16),
     PBT_SERVE_BENCH_MAX_BATCH (8), PBT_SERVE_BENCH_TRACE_ROUNDS (5),
-    PBT_SERVE_BENCH_RAGGED_ROUNDS (3), PBT_SERVE_BENCH_MEDIAN_LEN
-    (seq_len // 8).
+    PBT_SERVE_BENCH_RAGGED_ROUNDS (3), PBT_SERVE_BENCH_FLEET_ROUNDS
+    (3), PBT_SERVE_BENCH_MEDIAN_LEN (seq_len // 8).
     """
     import threading
 
@@ -1888,12 +2037,13 @@ def run_serve(length_mix=None):
     from proteinbert_tpu.train import create_train_state
 
     phases_env = os.environ.get("PBT_SERVE_BENCH_PHASES", "all").strip()
-    wanted = ({"core", "ragged", "quant"} if phases_env == "all"
+    wanted = ({"core", "ragged", "quant", "fleet"} if phases_env == "all"
               else {p for p in phases_env.split(",") if p})
-    bad = wanted - {"core", "ragged", "quant"}
+    bad = wanted - {"core", "ragged", "quant", "fleet"}
     if bad or not wanted:
         raise SystemExit(f"PBT_SERVE_BENCH_PHASES must name phases from "
-                         f"core,ragged,quant or 'all'; got {phases_env!r}")
+                         f"core,ragged,quant,fleet or 'all'; got "
+                         f"{phases_env!r}")
 
     seq_len = int(os.environ.get("PBT_SERVE_BENCH_SEQ_LEN", 512))
     dim = int(os.environ.get("PBT_SERVE_BENCH_DIM", 64))
@@ -1945,7 +2095,8 @@ def run_serve(length_mix=None):
         failures = []
         record = {
             "metric": ("serve_ragged" if "ragged" in wanted
-                       else "serve_quant"),
+                       else "serve_quant" if "quant" in wanted
+                       else "serve_fleet"),
             "platform": jax.devices()[0].platform,
             "seq_len": seq_len, "model_dim": dim, "median_len": median,
             "length_sigma": mix_sigma, "buckets": list(buckets),
@@ -1962,6 +2113,11 @@ def run_serve(length_mix=None):
                 Server, params, cfg, seqs, max_batch, max_wait_s,
                 n_clients, failures)
             _mirror_quant_note(record)
+        if "fleet" in wanted:
+            record["fleet_ab"] = _serve_fleet_ab(
+                Server, params, cfg, seqs, max_batch, max_wait_s,
+                n_clients, failures)
+            _mirror_fleet_note(record)
         print(json.dumps(record))
         if failures:
             for f in failures:
@@ -2191,6 +2347,11 @@ def run_serve(length_mix=None):
     def _trace_hot_path():
         tr = RequestTrace("bench-1f", "embed", time.monotonic(),
                           sampled=False)
+        # Fleet propagation rides the same hot path (ISSUE 18): every
+        # routed request joins the router's trace id and answers with
+        # public_id() — so the <1% gate prices that stamping in too.
+        tr.join("f1a2-3f", "r0")
+        tr.public_id()
         tr.mark_enqueued(time.monotonic())
         tr.mark_ingested(time.monotonic())
         tr.mark_popped(time.monotonic())
@@ -2285,6 +2446,11 @@ def run_serve(length_mix=None):
                                 max_wait_s, n_clients, failures)
                 if "quant" in wanted else None)
 
+    # ---- phase 6: fleet trace-propagation A/B (ISSUE 18) --------------
+    fleet_ab = (_serve_fleet_ab(Server, params, cfg, seqs, max_batch,
+                                max_wait_s, n_clients, failures)
+                if "fleet" in wanted else None)
+
     record = {
         "metric": "serve_load",
         "platform": jax.devices()[0].platform,
@@ -2301,12 +2467,15 @@ def run_serve(length_mix=None):
         "overflow": overflow,
         "ragged_ab": ragged_ab,
         "quant_ab": quant_ab,
+        "fleet_ab": fleet_ab,
         "failures": failures,
     }
     if ragged_ab is not None:
         _mirror_ragged_note(record)
     if quant_ab is not None:
         _mirror_quant_note(record)
+    if fleet_ab is not None:
+        _mirror_fleet_note(record)
     try:  # mirror onto the shared bench event stream (best-effort)
         from proteinbert_tpu.obs.events import EventLog
 

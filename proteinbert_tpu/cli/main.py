@@ -821,8 +821,8 @@ def cmd_diagnose(args) -> int:
     artifacts can be copied."""
     from proteinbert_tpu.obs import read_events, validate_flight_dump
     from proteinbert_tpu.obs.diagnose import (
-        render, render_map, render_serve, summarize, summarize_map,
-        summarize_serve,
+        render, render_fleet, render_map, render_serve, summarize,
+        summarize_fleet, summarize_map, summarize_serve,
     )
 
     records = read_events(args.events)
@@ -836,25 +836,55 @@ def cmd_diagnose(args) -> int:
             validate_flight_dump(flight)
         except ValueError as e:
             raise SystemExit(f"{args.flight} is not a valid flight dump: {e}")
-    # The serve/map sections render when asked for (--serve/--map) or
-    # when the stream carries their records (a mixed stream shows all).
+    # The serve/map/fleet sections render when asked for (--serve /
+    # --map / --fleet) or when the stream carries their records (a
+    # mixed stream — e.g. the fleet's MERGED stream — shows all).
     has_serve = any(r["event"].startswith("serve_") for r in records)
     if args.serve and not has_serve:
         raise SystemExit(f"--serve: no serve_* records in {args.events}")
     has_map = any(r["event"].startswith("map_") for r in records)
     if args.map and not has_map:
         raise SystemExit(f"--map: no map_* records in {args.events}")
+    has_fleet = any(r["event"].startswith("fleet_") for r in records)
+    if args.fleet and not has_fleet:
+        raise SystemExit(f"--fleet: no fleet_* records in {args.events}")
+    if args.trace_id and not args.fleet:
+        raise SystemExit("--trace-id requires --fleet (it selects one "
+                         "causal chain from the merged fleet stream)")
     serve_summary = (summarize_serve(records, slow_top=args.slow_top)
                      if has_serve else None)
     map_summary = summarize_map(records) if has_map else None
+    fleet_summary = (summarize_fleet(records, trace_id=args.trace_id,
+                                     slow_top=args.slow_top)
+                     if has_fleet else None)
+    if args.trace_perfetto:
+        # Cross-process lanes (router + one per replica attempt) from
+        # the merged stream — the fleet counterpart of the per-request
+        # lanes `pbt serve --trace-perfetto` exports live.
+        from proteinbert_tpu.obs.diagnose import export_fleet_spans
+        from proteinbert_tpu.obs.tracing import SpanCollector
+
+        if not has_fleet:
+            raise SystemExit(f"--trace-perfetto: no fleet_* records in "
+                             f"{args.events}")
+        collector = SpanCollector()
+        n = export_fleet_spans(records, collector,
+                               trace_id=args.trace_id)
+        collector.dump(args.trace_perfetto)
+        print(f"wrote {n} fleet trace lane group(s) to "
+              f"{args.trace_perfetto}")
     summary = summarize(records, flight=flight,
                         slow_top=args.slow_top, last=args.last)
     if serve_summary is not None:
         summary["serve"] = serve_summary
     if map_summary is not None:
         summary["map"] = map_summary
+    if fleet_summary is not None:
+        summary["fleet"] = fleet_summary
     if args.json:
         print(json.dumps(summary))
+    elif args.fleet:
+        print(render_fleet(fleet_summary))
     elif args.serve:
         print(render_serve(serve_summary))
     elif args.map:
@@ -863,6 +893,8 @@ def cmd_diagnose(args) -> int:
         print(render(summary))
         if serve_summary is not None:
             print(render_serve(serve_summary))
+        if fleet_summary is not None:
+            print(render_fleet(fleet_summary))
         if map_summary is not None:
             print(render_map(map_summary))
     return 0
@@ -1243,6 +1275,7 @@ def cmd_serve(args) -> int:
             quant_parity_every=args.quant_parity_every,
             index=index,
             nprobe=args.nprobe,
+            replica_id=args.replica_id,
         )
     except TrunkMismatchError as e:
         # The index pins the trunk its embeddings came from; serving it
@@ -1592,7 +1625,7 @@ def cmd_fleet(args) -> int:
     import time as _time
 
     from proteinbert_tpu.serve.fleet import (
-        FleetRouter, make_fleet_http_server,
+        FleetCollector, FleetRouter, make_fleet_http_server,
     )
     from proteinbert_tpu.train.resilience import GracefulShutdown
 
@@ -1647,7 +1680,11 @@ def cmd_fleet(args) -> int:
             pf = os.path.join(workdir, f"replica{i}.port")
             lf = open(os.path.join(workdir, f"replica{i}.log"), "ab")
             logs.append(lf)
-            cmd = list(base) + ["--port-file", pf]
+            # Explicit fleet identity (ISSUE 18): every replica stamps
+            # its serve_* events with this name, so the merged stream
+            # joins on identity, never on ports.
+            cmd = list(base) + ["--port-file", pf,
+                                "--replica-id", f"r{i}"]
             if args.events_jsonl:
                 cmd += ["--events-jsonl",
                         os.path.join(workdir, f"replica{i}.events.jsonl")]
@@ -1678,6 +1715,36 @@ def cmd_fleet(args) -> int:
         _shutdown_replicas()
         raise
 
+    # A SIGKILLed replica's flight-recorder ring dumps into its
+    # telemetry dir (= the tmp workdir): tell the router where each
+    # will land so the fleet_replica death event can point at it, and
+    # collect the dumps out of the tmpdir before it vanishes.
+    flight_paths = {}
+    if args.events_jsonl:
+        from proteinbert_tpu.obs import flight_path
+
+        flight_paths = {f"r{i}": flight_path(workdir, procs[i].pid)
+                        for i in range(len(procs))}
+
+    def _collect_flight_dumps():
+        """Copy any replica flight dumps beside --events-jsonl (the
+        artifact that survives this run) — a dead replica's last-N
+        forensic ring must not die with the tmpdir."""
+        import shutil
+
+        saved = []
+        dest_dir = os.path.dirname(os.path.abspath(args.events_jsonl))
+        for name, src in sorted(flight_paths.items()):
+            if os.path.exists(src):
+                dst = os.path.join(dest_dir,
+                                   f"fleet_{name}_flight.json")
+                try:
+                    shutil.copyfile(src, dst)
+                    saved.append(dst)
+                except OSError as e:
+                    log(f"could not save {name} flight dump: {e}")
+        return saved
+
     try:
         router = FleetRouter(
             urls, telemetry=tele,
@@ -1685,7 +1752,18 @@ def cmd_fleet(args) -> int:
             max_retries=args.max_retries,
             retry_budget_ratio=args.retry_budget_ratio,
             cache_size=args.fleet_cache_size,
+            flight_paths=flight_paths,
         ).start()
+        if args.events_jsonl:
+            # The fleet event funnel: router + replica streams merge
+            # post-hoc into one seq-ordered file `pbt diagnose --fleet`
+            # reconstructs causal chains from.
+            collector = FleetCollector({"router": args.events_jsonl})
+            for i in range(len(procs)):
+                collector.add_source(
+                    f"r{i}",
+                    os.path.join(workdir, f"replica{i}.events.jsonl"))
+            router.attach_collector(collector)
         # Bind can fail (EADDRINUSE on the fixed default port) — the
         # replicas must not be orphaned by a router that never served.
         httpd = make_fleet_http_server(router, args.host, args.port)
@@ -1721,6 +1799,19 @@ def cmd_fleet(args) -> int:
         if tele is not None:
             _export_metrics(tele)
             tele.close()
+            for p in _collect_flight_dumps():
+                log(f"saved replica flight dump: {p}")
+            if router.collector is not None:
+                # Merge AFTER every writer is closed: the router's
+                # stream is flushed and each replica stream is as
+                # complete as its exit allowed (a torn final line is
+                # tolerated by the reader).
+                merged = args.events_jsonl + ".merged.jsonl"
+                try:
+                    n = router.collector.write(merged)
+                    log(f"merged fleet stream: {n} event(s) → {merged}")
+                except OSError as e:
+                    log(f"could not write merged fleet stream: {e}")
     stats = router.stats()
     log(f"fleet drained: {stats['accepted']} accepted, "
         f"{stats['sealed']} sealed, outcomes {stats['outcomes']}, "
@@ -1960,6 +2051,19 @@ def build_parser() -> argparse.ArgumentParser:
                          "re-work across incarnations, quarantines); "
                          "a stream with map_* records shows it "
                          "automatically after the training report")
+    dg.add_argument("--fleet", action="store_true",
+                    help="render only the fleet section (causal chains "
+                         "across router attempts and replicas — feed "
+                         "the merged stream pbt fleet writes); a stream "
+                         "with fleet_* records shows it automatically")
+    dg.add_argument("--trace-id", default=None,
+                    help="with --fleet: reconstruct ONE request's "
+                         "causal chain (admission → attempts → sealed) "
+                         "by its fleet id (the X-PBT-Request-Id header)")
+    dg.add_argument("--trace-perfetto", type=creatable_path, default=None,
+                    help="with --fleet: write cross-process Perfetto "
+                         "lanes (router tid + one tid per replica "
+                         "attempt) reconstructed from the merged stream")
     dg.set_defaults(fn=cmd_diagnose)
 
     dbench = sub.add_parser("data-bench",
@@ -2059,6 +2163,11 @@ def build_parser() -> argparse.ArgumentParser:
                          "oldest queued request with a 429")
     sv.add_argument("--cache-size", type=int, default=1024,
                     help="LRU result-cache entries (0 disables)")
+    sv.add_argument("--replica-id", default=None,
+                    help="fleet identity stamped on every serve_request/"
+                         "serve_batch event (pbt fleet passes r0..rN-1 "
+                         "at spawn); lets the merged fleet stream "
+                         "attribute replica work to router attempts")
     sv.add_argument("--deadline-ms", type=float,
                     help="default per-request deadline (504 when missed)")
     sv.add_argument("--on-long", default="truncate",

@@ -355,6 +355,220 @@ def summarize_serve(records: List[Dict[str, Any]],
     return out
 
 
+def _fleet_chains(records: List[Dict[str, Any]]) -> Dict[str, Dict[str, Any]]:
+    """Group a (merged) stream's fleet_request / fleet_attempt /
+    serve_request records into per-trace causal chains (ISSUE 18).
+
+    The join key is `trace_id` — the router-minted id every record in
+    one request's life carries. Replica-side serve_request records are
+    matched onto attempts by `replica_id` in attempt order (the router
+    never has two concurrent attempts of one trace on one replica).
+    `complete` encodes the drill's reconstruction contract: sealed
+    exactly once, attempts on record == retries spent + 1, and an
+    ok/retried_ok chain ends in an attempt that succeeded."""
+    chains: Dict[str, Dict[str, Any]] = {}
+
+    def chain(tid: str) -> Dict[str, Any]:
+        c = chains.get(tid)
+        if c is None:
+            c = chains[tid] = {
+                "trace_id": tid, "seals": 0, "outcome": None,
+                "status": None, "path": None, "retries": None,
+                "replica": None, "sealed_t": None, "attempts": [],
+                "_serve": []}
+        return c
+
+    for r in records:
+        ev = r.get("event")
+        if ev == "fleet_request":
+            tid = r.get("trace_id") or r.get("request_id")
+            if not isinstance(tid, str):
+                continue
+            c = chain(tid)
+            c["seals"] += 1
+            c["outcome"] = r.get("outcome")
+            c["status"] = r.get("status")
+            c["path"] = r.get("path")
+            c["retries"] = r.get("retries")
+            c["replica"] = r.get("replica")
+            c["sealed_t"] = r.get("t")
+        elif ev == "fleet_attempt":
+            tid = r.get("trace_id")
+            if not isinstance(tid, str):
+                continue
+            chain(tid)["attempts"].append({
+                "attempt": r.get("attempt"), "replica": r.get("replica"),
+                "outcome": r.get("outcome"), "status": r.get("status"),
+                "backoff_s": r.get("backoff_s"), "t": r.get("t"),
+                "serve": None})
+        elif ev == "serve_request":
+            tid = r.get("trace_id")
+            if isinstance(tid, str):
+                chain(tid)["_serve"].append(r)
+
+    for c in chains.values():
+        c["attempts"].sort(
+            key=lambda a: (a["attempt"] is None, a["attempt"]))
+        unmatched = list(c.pop("_serve"))
+        for a in c["attempts"]:
+            for i, s in enumerate(unmatched):
+                if s.get("replica_id") == a["replica"]:
+                    a["serve"] = {
+                        "request_id": s.get("request_id"),
+                        "outcome": s.get("outcome"),
+                        "e2e_s": s.get("e2e_s"),
+                        "stages": s.get("stages"),
+                        "t": s.get("t"),
+                    }
+                    unmatched.pop(i)
+                    break
+        c["unmatched_serve"] = len(unmatched)
+        n_att = len(c["attempts"])
+        ok_chain = (c["seals"] == 1
+                    and (not n_att or c["retries"] is None
+                         or n_att == c["retries"] + 1))
+        if ok_chain and n_att and c["outcome"] in ("ok", "retried_ok"):
+            ok_chain = c["attempts"][-1]["outcome"] == "ok"
+        c["complete"] = ok_chain
+    return chains
+
+
+def summarize_fleet(records: List[Dict[str, Any]],
+                    trace_id: Optional[str] = None,
+                    slow_top: int = 5) -> Dict[str, Any]:
+    """The `pbt diagnose --fleet` section: per-trace causal chains
+    (admission → attempts → sealed) over a MERGED fleet stream, the
+    exactly-once-sealing and attempt-accounting audits, and replica
+    lifecycle context (ISSUE 18). `trace_id` selects one chain for
+    full rendering. Optional-input-safe like the other summarizers —
+    an un-merged single-process stream still summarizes (it simply has
+    no attempts to join)."""
+    start = next((r for r in records if r["event"] == "fleet_start"),
+                 None)
+    end = next((r for r in reversed(records)
+                if r["event"] == "fleet_end"), None)
+    transitions = [r for r in records if r["event"] == "fleet_replica"]
+    chains = _fleet_chains(records)
+
+    seal_violations = {tid: c["seals"] for tid, c in chains.items()
+                       if c["seals"] != 1}
+    mismatched = [tid for tid, c in chains.items()
+                  if c["attempts"] and c["retries"] is not None
+                  and len(c["attempts"]) != c["retries"] + 1]
+    out: Dict[str, Any] = {
+        "manifest": (start.get("config") if start else None),
+        "outcome": (end["outcome"] if end
+                    else "unknown (no fleet_end record)"),
+        "traces": len(chains),
+        "outcomes": dict(collections.Counter(
+            c["outcome"] for c in chains.values() if c["outcome"])),
+        "attempts_recorded": sum(len(c["attempts"])
+                                 for c in chains.values()),
+        "retried": sum(1 for c in chains.values()
+                       if (c["retries"] or 0) > 0),
+        "seal_violations": seal_violations,
+        "attempt_mismatches": sorted(mismatched),
+        "incomplete": sorted(tid for tid, c in chains.items()
+                             if not c["complete"]),
+        "replica_deaths": [{
+            "replica": r.get("replica"), "reason": r.get("reason"),
+            "flight": r.get("flight"), "t": r.get("t"),
+        } for r in transitions if r.get("state") == "dead"],
+    }
+    # The most-travelled chains (retries, then attempt count): the
+    # requests whose causal story is worth reading first.
+    ranked = sorted(chains.values(),
+                    key=lambda c: (-(c["retries"] or 0),
+                                   -len(c["attempts"])))
+    out["most_retried"] = [{
+        "trace_id": c["trace_id"], "outcome": c["outcome"],
+        "retries": c["retries"], "attempts": len(c["attempts"]),
+        "replica": c["replica"],
+    } for c in ranked[:slow_top] if (c["retries"] or 0) > 0
+        or len(c["attempts"]) > 1]
+    if end is not None and isinstance(end.get("stats"), dict):
+        out["final_stats"] = {
+            k: end["stats"].get(k)
+            for k in ("accepted", "sealed", "outcomes", "retries_spent")}
+    if trace_id is not None:
+        out["chain"] = chains.get(trace_id)
+        if out["chain"] is None:
+            out["chain_missing"] = trace_id
+    return out
+
+
+def export_fleet_spans(records: List[Dict[str, Any]], collector,
+                       trace_id: Optional[str] = None) -> int:
+    """Cross-process Perfetto lanes from a merged fleet stream: per
+    trace, one ROUTER lane (admission → sealed) plus one lane per
+    replica attempt, replica-side stages tiled inside the attempt span
+    (ISSUE 18). Reconstructed post-hoc from event timestamps — the
+    attempt's wall span is its serve-side e2e when a joined
+    serve_request exists, else the instant of its attempt record.
+    Returns the number of chains exported."""
+    import zlib
+
+    _MIN = 1e-7  # perfetto drops 0-duration complete events
+    chains = _fleet_chains(records)
+    n = 0
+    for tid, c in sorted(chains.items()):
+        if trace_id is not None and tid != trace_id:
+            continue
+        ts = [a["t"] for a in c["attempts"]
+              if isinstance(a.get("t"), (int, float))]
+        if isinstance(c.get("sealed_t"), (int, float)):
+            ts.append(c["sealed_t"])
+        # Admission approximated by the earliest observable moment:
+        # the first attempt's serve-side start when joined, else the
+        # first event stamp.
+        first = c["attempts"][0] if c["attempts"] else None
+        if first is not None and first["serve"] \
+                and isinstance(first["serve"].get("t"), (int, float)) \
+                and isinstance(first["serve"].get("e2e_s"),
+                               (int, float)):
+            ts.append(first["serve"]["t"] - first["serve"]["e2e_s"])
+        if not ts:
+            continue
+        t0, t1 = min(ts), max(ts)
+        base = zlib.crc32(tid.encode()) & 0x7FFFFFFF
+        collector.add(
+            f"fleet:{c['path'] or '?'}:{c['outcome'] or '?'}",
+            t0, max(t1 - t0, _MIN), 0, tid=base, trace_id=tid,
+            retries=c["retries"], status=c["status"])
+        for i, a in enumerate(c["attempts"]):
+            lane = (base + 1 + (a["attempt"] if isinstance(
+                a["attempt"], int) else i)) & 0x7FFFFFFF
+            s = a["serve"]
+            if s and isinstance(s.get("t"), (int, float)) \
+                    and isinstance(s.get("e2e_s"), (int, float)):
+                a0, dur = s["t"] - s["e2e_s"], s["e2e_s"]
+            elif isinstance(a.get("t"), (int, float)):
+                a0, dur = a["t"], _MIN
+            else:
+                continue
+            collector.add(
+                f"attempt{a['attempt']}:{a['replica']}:{a['outcome']}",
+                a0, max(dur, _MIN), 0, tid=lane, trace_id=tid,
+                status=a.get("status"))
+            cursor = a0
+            for stage, sdur in ((s or {}).get("stages") or {}).items():
+                if not isinstance(sdur, (int, float)):
+                    continue
+                collector.add(stage, cursor, max(sdur, _MIN), 1,
+                              tid=lane, trace_id=tid)
+                cursor += sdur
+            if isinstance(a.get("backoff_s"), (int, float)) \
+                    and a["backoff_s"] > 0 \
+                    and isinstance(a.get("t"), (int, float)):
+                # The wait a retry paid AFTER this failed attempt —
+                # rendered on the router lane where the sleep ran.
+                collector.add("backoff", a["t"],
+                              max(a["backoff_s"], _MIN), 1,
+                              tid=base, trace_id=tid)
+        n += 1
+    return n
+
+
 def summarize_map(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     """The `pbt diagnose --map` section: per-shard progress, block
     throughput, re-work across incarnations, quarantine/retry totals
@@ -601,6 +815,98 @@ def render_serve(summary: Dict[str, Any]) -> str:
         lines.append("slo: no breach events; final burn rates: " + ", ".join(
             f"{k}={v.get('burn_rate')}"
             for k, v in summary["final_slo"].items()))
+    return "\n".join(lines)
+
+
+def _render_chain(c: Dict[str, Any]) -> List[str]:
+    """One trace's causal chain, admission → attempts → sealed."""
+    lines = [f"trace {c['trace_id']}: {c['path'] or '?'} "
+             f"{c['outcome'] or 'UNSEALED'}"
+             + ("" if c["complete"] else "  [INCOMPLETE CHAIN]")]
+    lines.append(f"  admission → router (trace {c['trace_id']})")
+    for a in c["attempts"]:
+        status = f" status {a['status']}" if a.get("status") is not None \
+            else ""
+        lines.append(f"  attempt {a['attempt']}: replica "
+                     f"{a['replica']} {a['outcome']}{status}")
+        s = a.get("serve")
+        if s:
+            stages = s.get("stages") or {}
+            tile = " | ".join(f"{k} {v * 1e3:.2f}ms"
+                              for k, v in stages.items()
+                              if isinstance(v, (int, float)))
+            e2e = (f"{s['e2e_s'] * 1e3:.2f}ms"
+                   if isinstance(s.get("e2e_s"), (int, float)) else "?")
+            lines.append(f"    replica trace {s.get('request_id')} "
+                         f"{s.get('outcome')} e2e {e2e}"
+                         + (f": {tile}" if tile else ""))
+        if isinstance(a.get("backoff_s"), (int, float)) \
+                and a["backoff_s"] > 0:
+            lines.append(f"  backoff {a['backoff_s'] * 1e3:.1f}ms")
+    seal = f"  sealed: {c['outcome'] or '?'}"
+    if c.get("status") is not None:
+        seal += f" status {c['status']}"
+    if c.get("retries") is not None:
+        seal += f" after {c['retries']} retry(ies)"
+    if c["seals"] != 1:
+        seal += f"  [sealed {c['seals']}x — exactly-once VIOLATED]"
+    lines.append(seal)
+    return lines
+
+
+def render_fleet(summary: Dict[str, Any]) -> str:
+    """Human-readable fleet section (`pbt diagnose --fleet`)."""
+    lines = ["-- fleet --"]
+    lines.append(f"outcome: {summary['outcome']}")
+    man = summary.get("manifest")
+    if man:
+        reps = man.get("replicas") or {}
+        lines.append(
+            f"manifest: {len(reps)} replica(s) "
+            f"{sorted(reps)} max_retries {man.get('max_retries')} "
+            f"budget floor {man.get('retry_budget_floor')} "
+            f"ratio {man.get('retry_budget_ratio')}")
+    if summary["outcomes"]:
+        lines.append(
+            f"traces: {summary['traces']} sealed — " + ", ".join(
+                f"{k}={v}" for k, v in sorted(summary["outcomes"].items()))
+            + f"; {summary['attempts_recorded']} attempt(s) recorded, "
+            f"{summary['retried']} trace(s) retried")
+    for tid, n in sorted(summary["seal_violations"].items()):
+        lines.append(f"  SEAL VIOLATION: trace {tid} sealed {n}x "
+                     "(exactly-once broken)")
+    for tid in summary["attempt_mismatches"]:
+        lines.append(f"  ATTEMPT MISMATCH: trace {tid} — attempts on "
+                     "record != retries spent + 1")
+    inc = [t for t in summary["incomplete"]
+           if t not in summary["seal_violations"]
+           and t not in summary["attempt_mismatches"]]
+    if inc:
+        lines.append(f"incomplete chains: {len(inc)} "
+                     f"(e.g. {inc[:3]})")
+    for d in summary["replica_deaths"]:
+        flight = f", flight dump {d['flight']}" if d.get("flight") \
+            else ""
+        lines.append(f"replica DEATH: {d['replica']} "
+                     f"({d['reason']}){flight}")
+    for m in summary.get("most_retried") or []:
+        lines.append(
+            f"  retried: {m['trace_id']} {m['outcome']} — "
+            f"{m['attempts']} attempt(s), {m['retries']} retry(ies), "
+            f"final replica {m['replica']}")
+    fin = summary.get("final_stats")
+    if fin:
+        lines.append(
+            f"router: accepted {fin.get('accepted')} sealed "
+            f"{fin.get('sealed')} retries_spent "
+            f"{fin.get('retries_spent')}")
+    chain = summary.get("chain")
+    if chain:
+        lines.append("")
+        lines.extend(_render_chain(chain))
+    elif summary.get("chain_missing"):
+        lines.append(f"trace {summary['chain_missing']}: NOT FOUND in "
+                     "this stream")
     return "\n".join(lines)
 
 

@@ -118,8 +118,17 @@ EVENT_FIELDS: Dict[str, Dict[str, Any]] = {
     # One terminal routed request: outcome in FLEET_REQUEST_OUTCOMES
     # (every request the router ACCEPTS seals in exactly one of these —
     # the fleet-level funnel the drill harness audits). Typed optional
-    # fields: replica, retries, status.
+    # fields: replica, retries, status, trace_id, replica_id.
     "fleet_request": {"outcome": str, "path": str},
+    # One forward attempt under a routed request (ISSUE 18): the
+    # sibling record that turns a retry/hedge into a causal chain —
+    # `trace_id` joins it to its `fleet_request` seal (and to the
+    # replica-side `serve_request` records carrying the same id),
+    # `attempt` is the 0-based index (== retries spent so far), outcome
+    # in FLEET_ATTEMPT_OUTCOMES. Typed optional fields: status,
+    # backoff_s (the wait that FOLLOWED a failed attempt), path.
+    "fleet_attempt": {"trace_id": str, "attempt": int, "replica": str,
+                      "outcome": str},
     # Terminal router record; outcome in SERVE_OUTCOMES, stats is
     # FleetRouter.stats().
     "fleet_end": {"outcome": str, "stats": dict},
@@ -180,6 +189,13 @@ FLEET_REPLICA_STATES = ("up", "degraded", "dead", "draining", "admitted")
 # a typed 429/503 passthrough or router-side no-capacity 503), failed
 # (a non-retryable error reached the client).
 FLEET_REQUEST_OUTCOMES = ("ok", "cache_hit", "retried_ok", "shed",
+                          "failed")
+# Per-attempt outcomes under one routed request (ISSUE 18): ok (the
+# replica answered 200), transport_failed (connection-level failure —
+# the retry path's trigger), retryable (the replica answered a
+# RETRYABLE status, 503), shed (typed backpressure passthrough,
+# 429/504), failed (a non-retryable error answer).
+FLEET_ATTEMPT_OUTCOMES = ("ok", "transport_failed", "retryable", "shed",
                           "failed")
 # Map shard lifecycle states (mapper/engine.py): start (fresh cursor),
 # resume (an existing cursor was picked up — incl. a torn-cursor /
@@ -299,6 +315,21 @@ def _validate_packed_fields(event: str, rec: Dict[str, Any]) -> None:
                          f"[0, 1], got {pf!r}")
 
 
+def _validate_trace_fields(event: str, rec: Dict[str, Any]) -> None:
+    """Optional fleet-trace join fields (ISSUE 18) shared by
+    serve_request, serve_batch, and fleet_request: `trace_id` (the
+    fleet-scope id the router minted and the X-PBT-Trace header
+    propagated), `parent` (the enclosing fleet request's id), and
+    `replica_id` (the --replica-id identity stamped at emit). All
+    strings, typed when present — absent on pre-fleet streams and
+    standalone servers."""
+    for name in ("trace_id", "parent", "replica_id"):
+        v = rec.get(name)
+        if v is not None and not isinstance(v, str):
+            raise ValueError(f"{event}.{name} must be a string, "
+                             f"got {v!r}")
+
+
 def validate_record(rec: Any) -> None:
     """Raise ValueError (with a pinpointing message) unless `rec` is a
     well-formed event record. The writer, tools/validate_events.py, and
@@ -356,9 +387,11 @@ def validate_record(rec: Any) -> None:
                     f"got {v!r}")
         _validate_packed_fields(event, rec)
         _validate_quant_fields(event, rec)
+        _validate_trace_fields(event, rec)
     if event == "serve_request":
         _validate_packed_fields(event, rec)
         _validate_quant_fields(event, rec)
+        _validate_trace_fields(event, rec)
         if rec["outcome"] not in SERVE_REQUEST_OUTCOMES:
             raise ValueError(f"serve_request.outcome {rec['outcome']!r} "
                              f"not in {SERVE_REQUEST_OUTCOMES}")
@@ -422,6 +455,31 @@ def validate_record(rec: Any) -> None:
         if rep is not None and not isinstance(rep, str):
             raise ValueError(f"fleet_request.replica must be a string, "
                              f"got {rep!r}")
+        _validate_trace_fields(event, rec)
+    if event == "fleet_attempt":
+        if rec["outcome"] not in FLEET_ATTEMPT_OUTCOMES:
+            raise ValueError(f"fleet_attempt.outcome {rec['outcome']!r} "
+                             f"not in {FLEET_ATTEMPT_OUTCOMES}")
+        att = rec["attempt"]
+        if isinstance(att, bool) or att < 0:
+            raise ValueError(f"fleet_attempt.attempt must be a "
+                             f"non-negative int, got {att!r}")
+        status = rec.get("status")
+        if status is not None and (not isinstance(status, int)
+                                   or isinstance(status, bool)
+                                   or not 100 <= status <= 599):
+            raise ValueError(f"fleet_attempt.status must be an HTTP "
+                             f"status code, got {status!r}")
+        bo = rec.get("backoff_s")
+        if bo is not None and (isinstance(bo, bool)
+                               or not isinstance(bo, (int, float))
+                               or not math.isfinite(bo) or bo < 0):
+            raise ValueError(f"fleet_attempt.backoff_s must be a "
+                             f"non-negative finite number, got {bo!r}")
+        path = rec.get("path")
+        if path is not None and not isinstance(path, str):
+            raise ValueError(f"fleet_attempt.path must be a string, "
+                             f"got {path!r}")
     if event == "fleet_end" and rec["outcome"] not in SERVE_OUTCOMES:
         raise ValueError(f"fleet_end.outcome {rec['outcome']!r} not in "
                          f"{SERVE_OUTCOMES}")
@@ -591,6 +649,32 @@ def validate_record(rec: Any) -> None:
                 raise ValueError(
                     f"note(kind=onepass_capture).{name} must be a "
                     f"non-negative finite number, got {v!r}")
+    if event == "note" and rec.get("kind") == "fleet_trace_capture":
+        # The fleet-propagation overhead A/B (bench.py --serve fleet
+        # arm, ISSUE 18): routed-throughput delta with trace
+        # propagation on vs off. The pct is a trajectory-sentinel
+        # input (lower-is-better), so a writer bug must fail
+        # validation, not poison the series. It is a DIFFERENCE, so
+        # negative values (measurement noise) are legal — finiteness
+        # is the bound.
+        v = rec.get("fleet_trace_overhead_pct")
+        if v is None:
+            raise ValueError(
+                "note(kind=fleet_trace_capture): missing required "
+                "field 'fleet_trace_overhead_pct'")
+        if (isinstance(v, bool) or not isinstance(v, (int, float))
+                or not math.isfinite(v)):
+            raise ValueError(
+                f"note(kind=fleet_trace_capture).fleet_trace_overhead_"
+                f"pct must be a finite number, got {v!r}")
+        for name in ("fleet_rps_on", "fleet_rps_off"):
+            v = rec.get(name)
+            if v is not None and (isinstance(v, bool)
+                                  or not isinstance(v, (int, float))
+                                  or not math.isfinite(v) or v <= 0):
+                raise ValueError(
+                    f"note(kind=fleet_trace_capture).{name} must be a "
+                    f"positive finite number, got {v!r}")
     if event == "note" and rec.get("kind") == "neighbors_capture":
         # The ANN serving capture (bench.py --neighbors, ISSUE 17):
         # its QPS and recall fields feed trajectory-sentinel series
@@ -657,7 +741,11 @@ def make_example(event: str) -> Dict[str, Any]:
         "fleet_start": {"config": {"replicas": 3}, "pid": 1},
         "fleet_replica": {"replica": "r0", "state": "up"},
         "fleet_request": {"outcome": "ok", "path": "/v1/embed",
-                          "replica": "r0", "retries": 0, "status": 200},
+                          "replica": "r0", "retries": 0, "status": 200,
+                          "trace_id": "f1-1"},
+        "fleet_attempt": {"trace_id": "f1-1", "attempt": 0,
+                          "replica": "r0", "outcome": "ok",
+                          "status": 200, "path": "/v1/embed"},
         "fleet_end": {"outcome": "drained", "stats": {"accepted": 0}},
         "map_start": {"config": {"num_shards": 2}, "pid": 1},
         "map_shard": {"shard": 0, "state": "start", "next": 0,

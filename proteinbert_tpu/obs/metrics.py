@@ -109,6 +109,15 @@ class QuantileWindow:
         with self._lock:
             return len(self._ring)
 
+    def values(self):
+        """A consistent copy of the raw ring, oldest first. The fleet
+        aggregation plane (ISSUE 18) merges percentile windows across
+        replicas by CONCATENATING raw values — a fleet p99 is not any
+        function of per-replica p99s — so the scrape endpoint ships
+        these, not summary()."""
+        with self._lock:
+            return list(self._ring)
+
     def percentile(self, q: float) -> Optional[float]:
         """Nearest-rank percentile; `q` is in PERCENT (0–100), e.g.
         `percentile(99)` — not the 0–1 fraction `summary()` uses
@@ -251,6 +260,12 @@ class MetricsRegistry:
             out["windows"] = {k: w.summary()
                               for k, w in self._windows.items()}
         return out
+
+    def window_values(self) -> Dict[str, Any]:
+        """{window key: raw ring values} — the machine-readable form
+        `/metrics.json` ships so a fleet router can merge percentiles
+        across replicas from the concatenated observations."""
+        return {k: w.values() for k, w in self._windows.items()}
 
     def write_snapshot(self, path: str) -> None:
         """Append one timestamped JSONL snapshot line."""

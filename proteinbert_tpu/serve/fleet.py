@@ -36,6 +36,17 @@ behind a `FleetRouter` that
   warm embeddings: a repeat of any previously answered request is
   served router-side even while the replica that computed it is dead.
 
+Fleet-scope causal tracing (ISSUE 18): the router's request id IS the
+fleet `trace_id` — it rides every replica attempt as an `X-PBT-Trace`
+header (the replica's RequestTrace joins it), every retry/hedge emits
+a sibling `fleet_attempt` record (attempt index, target replica,
+outcome, backoff wait), and `FleetCollector` merges router + replica
+event files into one seq-ordered stream `pbt diagnose --fleet`
+reconstructs causal chains from. `fleet_metrics()` (GET
+/fleet/metrics) is the aggregation plane: replica registries scraped
+via /metrics.json and merged — counters summed, gauges labeled by
+replica, quantile windows merged over raw values.
+
 Exactly-once sealing: every request the router ACCEPTS terminates in
 exactly one `FLEET_REQUEST_OUTCOMES` outcome (ok / cache_hit /
 retried_ok / shed / failed), counted in `fleet_requests_total{outcome=}`
@@ -96,6 +107,7 @@ class FaultInjector:
         self._latency: Dict[str, float] = {}  # guarded-by: _lock
         self._dead: set = set()               # guarded-by: _lock
         self._torn_health: set = set()        # guarded-by: _lock
+        self._health_latency: Dict[str, float] = {}  # guarded-by: _lock
 
     def set_latency(self, replica: str, seconds: float) -> None:
         with self._lock:
@@ -122,9 +134,24 @@ class FaultInjector:
             else:
                 self._torn_health.discard(replica)
 
+    def set_health_latency(self, replica: str, seconds: float) -> None:
+        """Grey failure: the replica answers health checks, just
+        SLOWLY. Distinct from tear_health (hard failure) — the drill
+        uses this to prove the health loop never starves behind one
+        slow replica (fleet_health_scrape_seconds bounds it)."""
+        with self._lock:
+            if seconds > 0:
+                self._health_latency[replica] = float(seconds)
+            else:
+                self._health_latency.pop(replica, None)
+
     def forward_latency(self, replica: str) -> float:
         with self._lock:
             return self._latency.get(replica, 0.0)
+
+    def health_latency(self, replica: str) -> float:
+        with self._lock:
+            return self._health_latency.get(replica, 0.0)
 
     def is_dead(self, replica: str) -> bool:
         with self._lock:
@@ -187,6 +214,8 @@ class FleetRouter:
         cache_size: int = 2048,
         fault_injector: Optional[FaultInjector] = None,
         index_digest: Optional[str] = None,
+        propagate_trace: bool = True,
+        flight_paths: Optional[Dict[str, str]] = None,
     ):
         from proteinbert_tpu.obs import as_telemetry
 
@@ -225,6 +254,17 @@ class FleetRouter:
         # cannot prove two replicas hold the same index, so neighbor
         # responses are simply not cached (forwarding still works).
         self.index_digest = index_digest
+        # Fleet-scope causal tracing (ISSUE 18): when on, the router's
+        # request id travels to every replica attempt as X-PBT-Trace
+        # (the replica's RequestTrace joins it) and each attempt emits
+        # a fleet_attempt sibling record. Off is the bench A/B arm —
+        # the overhead gate measures on-vs-off.
+        self.propagate_trace = bool(propagate_trace)
+        # Where each replica's flight-recorder ring will dump on crash
+        # (replica name -> flight_<pid>.json path): surfaced on the
+        # fleet_replica death event so a dead replica's last-N trail is
+        # findable before its tmpdir vanishes.
+        self.flight_paths = dict(flight_paths or {})
         self.cache = EmbeddingCache(cache_size, metrics=self.tele.metrics)
         self._lock = threading.Lock()
         self._rr = itertools.count()
@@ -248,11 +288,27 @@ class FleetRouter:
                                             replica=r.name)
                       for r in self.replicas}
         self._admitting_g = metrics.gauge("fleet_replicas_admitting")
+        # Health-loop scrape latency per replica (the previously
+        # unmeasured half of the health plane): one slow replica shows
+        # up HERE, and the drill asserts the loop still visits every
+        # other replica each sweep (no starvation).
+        self._scrape_h = {r.name: metrics.histogram(
+            "fleet_health_scrape_seconds", replica=r.name)
+            for r in self.replicas}
         self._health_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._ended = False               # guarded-by: _lock
         self._req_ids = itertools.count(1)
         self._id_prefix = f"f{os.getpid():x}-"
+        # Optional FleetCollector (attach_collector): the merged-stream
+        # funnel the CLI/drill drain into one fleet JSONL.
+        self.collector = None
+
+    def attach_collector(self, collector: "FleetCollector") -> None:
+        """Wire the event funnel: the router itself never tails files
+        mid-flight (the merge is post-hoc), it just owns the handle so
+        drain-time callers find router + replicas in one place."""
+        self.collector = collector
 
     # ----------------------------------------------------------- lifecycle
 
@@ -311,15 +367,23 @@ class FleetRouter:
         """One health sweep over all replicas (public so tests and the
         drill can drive it deterministically without the thread)."""
         for rep in self.replicas:
+            t0 = self.clock()
             payload = self._fetch_health(rep)
+            self._scrape_h[rep.name].observe(max(0.0, self.clock() - t0))
             self._apply_health(rep, payload)
         self._gauge_admitting()
 
     def _fetch_health(self, rep: Replica) -> Optional[Dict[str, Any]]:
-        if self.injector is not None and (
-                self.injector.health_is_torn(rep.name)
-                or self.injector.is_dead(rep.name)):
-            return None
+        if self.injector is not None:
+            # Grey failure first: a slow replica is slow whether or not
+            # it eventually answers/tears — the scrape histogram must
+            # see the stall either way.
+            lat = self.injector.health_latency(rep.name)
+            if lat > 0:
+                self._sleep(lat)
+            if (self.injector.health_is_torn(rep.name)
+                    or self.injector.is_dead(rep.name)):
+                return None
         try:
             with urllib.request.urlopen(rep.url + "/healthz",
                                         timeout=self.health_timeout_s) as r:
@@ -378,11 +442,20 @@ class FleetRouter:
         report as 'admitted' while storing the routable 'up'."""
         rep.state = state
         self._up_g[rep.name].set(1.0 if rep.routable() else 0.0)
+        fields = {}
+        if state == "dead":
+            # Point the death record at the replica's flight-recorder
+            # dump (when the fleet knows where it will land): the
+            # last-N forensic ring outlives the replica even though its
+            # tmpdir will not (pbt fleet copies it out).
+            flight = self.flight_paths.get(rep.name)
+            if flight is not None:
+                fields["flight"] = flight
         self.tele.emit("fleet_replica", replica=rep.name,
                        state=event_state or state, url=rep.url,
                        reason=reason,
                        consecutive_failures=rep.consecutive_failures,
-                       burn_rate=round(rep.burn_rate, 4))
+                       burn_rate=round(rep.burn_rate, 4), **fields)
 
     def _gauge_admitting(self) -> None:
         with self._lock:
@@ -462,10 +535,12 @@ class FleetRouter:
         self._retry_c.inc()
         return True
 
-    def _forward(self, rep: Replica, path: str,
-                 raw_body: bytes) -> Tuple[int, bytes]:
+    def _forward(self, rep: Replica, path: str, raw_body: bytes,
+                 trace_id: Optional[str] = None) -> Tuple[int, bytes]:
         """One upstream POST; raises ConnectionError-family on transport
-        failure, returns (status, body) otherwise (4xx/5xx included)."""
+        failure, returns (status, body) otherwise (4xx/5xx included).
+        `trace_id` rides as X-PBT-Trace — the propagated fleet context
+        the replica's RequestTrace joins (ISSUE 18)."""
         if self.injector is not None:
             lat = self.injector.forward_latency(rep.name)
             if lat > 0:
@@ -473,9 +548,11 @@ class FleetRouter:
             if self.injector.is_dead(rep.name):
                 raise ConnectionError(
                     f"injected kill of replica {rep.name}")
+        headers = {"Content-Type": "application/json"}
+        if trace_id is not None:
+            headers["X-PBT-Trace"] = trace_id
         req = urllib.request.Request(
-            rep.url + path, data=raw_body,
-            headers={"Content-Type": "application/json"}, method="POST")
+            rep.url + path, data=raw_body, headers=headers, method="POST")
         try:
             with urllib.request.urlopen(
                     req, timeout=self.request_timeout_s) as resp:
@@ -539,9 +616,13 @@ class FleetRouter:
             self._outcome_c[outcome].inc()
             if outcome == "shed":
                 self._shed_c.inc()
+            # trace_id IS the router's request id (one id names the
+            # request end-to-end); replica_id mirrors `replica` under
+            # the uniform join key every fleet event carries.
             self.tele.emit("fleet_request", outcome=outcome, path=path,
                            replica=replica, retries=retries,
-                           status=status, request_id=rid)
+                           status=status, request_id=rid,
+                           trace_id=rid, replica_id=replica)
 
         try:
             return self._route_sealed(kind, path, raw_body, rid, seal)
@@ -551,7 +632,30 @@ class FleetRouter:
 
     def _route_sealed(self, kind: str, path: str, raw_body: bytes,
                       rid: str, seal) -> Tuple[int, bytes, Dict[str, str]]:
-        headers = {"X-PBT-Fleet-Request-Id": rid}
+        # X-PBT-Request-Id answers with the FLEET id on every response
+        # the router composes itself (shed/cache_hit/failed) — the same
+        # id the replica's propagated trace answers with on a forwarded
+        # 200, so clients read one header regardless of who replied.
+        headers = {"X-PBT-Fleet-Request-Id": rid,
+                   "X-PBT-Request-Id": rid}
+
+        def attempt(replica: str, outcome: str,
+                    status: Optional[int] = None,
+                    backoff_s: Optional[float] = None) -> None:
+            """One sibling attempt record under this trace (ISSUE 18):
+            `retries` at emit time IS the 0-based attempt index, so
+            attempts on record == retries spent + 1 — the accounting
+            invariant tests/test_fleet_trace.py audits."""
+            if not self.propagate_trace:
+                return
+            fields: Dict[str, Any] = {}
+            if status is not None:
+                fields["status"] = status
+            if backoff_s is not None:
+                fields["backoff_s"] = round(backoff_s, 6)
+            self.tele.emit("fleet_attempt", trace_id=rid,
+                           attempt=retries, replica=replica,
+                           outcome=outcome, path=path, **fields)
         try:
             body = json.loads(raw_body) if raw_body else None
         except ValueError:
@@ -589,7 +693,9 @@ class FleetRouter:
                 rep.inflight += 1
                 rep.requests_total += 1
             try:
-                status, resp = self._forward(rep, path, raw_body)
+                status, resp = self._forward(
+                    rep, path, raw_body,
+                    rid if self.propagate_trace else None)
                 transport_failure = False
             except (urllib.error.URLError, OSError) as e:
                 status, resp = 502, json.dumps(
@@ -613,15 +719,24 @@ class FleetRouter:
                             self._transition(rep, "dead",
                                              reason="forward_failed")
                 tried.add(rep.name)
+                failed_how = ("transport_failed" if transport_failure
+                              else "retryable")
                 # Spend a retry only when an untried candidate exists —
                 # a token burned on a guaranteed no_capacity would
                 # deplete the budget without buying a dispatch.
                 if self._has_candidate(tried) \
                         and self._try_spend_retry(retries):
-                    self._sleep(min(self.backoff_cap_s,
-                                    self.backoff_base_s * (2 ** retries)))
+                    wait = min(self.backoff_cap_s,
+                               self.backoff_base_s * (2 ** retries))
+                    # The backoff rides on the attempt a retry FOLLOWED:
+                    # the causal chain reads attempt(failed, waited W) →
+                    # attempt(next replica).
+                    attempt(rep.name, failed_how, status=status,
+                            backoff_s=wait)
+                    self._sleep(wait)
                     retries += 1
                     continue
+                attempt(rep.name, failed_how, status=status)
                 # Budget/cap/candidates exhausted: a replica 503 stays
                 # a typed shed; a transport failure surfaces as 502.
                 outcome = "failed" if transport_failure else "shed"
@@ -630,16 +745,19 @@ class FleetRouter:
 
             headers["X-PBT-Fleet-Replica"] = rep.name
             if status in SHED_STATUSES:
+                attempt(rep.name, "shed", status=status)
                 seal("shed", status, rep.name, retries)
                 return status, resp, headers
             if status == 200:
                 if key is not None:
                     self.cache.put(key, resp)
+                attempt(rep.name, "ok", status=status)
                 seal("retried_ok" if retries else "ok", status,
                      rep.name, retries)
                 return status, resp, headers
             # Replica answered with a non-retryable error (400/404/500):
             # pass it through, sealed as failed.
+            attempt(rep.name, "failed", status=status)
             seal("failed", status, rep.name, retries)
             return status, resp, headers
 
@@ -656,6 +774,161 @@ class FleetRouter:
             }
         out["cache"] = self.cache.stats()
         return out
+
+    # -------------------------------------------------- aggregation plane
+
+    def fleet_metrics(self) -> Dict[str, Any]:
+        """Scrape every replica's /metrics.json and merge into ONE
+        fleet view (the MLPerf aggregate-then-gate shape — ROADMAP 4's
+        autoscaler signal): counters SUMMED across replicas, gauges
+        kept per-replica (a mean of queue depths hides the hot one) by
+        re-labeling each key with `replica=`, histograms merged
+        (count/sum added, min/max combined), and quantile windows
+        merged over the CONCATENATED raw values — a fleet p99 is not
+        any function of per-replica p99s. Unreachable replicas are
+        skipped and listed under `missing` (a partial fleet view that
+        says so beats a hang)."""
+        from proteinbert_tpu.obs.metrics import nearest_rank
+
+        counters: Dict[str, float] = {}
+        gauges: Dict[str, Any] = {}
+        histograms: Dict[str, Dict[str, Any]] = {}
+        window_vals: Dict[str, List[float]] = {}
+        scraped: List[str] = []
+        missing: List[str] = []
+        for rep in self.replicas:
+            try:
+                with urllib.request.urlopen(
+                        rep.url + "/metrics.json",
+                        timeout=self.health_timeout_s) as r:
+                    payload = json.loads(r.read())
+                if not isinstance(payload, dict):
+                    raise ValueError("non-dict metrics payload")
+            except (urllib.error.URLError, OSError, ValueError):
+                missing.append(rep.name)
+                continue
+            scraped.append(rep.name)
+            snap = payload.get("snapshot") or {}
+            for k, v in (snap.get("counters") or {}).items():
+                if isinstance(v, (int, float)):
+                    counters[k] = counters.get(k, 0.0) + float(v)
+            for k, v in (snap.get("gauges") or {}).items():
+                gauges[_label_replica(k, rep.name)] = v
+            for k, h in (snap.get("histograms") or {}).items():
+                if not isinstance(h, dict) or not h.get("count"):
+                    continue
+                m = histograms.get(k)
+                if m is None:
+                    m = histograms[k] = {"count": 0, "sum": 0.0,
+                                         "min": None, "max": None}
+                m["count"] += int(h["count"])
+                m["sum"] += float(h.get("sum") or 0.0)
+                for side, pick in (("min", min), ("max", max)):
+                    v = h.get(side)
+                    if isinstance(v, (int, float)):
+                        m[side] = (float(v) if m[side] is None
+                                   else pick(m[side], float(v)))
+            for k, vals in (payload.get("windows") or {}).items():
+                if isinstance(vals, list):
+                    window_vals.setdefault(k, []).extend(
+                        float(v) for v in vals
+                        if isinstance(v, (int, float)))
+        windows = {}
+        for k, vals in window_vals.items():
+            vals.sort()
+            windows[k] = {
+                "n": len(vals),
+                "p50_s": (round(nearest_rank(vals, 0.50), 6)
+                          if vals else None),
+                "p99_s": (round(nearest_rank(vals, 0.99), 6)
+                          if vals else None),
+                "mean_s": (round(sum(vals) / len(vals), 6)
+                           if vals else None),
+            }
+        return {"replicas": scraped, "missing": missing,
+                "counters": counters, "gauges": gauges,
+                "histograms": histograms, "windows": windows}
+
+
+def _label_replica(key: str, replica: str) -> str:
+    """Append `replica="..."` to a registry key (`name` or
+    `name{l="v"}`) — how fleet_metrics keeps per-replica gauges apart
+    without inventing a second key syntax."""
+    name, sep, rest = key.partition("{")
+    if not sep:
+        return f'{name}{{replica="{replica}"}}'
+    inner = rest[:-1]
+    inner = f'{inner},replica="{replica}"' if inner \
+        else f'replica="{replica}"'
+    return f"{name}{{{inner}}}"
+
+
+class FleetCollector:
+    """The fleet event funnel (ISSUE 18): tails the router's and every
+    replica's event JSONL into ONE merged, seq-ordered stream keyed by
+    `trace_id` — the stream `pbt diagnose --fleet` reconstructs causal
+    chains from.
+
+    Reuses `obs/events.read_events` in tolerant mode, so a replica
+    SIGKILLed mid-write contributes everything up to its torn final
+    line (the drill's core scenario). Each record is stamped with its
+    source (`src`, `src_seq`) and a `replica_id` default (existing
+    stamps win — a fleet_request's serving-replica id is never
+    overwritten), then the merged stream is re-sequenced 0..N-1 so it
+    passes the same monotonic-seq validation as any single stream.
+    Ordering is (t, src, src_seq): wall-clock first, with per-source
+    emission order as the tiebreak inside one timestamp."""
+
+    def __init__(self, sources: Optional[Dict[str, str]] = None):
+        # name -> JSONL path; insertion order is irrelevant (merge
+        # sorts), uniqueness is not: one stream per name.
+        self.sources: Dict[str, str] = dict(sources or {})
+
+    def add_source(self, name: str, path: str) -> None:
+        self.sources[name] = path
+
+    def collect(self) -> List[Dict[str, Any]]:
+        from proteinbert_tpu.obs.events import read_events
+
+        merged: List[Dict[str, Any]] = []
+        for name in sorted(self.sources):
+            path = self.sources[name]
+            if not os.path.exists(path):
+                continue
+            for rec in read_events(path, strict=False):
+                rec = dict(rec)
+                rec["src"] = name
+                rec["src_seq"] = rec.get("seq", 0)
+                rec.setdefault("replica_id", name)
+                merged.append(rec)
+        merged.sort(key=lambda r: (r.get("t", 0.0), r["src"],
+                                   r["src_seq"]))
+        for i, rec in enumerate(merged):
+            rec["seq"] = i
+        return merged
+
+    @staticmethod
+    def seal_violations(records) -> Dict[str, int]:
+        """trace_id -> fleet_request seal count, for every trace sealed
+        != exactly once in the merged stream (empty == the exactly-once
+        invariant holds fleet-wide)."""
+        counts: Dict[str, int] = {}
+        for rec in records:
+            if rec.get("event") == "fleet_request":
+                tid = rec.get("trace_id") or rec.get("request_id")
+                if tid:
+                    counts[tid] = counts.get(tid, 0) + 1
+        return {tid: n for tid, n in counts.items() if n != 1}
+
+    def write(self, out_path: str) -> int:
+        """Collect + write the merged stream as JSONL; returns the
+        record count. Plain sequential write (no append contention —
+        the merge is a post-hoc pass, not a live tail)."""
+        records = self.collect()
+        with open(out_path, "w") as f:
+            for rec in records:
+                f.write(json.dumps(rec) + "\n")
+        return len(records)
 
 
 # ------------------------------------------------------------ HTTP front
@@ -701,6 +974,11 @@ def make_fleet_handler(router: FleetRouter):
             elif self.path == "/fleet/status":
                 self._reply(200, {"replicas": router.replica_status(),
                                   "stats": router.stats()})
+            elif self.path == "/fleet/metrics":
+                # The fleet-wide merged registry view (counters summed,
+                # gauges per-replica, windows percentile-merged) — the
+                # autoscaler/SLO-burn scrape point (ISSUE 18).
+                self._reply(200, router.fleet_metrics())
             elif self.path == "/metrics":
                 text = router.tele.metrics.prometheus_text() \
                     if getattr(router.tele, "metrics", None) is not None \

@@ -43,6 +43,13 @@ Routes (POST bodies and responses are JSON):
                                under "quant"
   GET  /metrics              → Prometheus textfile (the registry's
                                exposition; empty when telemetry is off)
+  GET  /metrics.json         → {"replica_id", "snapshot", "windows"} —
+                               the registry snapshot plus RAW quantile-
+                               window values, the machine-readable form
+                               the fleet router's /fleet/metrics
+                               aggregation scrapes (counters summed,
+                               gauges labeled by replica, windows
+                               merged value-by-value; ISSUE 18)
 
 Typed-error → status mapping (the backpressure contract, visible to
 clients): QueueFullError → 429, DeadlineExceededError → 504,
@@ -128,6 +135,21 @@ def make_handler(server: Server):
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+            elif self.path == "/metrics.json":
+                snapshot, windows = {}, {}
+                metrics = getattr(server.tele, "metrics", None)
+                if metrics is not None:
+                    if server.slo:
+                        server.slo.refresh_gauges()
+                    snapshot = metrics.snapshot()
+                    # Raw ring values (not just the summary): the
+                    # router merges fleet percentiles over the
+                    # CONCATENATED values — p99 of a fleet is not any
+                    # function of per-replica p99s.
+                    windows = metrics.window_values()
+                self._reply(200, {"replica_id": server.replica_id,
+                                  "snapshot": snapshot,
+                                  "windows": windows})
             else:
                 self._reply(404, {"error": f"no such route {self.path}"})
 
@@ -203,11 +225,16 @@ def make_handler(server: Server):
                     head_id = body["head_id"]
                     if not isinstance(head_id, str):
                         raise ValueError("'head_id' must be a string")
+                # Fleet-scope causal context (ISSUE 18): a router
+                # injects its minted trace id here; the trace joins it
+                # and X-PBT-Request-Id answers with the FLEET id, so
+                # one id names the request end-to-end across processes.
+                trace_id = self.headers.get("X-PBT-Trace")
                 future = server.submit(
                     kind, seq, annotations=body.get("annotations"),
                     deadline_s=(deadline_ms / 1000.0
                                 if deadline_ms is not None else None),
-                    top_k=top_k, head_id=head_id)
+                    top_k=top_k, head_id=head_id, trace_id=trace_id)
                 request_id = getattr(future, "pbt_request_id", None)
                 value = future.result()
             except UnknownHeadError as e:

@@ -66,6 +66,7 @@ class MicroBatchScheduler:
         complete_observer: Optional[
             Callable[[Request, str, float, Optional[BaseException],
                       Optional[dict]], None]] = None,
+        replica_id: Optional[str] = None,
     ):
         from proteinbert_tpu.obs import as_telemetry
 
@@ -75,6 +76,12 @@ class MicroBatchScheduler:
         self.max_batch = int(max_batch)
         self.max_wait_s = float(max_wait_s)
         self.clock = clock
+        # Fleet identity (ISSUE 18): stamped onto every serve_batch
+        # event so the fleet's merged stream can attribute batches to
+        # replicas without inferring identity from ports/paths.
+        self.replica_id = replica_id
+        self._replica_fields = (
+            {"replica_id": replica_id} if replica_id else {})
         # Multi-tenant grouping (ISSUE 8): requests group by
         # (kind, bucket) ONLY — all predict_task requests share the
         # kind "predict_task", so one micro-batch MIXES heads through
@@ -336,7 +343,8 @@ class MicroBatchScheduler:
                        rows=len(batch), batch_class=cls,
                        batch_seconds=round(dt, 6),
                        pad_fraction=ctx.get("pad_fraction"),
-                       heads=ctx.get("heads"), **quant_fields)
+                       heads=ctx.get("heads"), **quant_fields,
+                       **self._replica_fields)
         return len(batch)
 
     def poll(self, now: Optional[float] = None) -> int:
@@ -459,13 +467,14 @@ class PackedBatchScheduler(MicroBatchScheduler):
         latency_observer: Optional[Callable[[float], None]] = None,
         expire_observer: Optional[Callable[[Request], None]] = None,
         complete_observer=None,
+        replica_id: Optional[str] = None,
     ):
         super().__init__(
             queue, dispatcher, finalize, max_batch=rows_per_batch,
             max_wait_s=max_wait_s, clock=clock, partition_heads=False,
             telemetry=telemetry, latency_observer=latency_observer,
             expire_observer=expire_observer,
-            complete_observer=complete_observer)
+            complete_observer=complete_observer, replica_id=replica_id)
         # Lazy import: data/packing pulls the dataset module, which the
         # pure-logic scheduler tests (stub dispatchers) need not load.
         from proteinbert_tpu.data.packing import OnlinePacker
@@ -681,7 +690,8 @@ class PackedBatchScheduler(MicroBatchScheduler):
                        segments=n_riders,
                        segments_per_row=ctx["segments_per_row"],
                        mode="ragged",
-                       heads=ctx.get("heads"), **quant_fields)
+                       heads=ctx.get("heads"), **quant_fields,
+                       **self._replica_fields)
         return n_riders
 
     def fail_pending(self, exc: Exception) -> List[Request]:

@@ -127,6 +127,7 @@ class Server:
         quant_parity_every: Optional[int] = None,
         index=None,
         nprobe: int = 8,
+        replica_id: Optional[str] = None,
     ):
         from proteinbert_tpu.obs import as_telemetry
 
@@ -152,6 +153,11 @@ class Server:
             quant_parity_every = getattr(serve_cfg,
                                          "quant_parity_every", 0)
         self.quant = quant
+        # Fleet identity (ISSUE 18): a stable name the fleet assigns at
+        # spawn (`pbt serve --replica-id r0`). Stamped onto every
+        # serve_request/serve_batch event so fleet joins key on an
+        # explicit identity, never an inferred port.
+        self.replica_id = replica_id
         self.tele = as_telemetry(telemetry)
         metrics = self.tele.metrics
         self.cache = EmbeddingCache(cache_size, metrics=metrics)
@@ -183,7 +189,7 @@ class Server:
                 self.queue, self.dispatcher, self._finalize,
                 rows_per_batch=max_batch, max_wait_s=max_wait_s,
                 clock=clock, max_segments=pack_max_segments,
-                telemetry=telemetry,
+                telemetry=telemetry, replica_id=replica_id,
                 latency_observer=self._observe_latency,
                 expire_observer=self._count_expiry,
                 complete_observer=self._on_complete)
@@ -196,7 +202,7 @@ class Server:
                 self.queue, self.dispatcher, self._finalize,
                 max_batch=max_batch, max_wait_s=max_wait_s, clock=clock,
                 partition_heads=partition_heads,
-                telemetry=telemetry,
+                telemetry=telemetry, replica_id=replica_id,
                 latency_observer=self._observe_latency,
                 expire_observer=self._count_expiry,
                 complete_observer=self._on_complete)
@@ -401,6 +407,7 @@ class Server:
             "neighbor_index": (self.index.digest
                                if self.index is not None else None),
             "nprobe": self.nprobe if self.index is not None else None,
+            "replica_id": self.replica_id,
         })
         self.scheduler.start()
         self._started = True
@@ -527,9 +534,12 @@ class Server:
     def submit(self, kind: str, seq: str, annotations=None,
                deadline_s: Optional[float] = None,
                top_k: Optional[int] = None,
-               head_id: Optional[str] = None) -> Future:
+               head_id: Optional[str] = None,
+               trace_id: Optional[str] = None) -> Future:
         """Enqueue one request; returns its future (which carries the
-        trace id as `.pbt_request_id` when tracing is on). Raises
+        trace id as `.pbt_request_id` when tracing is on — the FLEET
+        id when a router propagated one via `trace_id`, so one id
+        names the request end-to-end across processes). Raises
         SequenceTooLongError (on_long="reject", or a '?' beyond the
         window for predict_residues), UnknownHeadError (predict_task
         for an unregistered/removed head — the typed 404), and
@@ -558,6 +568,10 @@ class Server:
             trace = RequestTrace(
                 f"{self._id_prefix}{n:x}", kind, now0,
                 sampled=stride_sampled(n, self.trace_sample_rate))
+            # Join the propagated fleet context (ISSUE 18): the
+            # router-minted id becomes this trace's trace_id/parent,
+            # and the replica identity rides every emitted event.
+            trace.join(trace_id, self.replica_id)
             trace.head_id = head_id
             # Which executable arm will serve this request (`quant` on
             # serve_request events — the per-request A/B attribution
@@ -579,7 +593,7 @@ class Server:
                 self._seal(trace, "rejected", self.clock(),
                            kind=kind)
                 if trace is not None:
-                    exc.pbt_request_id = trace.request_id
+                    exc.pbt_request_id = trace.public_id()
                 raise
         window = self.cfg.data.seq_len - 2
         if len(seq) > window:
@@ -603,7 +617,7 @@ class Server:
                     # Synchronous rejections carry the trace id on the
                     # exception: the HTTP layer still answers with an
                     # X-PBT-Request-Id pinning the rejection's trace.
-                    exc.pbt_request_id = trace.request_id
+                    exc.pbt_request_id = trace.public_id()
                 raise exc
             # The process-wide inference.TRUNCATED_TOTAL is bumped by
             # _tokenize_masked below (cache hits skip tokenization and
@@ -616,7 +630,7 @@ class Server:
         self._req_c[kind].inc()
         future: Future = Future()
         if trace is not None:
-            future.pbt_request_id = trace.request_id
+            future.pbt_request_id = trace.public_id()
         key = None
         if self.cache.capacity:
             if trace is not None:
@@ -668,7 +682,7 @@ class Server:
                            queue_depth=len(self.queue))
             self._seal(trace, "rejected", self.clock(), kind=kind)
             if trace is not None:
-                exc.pbt_request_id = trace.request_id
+                exc.pbt_request_id = trace.public_id()
             raise
         if evicted:
             now2 = self.clock()

@@ -77,6 +77,7 @@ class RequestTrace:
         "bucket_len", "batch_class", "rows", "pad_fraction",
         "prep_s", "device_s", "cache", "outcome", "error", "head_id",
         "segments", "segments_per_row", "mode", "quant",
+        "trace_id", "parent", "replica_id",
     )
 
     def __init__(self, request_id: str, kind: str, now: float,
@@ -117,6 +118,33 @@ class RequestTrace:
         # Quantized executable arm (ISSUE 12): "int8"/"int8_act" when
         # a quantized executable served this request, None on fp32.
         self.quant: Optional[str] = None
+        # Fleet-scope causal context (ISSUE 18): `trace_id` is the
+        # router-minted id this request joined via the X-PBT-Trace
+        # header (None = self-rooted, standalone server), `parent` the
+        # enclosing fleet request's id (== trace_id in the current
+        # two-level router→replica topology), `replica_id` the serving
+        # process's --replica-id identity. All ride the serve_request
+        # event so the fleet collector can join cross-process records
+        # without inferring identity from ports.
+        self.trace_id: Optional[str] = None
+        self.parent: Optional[str] = None
+        self.replica_id: Optional[str] = None
+
+    def join(self, trace_id: Optional[str],
+             replica_id: Optional[str] = None) -> None:
+        """Adopt a propagated fleet-scope trace context (no-ops on
+        None): after joining, public_id() answers with the FLEET id —
+        the X-PBT-Request-Id value clients see end-to-end."""
+        if trace_id:
+            self.trace_id = trace_id
+            self.parent = trace_id
+        if replica_id:
+            self.replica_id = replica_id
+
+    def public_id(self) -> str:
+        """The id this request answers to externally: the fleet-scope
+        trace id when joined, the local request id when self-rooted."""
+        return self.trace_id or self.request_id
 
     # ------------------------------------------------------------ marks
 
@@ -251,7 +279,8 @@ class RequestTrace:
         }
         for name in ("bucket_len", "batch_class", "rows", "pad_fraction",
                      "prep_s", "device_s", "error", "head_id",
-                     "segments", "segments_per_row", "mode", "quant"):
+                     "segments", "segments_per_row", "mode", "quant",
+                     "trace_id", "parent", "replica_id"):
             v = getattr(self, name)
             if v is not None:
                 fields[name] = v
@@ -273,6 +302,8 @@ class RequestTrace:
             base_args["batch_class"] = self.batch_class
         if self.error is not None:
             base_args["error"] = self.error
+        if self.trace_id is not None:
+            base_args["trace_id"] = self.trace_id
         collector.add("serve.request", self.wall0, self.e2e_s(),
                       depth=0, tid=tid, **base_args)
         for name, t0, t1 in self._segments():

@@ -211,12 +211,18 @@ _EVENT_METRICS = (
     ("neighbors_capture", "neighbors_qps", "neighbors_qps"),
     ("neighbors_capture", "neighbors_recall_at_10",
      "neighbors_recall_at_10"),
+    # Fleet trace propagation (ISSUE 18, bench --serve fleet arm): the
+    # matched propagation-on vs propagation-off fleet throughput delta
+    # as a percentage. LOWER is better — creep here means stamping the
+    # trace context onto every routed request got more expensive.
+    ("fleet_trace_capture", "fleet_trace_overhead_pct",
+     "fleet_trace_overhead_pct"),
 )
 
 # Series (by base name, before the /platform suffix) where a LOWER
 # value is the good direction — ratios and error bounds.
 _LOWER_IS_BETTER = {"comm_bytes_int8_ratio", "serve_quant_parity_max",
-                    "check_findings_total"}
+                    "check_findings_total", "fleet_trace_overhead_pct"}
 
 
 def series_direction(name: str) -> bool:

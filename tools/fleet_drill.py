@@ -27,7 +27,19 @@ Gates (exit nonzero on violation — tier-1 runs this as a smoke stage):
   - failover actually happened: retried_ok >= 1 and the victim's
     stream shows aborted/rejected seals;
   - every router/replica event record round-trips the schema
-    validator; no request_id seals twice within a stream.
+    validator; no request_id seals twice within a stream;
+  - fleet tracing (ISSUE 18): every client 200 carries an
+    X-PBT-Request-Id naming a sealed trace; the MERGED stream
+    (FleetCollector over router + every replica) is schema-valid and
+    re-sequenced 0..N-1 with exactly-once sealing and attempts ==
+    retries + 1 per trace; a request whose FIRST attempt died on the
+    killed victim reconstructs as one COMPLETE causal chain via a
+    `pbt diagnose --fleet --trace-id` subprocess over the merged
+    stream alone;
+  - grey failure: with one replica answering health checks SLOWLY
+    (injector.set_health_latency), the health loop keeps visiting
+    every replica (scrape counts advance — no starvation), measured
+    by the fleet_health_scrape_seconds histogram.
 
 Latency/shed ratios are reported, not gated (a 1-core CI box is noisy).
 
@@ -92,7 +104,7 @@ class LocalReplica:
         self.server = Server(
             params, cfg, buckets=BUCKETS, max_batch=4, max_wait_s=0.005,
             queue_depth=64, cache_size=256, telemetry=self.tele,
-            trace_sample_rate=1.0)
+            trace_sample_rate=1.0, replica_id=name)
         self.server.start()
         self.httpd = make_http_server(self.server, "127.0.0.1", 0)
         self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
@@ -120,17 +132,21 @@ class LocalReplica:
 
 
 def _post(url: str, payload: dict, timeout: float = 60.0):
+    """POST returning (status, body, fleet id) — the X-PBT-Request-Id
+    header is the trace id `pbt diagnose --fleet --trace-id` takes."""
     req = urllib.request.Request(
         url, data=json.dumps(payload).encode(),
         headers={"Content-Type": "application/json"}, method="POST")
     try:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
-            return resp.status, json.loads(resp.read())
+            return (resp.status, json.loads(resp.read()),
+                    resp.headers.get("X-PBT-Request-Id"))
     except urllib.error.HTTPError as e:
+        rid = e.headers.get("X-PBT-Request-Id") if e.headers else None
         try:
-            return e.code, json.loads(e.read())
+            return e.code, json.loads(e.read()), rid
         except ValueError:
-            return e.code, None
+            return e.code, None, rid
 
 
 def run_drill(args) -> dict:
@@ -193,8 +209,7 @@ def run_drill(args) -> dict:
     def client(worker: int):
         for i in range(worker, args.requests, args.clients):
             path, payload = payloads[i]
-            status, body = _post(base + path, payload)
-            results[i] = (status, body)
+            results[i] = _post(base + path, payload)
             with done_lock:
                 done_count[0] += 1
 
@@ -230,6 +245,28 @@ def run_drill(args) -> dict:
         if st[torn.name] in ("up", "degraded"):
             break
         time.sleep(0.05)
+
+    # Grey-failure window (ISSUE 18): one replica answers health
+    # checks SLOWLY (not dead, not torn — the failure mode health
+    # binaries miss). The health loop must keep visiting EVERY
+    # replica: scrape counts all advance across the window, and the
+    # slow replica's latency lands in fleet_health_scrape_seconds.
+    grey_failures = []
+    injector.set_health_latency(torn.name, 0.35)
+    before = {name: h.count for name, h in router._scrape_h.items()}
+    time.sleep(1.6)  # several sweeps even at ~0.35s+interval each
+    after = {name: h.count for name, h in router._scrape_h.items()}
+    injector.set_health_latency(torn.name, 0.0)
+    starved = sorted(n for n in before if after[n] <= before[n])
+    if starved:
+        grey_failures.append(
+            f"health loop starved under a slow replica: no new scrape "
+            f"of {starved} during the grey window")
+    slow_max = router._scrape_h[torn.name].max
+    if slow_max < 0.3:
+        grey_failures.append(
+            f"fleet_health_scrape_seconds never measured the injected "
+            f"0.35s health latency (max {slow_max:.3f}s)")
 
     httpd.shutdown()
     httpd.server_close()
@@ -308,6 +345,125 @@ def run_drill(args) -> dict:
             victim_aborted = sum(1 for x in seals
                                  if x["outcome"] in ("aborted", "error"))
 
+    failures.extend(grey_failures)
+
+    # ------------------------------------ fleet trace plane (ISSUE 18)
+    from proteinbert_tpu.obs.diagnose import summarize_fleet
+    from proteinbert_tpu.serve.fleet import FleetCollector
+
+    # Every client 200 must carry the fleet id, and every id a client
+    # saw must name a sealed trace — one id end-to-end.
+    sealed_ids = {r.get("trace_id") or r.get("request_id")
+                  for r in freqs}
+    no_header = sum(1 for r in results if r and r[0] == 200 and not r[2])
+    if no_header:
+        failures.append(f"{no_header} client 200s carried no "
+                        "X-PBT-Request-Id header")
+    unknown_ids = sorted({r[2] for r in results if r and r[2]}
+                         - sealed_ids)
+    if unknown_ids:
+        failures.append(f"client-visible fleet ids never sealed: "
+                        f"{unknown_ids[:5]}")
+
+    # One merged, seq-ordered fleet stream: router + every replica
+    # through the torn-tail-tolerant reader, re-sequenced 0..N-1.
+    collector = FleetCollector({"router": router_events})
+    for r in replicas:
+        collector.add_source(r.name, r.events_path)
+    merged_path = os.path.join(outdir, "merged.events.jsonl")
+    merged_n = collector.write(merged_path)
+    merged = read_events(merged_path, strict=True)
+    if len(merged) != merged_n:
+        failures.append(f"merged stream re-read {len(merged)} of "
+                        f"{merged_n} written records")
+    for i, rec in enumerate(merged):
+        try:
+            validate_record(rec)
+        except ValueError as e:
+            failures.append(f"merged stream schema break at record "
+                            f"{i}: {e}")
+            break
+    if [r["seq"] for r in merged] != list(range(len(merged))):
+        failures.append("merged stream seq is not a dense 0..N-1 "
+                        "re-sequencing")
+    viol = FleetCollector.seal_violations(merged)
+    if viol:
+        failures.append(f"exactly-once sealing broke in the merged "
+                        f"stream: {dict(list(viol.items())[:5])}")
+    fsum = summarize_fleet(merged)
+    if fsum["attempt_mismatches"]:
+        failures.append(f"attempts != retries + 1 for traces "
+                        f"{fsum['attempt_mismatches'][:5]}")
+    if fsum["incomplete"]:
+        failures.append(f"incomplete causal chains in the merged "
+                        f"stream: {fsum['incomplete'][:5]}")
+
+    # The headline gate: a request whose attempt DIED on the killed
+    # victim must reconstruct as one complete causal chain — via the
+    # actual CLI subprocess, from the merged stream ALONE.
+    attempts_by_tid: dict = {}
+    for rec in merged:
+        if rec["event"] == "fleet_attempt":
+            attempts_by_tid.setdefault(rec["trace_id"], []).append(rec)
+    victim_tid = None
+    for rec in freqs:
+        if rec.get("outcome") != "retried_ok":
+            continue
+        atts = sorted(attempts_by_tid.get(rec.get("trace_id"), []),
+                      key=lambda a: a["attempt"])
+        if (atts and atts[-1]["outcome"] == "ok"
+                and any(a["replica"] == victim.name
+                        and a["outcome"] in ("transport_failed",
+                                             "retryable")
+                        for a in atts)):
+            victim_tid = rec["trace_id"]
+            break
+    chain = None
+    if victim_tid is None:
+        failures.append(
+            "no retried_ok trace with a failed attempt on the killed "
+            "victim — the reconstruction gate never ran")
+    else:
+        import subprocess
+
+        perfetto_path = os.path.join(outdir, "fleet_trace.json")
+        proc = subprocess.run(
+            [sys.executable, "-m", "proteinbert_tpu", "diagnose",
+             merged_path, "--fleet", "--trace-id", victim_tid,
+             "--trace-perfetto", perfetto_path, "--json"],
+            capture_output=True, text=True, timeout=120, cwd=REPO)
+        if proc.returncode != 0:
+            failures.append(f"pbt diagnose --fleet subprocess failed "
+                            f"rc={proc.returncode}: "
+                            f"{proc.stderr.strip()[:300]}")
+        else:
+            # --trace-perfetto logs a line before the JSON summary.
+            chain = json.loads(
+                proc.stdout.strip().splitlines()[-1])["fleet"].get(
+                "chain")
+            if chain is None:
+                failures.append(f"diagnose found no chain for trace "
+                                f"{victim_tid} in the merged stream")
+            elif not chain["complete"]:
+                failures.append(f"trace {victim_tid} reconstructed "
+                                f"INCOMPLETE: {chain}")
+            elif not any(a["replica"] == victim.name
+                         for a in chain["attempts"]):
+                failures.append(f"reconstructed chain for {victim_tid} "
+                                "lost the victim attempt")
+            elif chain["attempts"][-1]["serve"] is None:
+                failures.append(
+                    f"winning attempt of {victim_tid} joined no "
+                    "replica-side serve_request (stage tiling missing)")
+            with open(perfetto_path) as f:
+                lanes = {e.get("tid") for e in
+                         json.load(f)["traceEvents"]
+                         if e.get("ph") == "X"}
+            if len(lanes) < 3:
+                failures.append(
+                    f"cross-process Perfetto export has {len(lanes)} "
+                    "lane(s); want router + one per attempt (>= 3)")
+
     summary = {
         "requests": args.requests,
         "clients": args.clients,
@@ -321,6 +477,16 @@ def run_drill(args) -> dict:
         "replica_states_seen": sorted(set(states_seen)),
         "cache": stats["cache"],
         "outdir": outdir,
+        "merged_stream": merged_path,
+        "merged_records": merged_n,
+        "traces": fsum["traces"],
+        "attempts_recorded": fsum["attempts_recorded"],
+        "reconstructed_trace": victim_tid,
+        "reconstructed_attempts": (len(chain["attempts"])
+                                   if chain else None),
+        "health_scrapes_in_grey_window": {
+            n: after[n] - before[n] for n in sorted(after)},
+        "slow_health_scrape_max_s": round(slow_max, 3),
         "failures": failures,
         "ok": not failures,
     }
@@ -354,7 +520,11 @@ def main(argv=None) -> int:
     print(f"fleet drill OK: {summary['requests']} accepted, all sealed "
           f"exactly once ({summary['router']['outcomes']}), victim "
           f"{summary['victim']} killed mid-request, "
-          f"{summary['router']['retries_spent']} retries",
+          f"{summary['router']['retries_spent']} retries; merged "
+          f"{summary['merged_records']} records across "
+          f"{summary['traces']} traces, killed-victim trace "
+          f"{summary['reconstructed_trace']} reconstructed with "
+          f"{summary['reconstructed_attempts']} attempts",
           file=sys.stderr)
     return 0
 

@@ -262,6 +262,49 @@ NEGATIVE_CASES = [
          "source": "bench", "kind": "neighbors_capture",
          "neighbors_qps": 5000.0, "neighbors_recall_at_10": 0.97,
          "index_bytes_ratio": -0.3},  # typed when present
+        # fleet-scope causal tracing (ISSUE 18): the propagated trace
+        # context is optional but TYPED on every carrier event, and
+        # fleet_attempt (one sibling record per router try) is fully
+        # constrained — the fleet drill audits the MERGED stream with
+        # this validator, so a propagation bug must fail here.
+        {"v": 1, "event": "serve_request", "seq": 0, "t": 0.0,
+         "kind": "embed", "outcome": "ok", "request_id": "r1",
+         "stages": {}, "trace_id": 17},  # trace_id must be a string
+        {"v": 1, "event": "serve_request", "seq": 0, "t": 0.0,
+         "kind": "embed", "outcome": "ok", "request_id": "r1",
+         "stages": {}, "replica_id": 0},  # replica_id must be a string
+        {"v": 1, "event": "serve_batch", "seq": 0, "t": 0.0,
+         "kind": "embed", "bucket_len": 256, "rows": 4,
+         "replica_id": ["r0"]},  # replica_id must be a string
+        {"v": 1, "event": "fleet_request", "seq": 0, "t": 0.0,
+         "outcome": "ok", "path": "/v1/embed",
+         "trace_id": 3.5},  # trace_id must be a string
+        {"v": 1, "event": "fleet_attempt", "seq": 0, "t": 0.0,
+         "trace_id": "f1-1", "attempt": 0, "replica": "r0",
+         "outcome": "vanished"},  # not an attempt outcome
+        {"v": 1, "event": "fleet_attempt", "seq": 0, "t": 0.0,
+         "trace_id": "f1-1", "attempt": -1, "replica": "r0",
+         "outcome": "ok"},  # attempt index must be >= 0
+        {"v": 1, "event": "fleet_attempt", "seq": 0, "t": 0.0,
+         "trace_id": "f1-1", "attempt": 0, "replica": "r0",
+         "outcome": "retryable", "status": 42},  # not an HTTP status
+        {"v": 1, "event": "fleet_attempt", "seq": 0, "t": 0.0,
+         "trace_id": "f1-1", "attempt": 0, "replica": "r0",
+         "outcome": "retryable", "backoff_s": -0.02},  # wait >= 0
+        {"v": 1, "event": "fleet_attempt", "seq": 0, "t": 0.0,
+         "trace_id": 99, "attempt": 0, "replica": "r0",
+         "outcome": "ok"},  # trace_id must be a string
+        # the fleet_trace_capture note (bench --serve fleet A/B arm):
+        # the propagation-overhead sentinel's input, typed + required.
+        {"v": 1, "event": "note", "seq": 0, "t": 0.0,
+         "source": "bench", "kind": "fleet_trace_capture"},  # no pct
+        {"v": 1, "event": "note", "seq": 0, "t": 0.0,
+         "source": "bench", "kind": "fleet_trace_capture",
+         "fleet_trace_overhead_pct": float("nan")},  # finite
+        {"v": 1, "event": "note", "seq": 0, "t": 0.0,
+         "source": "bench", "kind": "fleet_trace_capture",
+         "fleet_trace_overhead_pct": 0.4,
+         "fleet_rps_on": 0.0},  # throughput must be > 0 when present
 ]
 
 

@@ -1273,6 +1273,7 @@ def cmd_serve(args) -> int:
             pack_max_segments=args.pack_max_segments,
             quant=args.quant,
             quant_parity_every=args.quant_parity_every,
+            pipeline_depth=args.pipeline_depth,
             index=index,
             nprobe=args.nprobe,
             replica_id=args.replica_id,
@@ -1431,7 +1432,8 @@ def cmd_map(args) -> int:
             num_shards=args.num_shards, block_size=args.block_size,
             rows_per_batch=args.rows_per_batch,
             max_segments=args.max_segments, buckets=buckets,
-            telemetry=tele, max_blocks=args.max_blocks)
+            telemetry=tele, max_blocks=args.max_blocks,
+            pipeline=not args.no_pipeline)
     except (StoreError, ValueError) as e:
         raise SystemExit(f"map failed: {e}")
     finally:
@@ -2233,6 +2235,15 @@ def build_parser() -> argparse.ArgumentParser:
                          "stats()['quant'], serve_batch events). "
                          "0 disables. Default: the run config's "
                          "serve.quant_parity_every")
+    sv.add_argument("--pipeline-depth", type=int, default=None,
+                    metavar="N",
+                    help="bounded in-flight dispatch window (ISSUE 19, "
+                         "docs/serving.md Pipelined dispatch): up to N "
+                         "batches submitted before the scheduler blocks; "
+                         "a completer thread resolves device results "
+                         "while the next batch forms. 1 = serial "
+                         "(pre-pipeline) dispatch. Default: the run "
+                         "config's serve.pipeline_depth (2 unless set)")
     sv.add_argument("--index",
                     help="neighbor-index directory (pbt index) to "
                          "serve /v1/neighbors from: query sequences "
@@ -2298,6 +2309,12 @@ def build_parser() -> argparse.ArgumentParser:
     mp.add_argument("--max-blocks", type=int,
                     help="stop (resumably, exit 75) after this many "
                          "blocks this invocation — smoke/drill knob")
+    mp.add_argument("--no-pipeline", action="store_true",
+                    help="disable pipelined dispatch (ISSUE 19): run "
+                         "device compute → host fetch → commit strictly "
+                         "serially per block instead of keeping one "
+                         "block in flight. Same bytes either way — this "
+                         "is the A/B knob, not a safety valve")
     mp.add_argument("--events-jsonl", type=creatable_path,
                     help="append map_start/map_shard/map_block/map_end "
                          "events here (pbt diagnose --map reads them); "

@@ -244,10 +244,18 @@ class ServeConfig:
       records the per-request max-abs output deviation
       (`serve_quant_parity_max` gauge, stats()["quant"], serve_batch
       events) — live parity evidence at 1/N the cost. 0 disables.
+    pipeline_depth: bounded in-flight window for pipelined dispatch
+      (ISSUE 19): the scheduler submits up to this many batches before
+      blocking, and a completer thread resolves device results while
+      the next batch forms — device compute overlaps host fetch +
+      fan-out. 1 disables the completer and restores the serial
+      submit-then-finalize path bit-for-bit. Overridable per serve
+      process via `pbt serve --pipeline-depth`.
     """
 
     quant: str = "fp32"                     # "fp32" | "int8" | "int8_act"
     quant_parity_every: int = 0
+    pipeline_depth: int = 2
 
 
 @dataclasses.dataclass(frozen=True)

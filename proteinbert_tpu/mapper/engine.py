@@ -72,14 +72,22 @@ def poison_reason(seq: Any) -> Optional[str]:
     return None
 
 
-def _embed_block(params, cfg, ids: Sequence[str], seqs: Sequence[str],
-                 rows_per_batch: int, max_segments: int,
-                 buckets: Sequence[int]) -> Dict[str, Any]:
-    """One block through the ragged packed trunk: first-fit-pack the
-    block's sequences into (rows_per_batch, seq_len) rows and run
-    `inference._packed_encode_batch` per fixed-shape batch (ONE warm
-    executable for the whole run), scattering the per-segment outputs
-    back to corpus order.
+def _embed_block_submit(params, cfg, ids: Sequence[str],
+                        seqs: Sequence[str], rows_per_batch: int,
+                        max_segments: int, buckets: Sequence[int]):
+    """Submit one block through the ragged packed trunk and return a
+    `fetch()` closure for its host-side materialization (ISSUE 19 —
+    pipelined dispatch).
+
+    First-fit-packs the block's sequences into (rows_per_batch,
+    seq_len) rows and ENQUEUES `inference._packed_encode_batch` per
+    fixed-shape batch (ONE warm executable for the whole run). JAX
+    dispatch is async: this returns as soon as every chunk is enqueued,
+    holding unmaterialized device arrays — the device computes while
+    the caller does other host work. `fetch()` performs the blocking
+    device→host transfers and scatters the per-segment outputs back to
+    corpus order, returning the same arrays dict `_embed_block` always
+    produced.
 
     Spans follow the ragged SERVING rule (serve/dispatch.
     RaggedDispatcher): each sequence occupies its bucket-quantized span
@@ -88,7 +96,8 @@ def _embed_block(params, cfg, ids: Sequence[str], seqs: Sequence[str],
     surfaces within the documented jitted ≤1e-5 tolerance instead of
     being a third numerics regime (tests/test_mapper.py proves the
     parity). Deterministic in its inputs — the property the
-    byte-identical-store contract rides on."""
+    byte-identical-store contract rides on; submit/fetch split or not,
+    the numbers are the same device computation."""
     import jax.numpy as jnp
 
     from proteinbert_tpu import inference
@@ -108,7 +117,7 @@ def _embed_block(params, cfg, ids: Sequence[str], seqs: Sequence[str],
 
     n = len(seqs)
     A = cfg.model.num_annotations
-    out_global = out_local = None
+    pending = []
     for chunk_start in range(0, len(rows), rows_per_batch):
         chunk = rows[chunk_start:chunk_start + rows_per_batch]
         tok = np.zeros((rows_per_batch, seq_len), np.int32)
@@ -121,25 +130,41 @@ def _embed_block(params, cfg, ids: Sequence[str], seqs: Sequence[str],
         res = inference._packed_encode_batch(
             params, jnp.asarray(tok), jnp.asarray(seg),
             jnp.asarray(ann), cfg.model)
-        g = np.asarray(res["global"])
-        lm = np.asarray(res["local_mean"])
-        if out_global is None:
-            out_global = np.zeros((n, g.shape[-1]), np.float32)
-            out_local = np.zeros((n, lm.shape[-1]), np.float32)
-        for r, row in enumerate(chunk):
-            for s, (pos, _start, _span) in enumerate(row):
-                out_global[pos] = g[r, s]
-                out_local[pos] = lm[r, s]
-    if out_global is None:  # every record in the block was quarantined
-        out_global = np.zeros((0, 1), np.float32)
-        out_local = np.zeros((0, 1), np.float32)
-    # Explicit UTF-8: np.array(dtype="S") on str raises for non-ASCII
-    # ids (any real-world FASTA header can carry one), and an id must
-    # never be able to kill a run — bytes round-trip losslessly through
-    # iter_embeddings' .decode().
-    return {"ids": np.array([str(i).encode("utf-8") for i in ids]),
-            "lengths": lengths, "global": out_global,
-            "local_mean": out_local}
+        pending.append((chunk, res))
+
+    def fetch() -> Dict[str, Any]:
+        out_global = out_local = None
+        for chunk, res in pending:
+            g = np.asarray(res["global"])
+            lm = np.asarray(res["local_mean"])
+            if out_global is None:
+                out_global = np.zeros((n, g.shape[-1]), np.float32)
+                out_local = np.zeros((n, lm.shape[-1]), np.float32)
+            for r, row in enumerate(chunk):
+                for s, (pos, _start, _span) in enumerate(row):
+                    out_global[pos] = g[r, s]
+                    out_local[pos] = lm[r, s]
+        if out_global is None:  # every record was quarantined
+            out_global = np.zeros((0, 1), np.float32)
+            out_local = np.zeros((0, 1), np.float32)
+        # Explicit UTF-8: np.array(dtype="S") on str raises for
+        # non-ASCII ids (any real-world FASTA header can carry one),
+        # and an id must never be able to kill a run — bytes round-trip
+        # losslessly through iter_embeddings' .decode().
+        return {"ids": np.array([str(i).encode("utf-8") for i in ids]),
+                "lengths": lengths, "global": out_global,
+                "local_mean": out_local}
+
+    return fetch
+
+
+def _embed_block(params, cfg, ids: Sequence[str], seqs: Sequence[str],
+                 rows_per_batch: int, max_segments: int,
+                 buckets: Sequence[int]) -> Dict[str, Any]:
+    """One block, synchronously: submit + immediate fetch (the
+    pre-pipeline entry, kept for parity tests and in-process callers)."""
+    return _embed_block_submit(params, cfg, ids, seqs, rows_per_batch,
+                               max_segments, buckets)()
 
 
 def run_map(
@@ -159,6 +184,7 @@ def run_map(
     backoff_cap_s: float = 2.0,
     max_blocks: Optional[int] = None,
     stop_flag=None,
+    pipeline: bool = True,
 ) -> Dict[str, Any]:
     """Map the corpus into `store_dir`; resumes automatically from the
     shard cursors it finds there. Returns a stats dict whose "outcome"
@@ -166,7 +192,18 @@ def run_map(
     "halted" | "error"). `max_blocks` bounds the blocks processed THIS
     invocation (outcome "preempted" when work remains — the smoke/test
     resume seam). `stop_flag` (callable → bool) replaces the default
-    SIGTERM/SIGINT GracefulShutdown for in-process callers."""
+    SIGTERM/SIGINT GracefulShutdown for in-process callers.
+
+    `pipeline` (ISSUE 19) keeps ONE block in flight: block N+1's device
+    compute is submitted before block N's host fetch + `commit_block`
+    (object write, fsync, cursor advance), so the device stays fed
+    through the durability I/O. Commit ORDER is strictly preserved —
+    the cursor remains the commit point and never advances past an
+    unfetched block, so the crash-window taxonomy and the
+    byte-identical-resume contract (tools/map_drill.py) are unchanged;
+    the new `block_fetched` crash point covers the device-complete-but-
+    uncommitted window the split adds. False restores strictly serial
+    compute → fetch → commit per block."""
     if block_size < 1:
         raise ValueError(f"block_size must be >= 1, got {block_size}")
     if rows_per_batch < 1:
@@ -234,7 +271,13 @@ def run_map(
         st = {"shard": shard, "lo": lo, "hi": hi, "state": state,
               "cursor": cursor, "next": nxt, "halted": False,
               "failed": False, "tail_dropped": info["tail_dropped"],
-              "rework": rework}
+              "rework": rework,
+              # Optimistic submit-side counters (ISSUE 19): where the
+              # NEXT submit starts, ahead of the committed `next` /
+              # `state["blocks"]` by at most the one in-flight block.
+              # Single-threaded — only the run_map driver touches them.
+              "pending_next": nxt,
+              "pending_blocks": len(state["blocks"])}
         shards.append(st)
         is_resume = info["source"] != "fresh" or nxt > 0
         if state["done"]:
@@ -265,14 +308,19 @@ def run_map(
     budget = [max(retry_budget_floor,
                   int(retry_budget_ratio * total_blocks))]
     stats = {"blocks": 0, "seqs": 0, "quarantined": 0, "retries": 0,
-             "rework": sum(s["rework"] for s in shards)}
+             "rework": sum(s["rework"] for s in shards),
+             "commit_s": 0.0, "overlap_s": 0.0}
     t_run0 = time.perf_counter()
 
-    def process_block(st: Dict[str, Any]) -> None:
+    def submit_block(st: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        """Submit one block's device compute and return its in-flight
+        record, or None when the shard failed at submit (retries
+        exhausted). Advances the shard's OPTIMISTIC counters only —
+        `next`/`state` move at commit, never here, so the cursor can
+        never get ahead of durable bytes."""
         shard = st["shard"]
-        state = st["state"]
-        block_idx = len(state["blocks"])
-        start = st["next"]
+        block_idx = st["pending_blocks"]
+        start = st["pending_next"]
         end = min(start + block_size, st["hi"] - st["lo"])
         block_ids = [str(i) for i in ids[st["lo"] + start:st["lo"] + end]]
         block_seqs = list(seqs[st["lo"] + start:st["lo"] + end])
@@ -300,14 +348,16 @@ def run_map(
                         f"injected dispatch failure (shard {shard} "
                         f"block {block_idx})")
                 if kept_seqs:
-                    arrays = _embed_block(params, cfg, kept_ids,
-                                          kept_seqs, rows_per_batch,
-                                          max_segments, buckets)
+                    fetch = _embed_block_submit(
+                        params, cfg, kept_ids, kept_seqs,
+                        rows_per_batch, max_segments, buckets)
                 else:
-                    arrays = {"ids": np.array([], dtype="S1"),
-                              "lengths": np.zeros(0, np.int32),
-                              "global": np.zeros((0, 1), np.float32),
-                              "local_mean": np.zeros((0, 1), np.float32)}
+                    def fetch() -> Dict[str, Any]:
+                        return {
+                            "ids": np.array([], dtype="S1"),
+                            "lengths": np.zeros(0, np.int32),
+                            "global": np.zeros((0, 1), np.float32),
+                            "local_mean": np.zeros((0, 1), np.float32)}
                 break
             except TransientDispatchError as e:
                 stats["retries"] += 1
@@ -318,11 +368,11 @@ def run_map(
                     st["failed"] = True
                     tele.emit("map_shard", shard=shard, state="failed",
                               reason=f"retries exhausted: {e}",
-                              blocks=len(state["blocks"]))
+                              blocks=len(st["state"]["blocks"]))
                     logger.error("shard %d block %d: retries exhausted "
                                  "(%d attempts, budget %d): %s", shard,
                                  block_idx, attempts, budget[0], e)
-                    return
+                    return None
                 delay = min(backoff_cap_s,
                             backoff_base_s * (2 ** (attempts - 1)))
                 logger.warning("shard %d block %d: transient dispatch "
@@ -330,6 +380,38 @@ def run_map(
                                "%.3fs): %s", shard, block_idx, attempts,
                                retry_limit, delay, e)
                 time.sleep(delay)
+
+        st["pending_blocks"] = block_idx + 1
+        st["pending_next"] = end
+        return {"st": st, "shard": shard, "block": block_idx,
+                "start": start, "end": end, "kept_ids": kept_ids,
+                "quarantined": quarantined, "attempts": attempts,
+                "t0": t0, "fetch": fetch}
+
+    def commit_inflight(rec: Dict[str, Any], overlapped: bool) -> None:
+        """Resolve one in-flight block: blocking host fetch, NaN gate,
+        then the durable commit (object write → fsync → cursor
+        advance) — the SAME ordered sequence as the serial path, so
+        every crash window keeps its taxonomy. `overlapped` marks
+        whether a later block's device compute was already enqueued
+        when this ran (the pipelining evidence `map_overlap_ratio`
+        reports)."""
+        st = rec["st"]
+        shard = rec["shard"]
+        block_idx = rec["block"]
+        if st["halted"]:
+            # The predecessor block NaN-halted this shard at ITS commit
+            # — committing this one would advance the cursor over a
+            # hole. Discard the compute; the shard is already dead.
+            logger.warning("shard %d block %d: discarding in-flight "
+                           "block after shard halt", shard, block_idx)
+            return
+        tf0 = time.perf_counter()
+        arrays = rec["fetch"]()
+        start, end = rec["start"], rec["end"]
+        kept_ids, quarantined = rec["kept_ids"], rec["quarantined"]
+        attempts = rec["attempts"]
+        t0 = rec["t0"]
 
         if faults.poison_output(shard, block_idx) \
                 and arrays["global"].size:
@@ -359,12 +441,24 @@ def run_map(
         entry = {"block": block_idx, "digest": digest, "start": start,
                  "end": end, "n": len(kept_ids),
                  "quarantined": [[q, r] for q, r in quarantined]}
-        st["state"] = commit_block(store, st["cursor"], state, payload,
-                                  entry,
-                                  crash=faults.crash_hook(shard,
-                                                          block_idx))
+        hook = faults.crash_hook(shard, block_idx)
+        if hook is not None:
+            # The pipelined split's new crash window (ISSUE 19): device
+            # results are on the host but NOTHING is durable yet — a
+            # kill here must cost exactly one block of re-work, same as
+            # before_object.
+            hook("block_fetched")
+        st["state"] = commit_block(store, st["cursor"], st["state"],
+                                   payload, entry, crash=hook)
         st["next"] = end
         dur = time.perf_counter() - t0
+        commit_s = time.perf_counter() - tf0
+        stats["commit_s"] += commit_s
+        if overlapped:
+            stats["overlap_s"] += commit_s
+        if stats["commit_s"] > 0:
+            tele.metrics.gauge("map_overlap_ratio").set(
+                round(stats["overlap_s"] / stats["commit_s"], 4))
         rate = len(kept_ids) / dur if dur > 0 else 0.0
         stats["blocks"] += 1
         stats["seqs"] += len(kept_ids)
@@ -389,26 +483,61 @@ def run_map(
     # Round-robin over shards so progress (and therefore the worst-case
     # re-work after a kill) stays balanced, and so a chaos drill can
     # interleave faults across shards deterministically.
+    #
+    # Pipelined (ISSUE 19): ONE global in-flight slot. Each iteration
+    # submits block N+1's device compute FIRST, then resolves + commits
+    # block N — the host fetch and the durability I/O run while the
+    # device chews on N+1. The slot is plain function-local state owned
+    # by the single driver thread (no lock; nothing else can see it),
+    # and commits still happen in exact submit order, so the per-shard
+    # cursor invariant — never past an unfetched block — holds by
+    # construction. A stop/preempt COMMITS the in-flight block before
+    # returning (same contract as the serial path: finish the in-flight
+    # block, flush the cursor, exit preempted).
     def runnable(st):
         return not (st["state"]["done"] or st["halted"] or st["failed"])
 
+    def submittable(st):
+        return runnable(st) and st["pending_next"] < st["hi"] - st["lo"]
+
     preempted = False
+    inflight: List[Optional[Dict[str, Any]]] = [None]
+
+    def drain_inflight() -> None:
+        rec, inflight[0] = inflight[0], None
+        if rec is not None:
+            commit_inflight(rec, overlapped=False)
 
     def drive(stop_requested) -> None:
         nonlocal preempted
         processed = 0
-        while any(runnable(s) for s in shards):
+        while any(submittable(s) for s in shards):
+            advanced = False
             for st in shards:
-                if not runnable(st):
+                if not submittable(st):
                     continue
                 if stop_requested():
                     preempted = True
+                    drain_inflight()
                     return
                 if max_blocks is not None and processed >= max_blocks:
                     preempted = True
+                    drain_inflight()
                     return
-                process_block(st)
+                rec = submit_block(st)
                 processed += 1
+                advanced = True
+                if rec is None:
+                    continue  # shard failed at submit; nothing enqueued
+                if not pipeline:
+                    commit_inflight(rec, overlapped=False)
+                    continue
+                prev, inflight[0] = inflight[0], rec
+                if prev is not None:
+                    commit_inflight(prev, overlapped=True)
+            if not advanced:
+                break
+        drain_inflight()
 
     if stop_flag is not None:
         drive(stop_flag)
@@ -441,6 +570,15 @@ def run_map(
         "failed_shards": failed,
         "wall_s": round(wall, 3),
         "seqs_per_s": round(stats["seqs"] / wall, 3) if wall > 0 else 0.0,
+        # Pipelining evidence (ISSUE 19): the share of host
+        # fetch+commit seconds spent while a later block's device
+        # compute was already enqueued. On CPU the "device" shares the
+        # host's cores, so this proves overlap happened, not that it
+        # was free — wall_s is the honest speed number.
+        "pipeline": bool(pipeline),
+        "overlap_ratio": (round(stats["overlap_s"] / stats["commit_s"],
+                                4)
+                          if stats["commit_s"] > 0 else 0.0),
         "shards": [{
             "shard": s["shard"],
             "blocks": len(s["state"]["blocks"]),

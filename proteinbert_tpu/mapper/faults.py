@@ -14,7 +14,12 @@ Spec format (semicolon-separated directives; shard/block are ints):
 
   crash=<shard>:<block>:<point>   SIGKILL self when the engine reaches
                                   `point` for that (shard, block).
-                                  Points: before_object, after_object,
+                                  Points: block_fetched (ISSUE 19 —
+                                  device results fetched to host but
+                                  nothing written yet: the pipelined
+                                  device-complete-but-uncommitted
+                                  window, fired by engine.py),
+                                  before_object, after_object,
                                   cursor_serialized, cursor_tmp_written,
                                   cursor_prev_updated, cursor_renamed
                                   (store.commit_block / ShardCursor).
@@ -42,9 +47,9 @@ logger = logging.getLogger(__name__)
 
 FAULT_ENV = "PBT_MAP_FAULTS"
 
-CRASH_POINTS = ("before_object", "after_object", "cursor_serialized",
-                "cursor_tmp_written", "cursor_prev_updated",
-                "cursor_renamed")
+CRASH_POINTS = ("block_fetched", "before_object", "after_object",
+                "cursor_serialized", "cursor_tmp_written",
+                "cursor_prev_updated", "cursor_renamed")
 
 
 class TransientDispatchError(RuntimeError):

@@ -556,6 +556,17 @@ def validate_record(rec: Any) -> None:
             raise ValueError(
                 f"note(kind=map_capture).map_seqs_per_s must be a "
                 f"positive finite number, got {v!r}")
+        # Pipelined-mapper overlap evidence (ISSUE 19): the share of
+        # host fetch+commit seconds spent with a later block's device
+        # compute enqueued — a ratio, so [0, 1] by construction.
+        r = rec.get("map_overlap_ratio")
+        if r is not None and (isinstance(r, bool)
+                              or not isinstance(r, (int, float))
+                              or not math.isfinite(r)
+                              or not 0.0 <= r <= 1.0):
+            raise ValueError(
+                f"note(kind=map_capture).map_overlap_ratio must be a "
+                f"number in [0, 1], got {r!r}")
     if event == "note" and rec.get("kind") == "check_capture":
         # The static-analyzer capture (`pbt check --events-jsonl`,
         # ISSUE 15): check_findings_total (new + baselined findings) is
@@ -675,6 +686,15 @@ def validate_record(rec: Any) -> None:
                 raise ValueError(
                     f"note(kind=fleet_trace_capture).{name} must be a "
                     f"positive finite number, got {v!r}")
+        # ISSUE 19 satellite: the pct is the MEDIAN over this many A/B
+        # rounds (the PR 18 single-round number sign-flipped under
+        # load); typed when present so the sentinel can trust it.
+        n = rec.get("rounds")
+        if n is not None and (not isinstance(n, int)
+                              or isinstance(n, bool) or n < 1):
+            raise ValueError(
+                f"note(kind=fleet_trace_capture).rounds must be a "
+                f"positive int, got {n!r}")
     if event == "note" and rec.get("kind") == "neighbors_capture":
         # The ANN serving capture (bench.py --neighbors, ISSUE 17):
         # its QPS and recall fields feed trajectory-sentinel series
@@ -707,6 +727,46 @@ def validate_record(rec: Any) -> None:
                 raise ValueError(
                     f"note(kind=neighbors_capture).{name} must be a "
                     f"positive finite number, got {v!r}")
+    if event == "note" and rec.get("kind") == "serve_pipeline_capture":
+        # The pipelined-dispatch A/B capture (bench.py --serve pipeline
+        # phase, ISSUE 19): depth-2 vs depth-1 served throughput, gated
+        # on async-vs-sync output bit-parity and exactly-once sealing
+        # under drain with work in flight. The speedup is a trajectory-
+        # sentinel input, so a writer bug must fail validation, not
+        # poison the series.
+        v = rec.get("serve_pipeline_speedup_x")
+        if v is None:
+            raise ValueError(
+                "note(kind=serve_pipeline_capture): missing required "
+                "field 'serve_pipeline_speedup_x'")
+        if (isinstance(v, bool) or not isinstance(v, (int, float))
+                or not math.isfinite(v) or v <= 0):
+            raise ValueError(
+                f"note(kind=serve_pipeline_capture)."
+                f"serve_pipeline_speedup_x must be a positive finite "
+                f"number, got {v!r}")
+        for name in ("pipeline_rps", "serial_rps"):
+            v = rec.get(name)
+            if v is not None and (isinstance(v, bool)
+                                  or not isinstance(v, (int, float))
+                                  or not math.isfinite(v) or v <= 0):
+                raise ValueError(
+                    f"note(kind=serve_pipeline_capture).{name} must be "
+                    f"a positive finite number, got {v!r}")
+        r = rec.get("serve_overlap_ratio")
+        if r is not None and (isinstance(r, bool)
+                              or not isinstance(r, (int, float))
+                              or not math.isfinite(r)
+                              or not 0.0 <= r <= 1.0):
+            raise ValueError(
+                f"note(kind=serve_pipeline_capture).serve_overlap_"
+                f"ratio must be a number in [0, 1], got {r!r}")
+        im = rec.get("inflight_max")
+        if im is not None and (not isinstance(im, int)
+                               or isinstance(im, bool) or im < 0):
+            raise ValueError(
+                f"note(kind=serve_pipeline_capture).inflight_max must "
+                f"be a non-negative int, got {im!r}")
 
 
 def make_example(event: str) -> Dict[str, Any]:

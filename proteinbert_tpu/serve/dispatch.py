@@ -112,6 +112,41 @@ def default_batch_classes(max_batch: int, multiple: int = 1) -> Tuple[int, ...]:
     return tuple(classes)
 
 
+class InFlightBatch:
+    """Handle for one asynchronously dispatched micro-batch (ISSUE 19).
+
+    `run_*_async` returns one of these immediately after the jitted
+    call is ENQUEUED — JAX dispatch is async, so the device computes
+    while the host moves on to form the next batch. Everything that
+    blocks (the `np.asarray` host fetch, per-request fan-out, the quant
+    parity shadow) lives in `finalize()`, which the scheduler's
+    completer thread calls when it is ready to resolve the batch. The
+    sync entries (`run_timed`/`run_packed_timed`) are literally
+    submit + immediate finalize, so async and sync outputs are
+    bit-identical by construction (gated by tools/pipeline_smoke.py
+    and the bench `pipeline` phase).
+    """
+
+    __slots__ = ("rows", "timings", "_fetch", "_result")
+
+    def __init__(self, rows: int, timings: Dict, fetch):
+        self.rows = rows
+        self.timings = timings
+        self._fetch = fetch
+        self._result = None
+
+    def finalize(self):
+        """Block for the device result (host fetch + fan-out + parity
+        shadow) and return (outputs, timings) — the exact pair the sync
+        entry returns. Idempotent: a second call returns the first
+        call's result."""
+        if self._fetch is not None:
+            out = self._fetch()
+            self._result = (out, self.timings)
+            self._fetch = None
+        return self._result
+
+
 class BucketDispatcher:
     """Routes (kind, tokens, annotations) micro-batches to the warm
     executable of their shape class and returns trimmed host outputs."""
@@ -505,9 +540,25 @@ class BucketDispatcher:
         """`run()` that also returns stage attribution for request
         traces: {"prep_s": pad + device placement, "device_s": model
         call through host fetch (the compile lands here on a cold
-        shape), "pad_fraction": padding share of the (batch_class, L)
-        grid the executable actually ran — row padding up to the class
-        plus token padding within rows}."""
+        shape), "finalize_s": the host-fetch share of device_s,
+        "pad_fraction": padding share of the (batch_class, L) grid the
+        executable actually ran — row padding up to the class plus
+        token padding within rows}. Implemented as submit + immediate
+        finalize of the async entry, so sync and pipelined dispatch
+        share one code path (and therefore bit-identical outputs)."""
+        return self.run_timed_async(kind, tokens, annotations,
+                                    timed=timed, heads=heads).finalize()
+
+    def run_timed_async(self, kind: str, tokens: np.ndarray,
+                        annotations: Optional[np.ndarray] = None,
+                        timed: bool = True,
+                        heads: Optional[Sequence[LoadedHead]] = None
+                        ) -> InFlightBatch:
+        """Submit one micro-batch and return an `InFlightBatch` as soon
+        as the jitted call is enqueued (ISSUE 19). Validation, padding,
+        device placement and the model call happen here on the calling
+        (scheduler) thread; the blocking host fetch, head tails and the
+        parity shadow run in the handle's `finalize()`."""
         if kind == NEIGHBORS_KIND:
             kind = "embed"  # identical device work, shared executable
         rows, L = tokens.shape
@@ -530,42 +581,59 @@ class BucketDispatcher:
             tokens = np.pad(tokens, ((0, cls - rows), (0, 0)))
             annotations = np.pad(annotations, ((0, cls - rows), (0, 0)))
         tb, ab = self._place(tokens, annotations)
+        t1 = time.perf_counter()
         if timed:
-            t1 = time.perf_counter()
             timings["prep_s"] = round(t1 - t0, 9)
         parity_due = self._quant_batch_tick(timings)
         if heads is not None:
             # Multi-tenant path: ONE shared trunk executable for the
             # whole (possibly mixed-head) batch, then each distinct
             # head's cheap tail over the full batch — every row keeps
-            # its own head's output (heads/apply.py).
+            # its own head's output (heads/apply.py). The tails ride
+            # in the fetch closure: they are tiny, and the trunk — the
+            # device work worth overlapping — is already in flight.
             trunk_out = self._trunk_fn()(self._run_params(), tb, ab,
                                          self.cfg.model)
             self._note_warm(("trunk", L, cls))
-            out = heads_apply.apply_heads(trunk_out, heads)
-            if parity_due:
-                self._shadow_parity(
-                    out,
-                    lambda: heads_apply.apply_heads(
-                        heads_apply.trunk_batch(self.params, tb, ab,
-                                                self.cfg.model), heads),
-                    timings)
+
+            def fetch():
+                out = heads_apply.apply_heads(trunk_out, heads)
+                if parity_due:
+                    self._shadow_parity(
+                        out,
+                        lambda: heads_apply.apply_heads(
+                            heads_apply.trunk_batch(self.params, tb, ab,
+                                                    self.cfg.model),
+                            heads),
+                        timings)
+                return out
         else:
             fn = self._fn(kind)
             res = fn(self._run_params(), tb, ab, self.cfg.model)
             self._note_warm((kind, L, cls))
-            out = jax.tree.map(lambda a: np.asarray(a)[:rows], res)
-            if parity_due:
-                self._shadow_parity(
-                    out,
-                    lambda: jax.tree.map(
-                        lambda a: np.asarray(a)[:rows],
-                        self._fn(kind, quantized=False)(
-                            self.params, tb, ab, self.cfg.model)),
-                    timings)
-        if timed:
-            timings["device_s"] = round(time.perf_counter() - t1, 9)
-        return out, timings
+
+            def fetch():
+                out = jax.tree.map(lambda a: np.asarray(a)[:rows], res)
+                if parity_due:
+                    self._shadow_parity(
+                        out,
+                        lambda: jax.tree.map(
+                            lambda a: np.asarray(a)[:rows],
+                            self._fn(kind, quantized=False)(
+                                self.params, tb, ab, self.cfg.model)),
+                        timings)
+                return out
+
+        def finalize_fetch():
+            tf = time.perf_counter()
+            out = fetch()
+            if timed:
+                now = time.perf_counter()
+                timings["device_s"] = round(now - t1, 9)
+                timings["finalize_s"] = round(now - tf, 9)
+            return out
+
+        return InFlightBatch(rows, timings, finalize_fetch)
 
     def warmup(self, kinds: Sequence[str] = ("embed",)) -> int:
         """Pre-compile every (bucket_len, batch_class) executable for the
@@ -815,6 +883,12 @@ class RaggedDispatcher(BucketDispatcher):
             "run_packed()/run_packed_timed() "
             "(serve/scheduler.PackedBatchScheduler builds them)")
 
+    def run_timed_async(self, *args, **kwargs):
+        raise NotImplementedError(
+            "RaggedDispatcher consumes packed batches only — use "
+            "run_packed_timed_async() "
+            "(serve/scheduler.PackedBatchScheduler builds them)")
+
     def run_packed(self, kind: str, tokens: np.ndarray,
                    segment_ids: np.ndarray, annotations: np.ndarray,
                    riders: Sequence[Tuple[int, int, int, int]],
@@ -828,8 +902,22 @@ class RaggedDispatcher(BucketDispatcher):
                          segment_ids: np.ndarray, annotations: np.ndarray,
                          riders: Sequence[Tuple[int, int, int, int]],
                          heads=None, timed: bool = True):
-        """Run one packed batch through the kind's single warm
-        executable and fan per-segment outputs back out.
+        """Run one packed batch synchronously — submit + immediate
+        finalize of `run_packed_timed_async`, so sync and pipelined
+        dispatch share one code path (bit-identical outputs)."""
+        return self.run_packed_timed_async(
+            kind, tokens, segment_ids, annotations, riders, heads=heads,
+            timed=timed).finalize()
+
+    def run_packed_timed_async(self, kind: str, tokens: np.ndarray,
+                               segment_ids: np.ndarray,
+                               annotations: np.ndarray,
+                               riders: Sequence[Tuple[int, int, int, int]],
+                               heads=None, timed: bool = True
+                               ) -> InFlightBatch:
+        """Submit one packed batch through the kind's single warm
+        executable; the returned `InFlightBatch.finalize()` fans
+        per-segment outputs back out after the host fetch (ISSUE 19).
 
         tokens/segment_ids are (rows_per_batch, seq_len), annotations
         (rows_per_batch, max_segments, A). `riders` carries one
@@ -862,8 +950,8 @@ class RaggedDispatcher(BucketDispatcher):
             timings["segments"] = len(riders)
             timings["segments_per_row"] = round(len(riders) / R, 4)
         tb, sb, ab = self._place_packed(tokens, segment_ids, annotations)
+        t1 = time.perf_counter()
         if timed:
-            t1 = time.perf_counter()
             timings["prep_s"] = round(t1 - t0, 9)
         parity_due = self._quant_batch_tick(timings)
 
@@ -885,34 +973,49 @@ class RaggedDispatcher(BucketDispatcher):
             trunk_out = self._packed_trunk_fn()(
                 self._run_params(), tb, sb, ab, self.cfg.model)
             self._note_warm(("trunk", L, R))
-            outs = heads_apply.apply_heads_packed(
-                trunk_out,
-                [(h,) + tuple(r) for h, r in zip(heads, riders)])
-            if parity_due:
-                self._shadow_parity(
-                    outs,
-                    lambda: heads_apply.apply_heads_packed(
-                        heads_apply.packed_trunk_batch(
-                            self.params, tb, sb, ab, self.cfg.model),
-                        [(h,) + tuple(r)
-                         for h, r in zip(heads, riders)]),
-                    timings)
+
+            def fetch():
+                outs = heads_apply.apply_heads_packed(
+                    trunk_out,
+                    [(h,) + tuple(r) for h, r in zip(heads, riders)])
+                if parity_due:
+                    self._shadow_parity(
+                        outs,
+                        lambda: heads_apply.apply_heads_packed(
+                            heads_apply.packed_trunk_batch(
+                                self.params, tb, sb, ab, self.cfg.model),
+                            [(h,) + tuple(r)
+                             for h, r in zip(heads, riders)]),
+                        timings)
+                return outs
         else:
             res = self._packed_fn(kind)(self._run_params(), tb, sb, ab,
                                         self.cfg.model)
             self._note_warm((kind, L, R))
-            outs = fan_out(jax.tree.map(np.asarray, res))
-            if parity_due:
-                self._shadow_parity(
-                    outs,
-                    lambda: fan_out(jax.tree.map(
-                        np.asarray,
-                        self._packed_fn(kind, quantized=False)(
-                            self.params, tb, sb, ab, self.cfg.model))),
-                    timings)
-        if timed:
-            timings["device_s"] = round(time.perf_counter() - t1, 9)
-        return outs, timings
+
+            def fetch():
+                outs = fan_out(jax.tree.map(np.asarray, res))
+                if parity_due:
+                    self._shadow_parity(
+                        outs,
+                        lambda: fan_out(jax.tree.map(
+                            np.asarray,
+                            self._packed_fn(kind, quantized=False)(
+                                self.params, tb, sb, ab,
+                                self.cfg.model))),
+                        timings)
+                return outs
+
+        def finalize_fetch():
+            tf = time.perf_counter()
+            outs = fetch()
+            if timed:
+                now = time.perf_counter()
+                timings["device_s"] = round(now - t1, 9)
+                timings["finalize_s"] = round(now - tf, 9)
+            return outs
+
+        return InFlightBatch(len(riders), timings, finalize_fetch)
 
     # ------------------------------------------------------------- warmup
 

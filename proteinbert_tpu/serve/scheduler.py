@@ -50,6 +50,30 @@ logger = logging.getLogger(__name__)
 GroupKey = Tuple[str, int]  # (kind, bucket_len)
 
 
+class _ReadyBatch:
+    """An already-resolved result wearing the in-flight handle shape —
+    the fallback for stub dispatchers with no `run_*_async` entry
+    (their blocking call already happened on the scheduler thread)."""
+
+    def __init__(self, result, timings):
+        self._result = (result, timings)
+
+    def finalize(self):
+        return self._result
+
+
+class _FailedBatch:
+    """A submit-time dispatch failure carried through the in-flight
+    window so the ONE finalize path handles every batch outcome; the
+    original traceback rides on the exception object."""
+
+    def __init__(self, exc: BaseException):
+        self._exc = exc
+
+    def finalize(self):
+        raise self._exc
+
+
 class MicroBatchScheduler:
     def __init__(
         self,
@@ -67,6 +91,7 @@ class MicroBatchScheduler:
             Callable[[Request, str, float, Optional[BaseException],
                       Optional[dict]], None]] = None,
         replica_id: Optional[str] = None,
+        pipeline_depth: int = 2,
     ):
         from proteinbert_tpu.obs import as_telemetry
 
@@ -133,6 +158,32 @@ class MicroBatchScheduler:
         # or this flag (the Server sets it when SLO attribution needs
         # pad_fraction for every request).
         self.time_batches = False
+        # Pipelined dispatch (ISSUE 19): a bounded window of submitted-
+        # but-unfinalized batches between SUBMIT (the jitted call is
+        # enqueued — JAX dispatch is async, so the device starts
+        # immediately) and FINALIZE (blocking host fetch, per-request
+        # fan-out, future sealing). With a completer thread (started by
+        # start() when pipeline_depth > 1) the scheduler forms and
+        # submits batch N+1 while batch N computes; without one
+        # (single-threaded poll() tests, or depth 1) every submit
+        # finalizes synchronously — exactly the pre-pipeline behavior,
+        # which is what keeps fake-clock formation tests deterministic.
+        # The Condition below doubles as the mutex for every field
+        # annotated with it ('lock' in its name keeps the
+        # lock-discipline rule reading `with self._inflight_lock:`
+        # regions as held).
+        self.pipeline_depth = max(1, int(pipeline_depth))
+        self._inflight_lock = threading.Condition()
+        self._inflight = collections.deque()  # guarded-by: _inflight_lock
+        self.inflight_max = 0                 # guarded-by: _inflight_lock
+        self.finalize_seconds_total = 0.0     # guarded-by: _inflight_lock
+        self.overlap_seconds_total = 0.0      # guarded-by: _inflight_lock
+        self._completer: Optional[threading.Thread] = None
+        self._completer_stop = threading.Event()
+        self._inflight_g = self.tele.metrics.gauge("serve_inflight_batches")
+        self._overlap_g = self.tele.metrics.gauge("serve_overlap_ratio")
+        self._finalize_h = self.tele.metrics.histogram(
+            "serve_finalize_seconds")
 
     # -------------------------------------------------------- formation
 
@@ -266,29 +317,57 @@ class MicroBatchScheduler:
         extra = {"heads": heads} if heads is not None else {}
         if heads is not None:
             ctx["heads"] = sorted({h.head_id for h in heads})
+        self._wait_for_slot()
         t0 = time.perf_counter()
         run0 = self.clock()
         try:
-            # run_timed (BucketDispatcher) splits prep (pad/place) from
-            # device execute and reports the padded grid's pad
-            # fraction; plain run() keeps stub dispatchers working.
-            # Untimed batches still go through run_timed(timed=False)
-            # when the dispatcher has it: the quantized arm stamps its
-            # `quant`/`quant_parity_max` event fields unconditionally
-            # (absent-means-fp32 must hold on untimed batches too), and
-            # timed=False skips only the O(rows*L) pad scan.
+            # run_timed_async (BucketDispatcher) returns an in-flight
+            # handle as soon as the jitted call is enqueued — the
+            # blocking host fetch moves to _finalize_batch. run_timed /
+            # plain run() keep stub dispatchers working (their result
+            # rides the window in a _ReadyBatch). Untimed batches still
+            # go through timed=False rather than run(): the quantized
+            # arm stamps its `quant`/`quant_parity_max` event fields
+            # unconditionally (absent-means-fp32 must hold on untimed
+            # batches too), and timed=False skips only the O(rows*L)
+            # pad scan.
+            run_async = getattr(self.dispatcher, "run_timed_async", None)
             run_timed = getattr(self.dispatcher, "run_timed", None)
-            if run_timed is not None and tracing and timed:
-                result, timings = run_timed(kind, tokens, annotations,
-                                            **extra)
-                ctx.update(timings)
+            if run_async is not None:
+                handle = run_async(kind, tokens, annotations,
+                                   timed=bool(tracing and timed), **extra)
             elif run_timed is not None:
                 result, timings = run_timed(kind, tokens, annotations,
-                                            timed=False, **extra)
-                ctx.update(timings)
+                                            timed=bool(tracing and timed),
+                                            **extra)
+                handle = _ReadyBatch(result, timings)
             else:
-                result = self.dispatcher.run(kind, tokens, annotations,
-                                             **extra)
+                handle = _ReadyBatch(
+                    self.dispatcher.run(kind, tokens, annotations,
+                                        **extra), {})
+        except Exception as e:  # submit failed; finalize path fails it
+            handle = _FailedBatch(e)
+        self._enqueue_inflight({
+            "mode": "bucketed", "batch": batch, "handle": handle,
+            "ctx": ctx, "kind": kind, "bucket_len": bucket_len,
+            "cls": cls, "run0": run0, "t0": t0})
+        return len(batch)
+
+    def _finalize_batch(self, entry: Dict) -> None:
+        """Resolve one in-flight micro-batch: blocking host fetch,
+        per-request finalize/fan-out, trace marks, counters, the
+        serve_batch event and the terminal complete callback. Runs on
+        the completer thread when one is live, else inline right after
+        submit. Trace stages: `execute` is submit → fetch-complete
+        (run0 → run1) and `finalize` is fetch-complete → sealed, so
+        per-request stages still tile [submit, done]."""
+        batch: List[Request] = entry["batch"]
+        ctx, run0 = entry["ctx"], entry["run0"]
+        kind, bucket_len, cls = (entry["kind"], entry["bucket_len"],
+                                 entry["cls"])
+        tf0 = time.perf_counter()
+        try:
+            result, timings = entry["handle"].finalize()
         except Exception as e:  # fail THIS batch, keep serving
             logger.exception("batch dispatch failed (%s, L=%d, rows=%d)",
                              kind, bucket_len, len(batch))
@@ -304,10 +383,12 @@ class MicroBatchScheduler:
                 if not req.future.done():
                     req.future.set_exception(e)
                 self._on_complete(req, "error", fail_t, e, ctx)
-            return len(batch)
-        dt = time.perf_counter() - t0
+            return
+        ctx.update(timings)
+        dt = time.perf_counter() - entry["t0"]
         run1 = self.clock()
         self._batch_h.observe(dt)
+        self._finalize_h.observe(time.perf_counter() - tf0)
         done_t = self.clock()
         for i, req in enumerate(batch):
             if isinstance(result, dict):
@@ -345,7 +426,100 @@ class MicroBatchScheduler:
                        pad_fraction=ctx.get("pad_fraction"),
                        heads=ctx.get("heads"), **quant_fields,
                        **self._replica_fields)
-        return len(batch)
+
+    # ------------------------------------------------- in-flight window
+
+    def _wait_for_slot(self) -> None:
+        """Backpressure: block until the in-flight window has room.
+        Only meaningful with a live completer (the sync path never
+        leaves an entry behind); bounded wait steps keep an abort's
+        stop() from wedging a full-window scheduler."""
+        if self._completer is None:
+            return
+        with self._inflight_lock:
+            while (len(self._inflight) >= self.pipeline_depth
+                   and not self._stopped.is_set()):
+                self._inflight_lock.wait(0.05)
+
+    def _enqueue_inflight(self, entry: Dict) -> None:
+        with self._inflight_lock:
+            self._inflight.append(entry)
+            n = len(self._inflight)
+            if n > self.inflight_max:
+                self.inflight_max = n
+            self._inflight_lock.notify_all()
+        self._inflight_g.set(n)
+        if self._completer is None:
+            self._drain_inflight()
+
+    def _drain_inflight(self) -> None:
+        """Finalize every windowed batch on the CALLING thread — the
+        sync path (no completer), and the epilogue that resolves
+        still-in-flight work when run_forever exits without one."""
+        while True:
+            with self._inflight_lock:
+                if not self._inflight:
+                    return
+                entry = self._inflight.popleft()
+                n = len(self._inflight)
+                self._inflight_lock.notify_all()
+            self._inflight_g.set(n)
+            self._observe_finalize(entry, overlapped=n > 0)
+
+    def _inflight_idle(self) -> bool:
+        with self._inflight_lock:
+            return not self._inflight
+
+    def _observe_finalize(self, entry: Dict, overlapped: bool) -> None:
+        """_finalize_batch plus the dispatch/finalize overlap
+        accounting: finalize wall-seconds spent while ANOTHER batch was
+        in the window are overlapped — the device had work the whole
+        time the host was fetching/sealing."""
+        t0 = time.perf_counter()
+        self._finalize_batch(entry)
+        fsec = time.perf_counter() - t0
+        with self._inflight_lock:
+            overlapped = overlapped or bool(self._inflight)
+            self.finalize_seconds_total += fsec
+            if overlapped:
+                self.overlap_seconds_total += fsec
+            total = self.finalize_seconds_total
+            overlap = self.overlap_seconds_total
+        if total > 0:
+            self._overlap_g.set(round(overlap / total, 6))
+
+    def _complete_forever(self) -> None:
+        """Completer-thread loop: pop the oldest in-flight batch,
+        finalize it, repeat — exiting only once run_forever has signaled
+        stop AND the window is empty, so drain/abort both resolve every
+        already-submitted batch exactly once."""
+        while True:
+            with self._inflight_lock:
+                if not self._inflight:
+                    if self._completer_stop.is_set():
+                        return
+                    self._inflight_lock.wait(0.05)
+                    continue
+                entry = self._inflight.popleft()
+                n = len(self._inflight)
+                self._inflight_lock.notify_all()
+            self._inflight_g.set(n)
+            self._observe_finalize(entry, overlapped=n > 0)
+
+    def pipeline_stats(self) -> Dict:
+        """One coherent read of the pipeline counters (Server.stats(),
+        bench, tools/pipeline_smoke.py)."""
+        with self._inflight_lock:
+            total = self.finalize_seconds_total
+            overlap = self.overlap_seconds_total
+            return {
+                "depth": self.pipeline_depth,
+                "inflight_max": self.inflight_max,
+                "finalize_seconds_total": round(total, 6),
+                "overlap_seconds_total": round(overlap, 6),
+                "overlap_ratio": (round(overlap / total, 6)
+                                  if total > 0 else 0.0),
+            }
 
     def poll(self, now: Optional[float] = None) -> int:
         """One scheduling step: ingest, expire, dispatch AT MOST one
@@ -366,22 +540,46 @@ class MicroBatchScheduler:
         # Idle parking: wake at least every max_wait/2 so an under-full
         # group's max-wait trigger fires on time even with no new pushes.
         park = max(min(self.max_wait_s / 2, 0.05), 0.001)
-        while not self._stopped.is_set():
-            if self.poll():
-                continue
-            # Drained only when the QUEUE is empty too: a push can land
-            # between poll()'s ingest and a close(), and exiting then
-            # would strand that request's future forever. After close()
-            # no new pushes are admitted, so empty-at-observation is
-            # final.
-            if (self.queue.closed and not self._pending
-                    and len(self.queue) == 0):
-                return
-            self.queue.wait(timeout=park)
+        try:
+            while not self._stopped.is_set():
+                if self.poll():
+                    continue
+                # Drained only when the QUEUE is empty too: a push can
+                # land between poll()'s ingest and a close(), and
+                # exiting then would strand that request's future
+                # forever. After close() no new pushes are admitted, so
+                # empty-at-observation is final. The in-flight window
+                # must be idle too — a submitted batch's futures are
+                # still unsealed until the completer resolves it.
+                if (self.queue.closed and not self._pending
+                        and len(self.queue) == 0
+                        and self._inflight_idle()):
+                    return
+                self.queue.wait(timeout=park)
+        finally:
+            # Drain/abort epilogue: every batch already SUBMITTED is on
+            # device and its futures must seal exactly once — signal
+            # the completer to exit once the window empties and wait
+            # for it (or resolve the window inline when there is
+            # none). Only after this does join() return, so
+            # Server.abort's fail_pending can never race a live
+            # finalize.
+            self._completer_stop.set()
+            with self._inflight_lock:
+                self._inflight_lock.notify_all()
+            if self._completer is not None:
+                self._completer.join()
+            else:
+                self._drain_inflight()
 
     def start(self) -> None:
         if self._thread is not None:
             raise RuntimeError("scheduler already started")
+        if self.pipeline_depth > 1:
+            self._completer = threading.Thread(
+                target=self._complete_forever,
+                name="pbt-serve-completer", daemon=True)
+            self._completer.start()
         self._thread = threading.Thread(target=self.run_forever,
                                         name="pbt-serve-scheduler",
                                         daemon=True)
@@ -468,13 +666,15 @@ class PackedBatchScheduler(MicroBatchScheduler):
         expire_observer: Optional[Callable[[Request], None]] = None,
         complete_observer=None,
         replica_id: Optional[str] = None,
+        pipeline_depth: int = 2,
     ):
         super().__init__(
             queue, dispatcher, finalize, max_batch=rows_per_batch,
             max_wait_s=max_wait_s, clock=clock, partition_heads=False,
             telemetry=telemetry, latency_observer=latency_observer,
             expire_observer=expire_observer,
-            complete_observer=complete_observer, replica_id=replica_id)
+            complete_observer=complete_observer, replica_id=replica_id,
+            pipeline_depth=pipeline_depth)
         # Lazy import: data/packing pulls the dataset module, which the
         # pure-logic scheduler tests (stub dispatchers) need not load.
         from proteinbert_tpu.data.packing import OnlinePacker
@@ -613,21 +813,44 @@ class PackedBatchScheduler(MicroBatchScheduler):
                "mode": "ragged"}
         if heads is not None:
             ctx["heads"] = sorted({h.head_id for h in heads})
+        self._wait_for_slot()
         t0 = time.perf_counter()
         run0 = self.clock()
         try:
             # Same rule as the bucketed scheduler: untimed batches run
             # timed=False so the quantized arm's unconditionally-
-            # stamped event fields still reach the ctx.
-            if tracing and timed:
-                outs, timings = self.dispatcher.run_packed_timed(
-                    kind, tokens, segment_ids, annotations, geom,
-                    heads=heads)
+            # stamped event fields still reach the ctx; the async entry
+            # moves the host fetch + fan-out into _finalize_batch.
+            run_async = getattr(self.dispatcher,
+                                "run_packed_timed_async", None)
+            if run_async is not None:
+                handle = run_async(kind, tokens, segment_ids,
+                                   annotations, geom, heads=heads,
+                                   timed=bool(tracing and timed))
             else:
                 outs, timings = self.dispatcher.run_packed_timed(
                     kind, tokens, segment_ids, annotations, geom,
-                    heads=heads, timed=False)
-            ctx.update(timings)
+                    heads=heads, timed=bool(tracing and timed))
+                handle = _ReadyBatch(outs, timings)
+        except Exception as e:  # submit failed; finalize path fails it
+            handle = _FailedBatch(e)
+        self._enqueue_inflight({
+            "mode": "ragged", "riders": riders, "handle": handle,
+            "ctx": ctx, "kind": kind, "n_riders": n_riders,
+            "run0": run0, "t0": t0})
+        return n_riders
+
+    def _finalize_batch(self, entry: Dict) -> None:
+        """Packed-batch finalize: host fetch + per-rider fan-out via
+        the in-flight handle, then the same marks/counters/event shape
+        the pre-pipeline dispatch produced (mode="ragged")."""
+        riders = entry["riders"]
+        ctx, run0 = entry["ctx"], entry["run0"]
+        kind, n_riders = entry["kind"], entry["n_riders"]
+        R, L, S = self.rows_per_batch, self.seq_len, self.max_segments
+        tf0 = time.perf_counter()
+        try:
+            outs, timings = entry["handle"].finalize()
         except Exception as e:  # fail THIS batch, keep serving
             logger.exception("packed batch dispatch failed "
                              "(%s, rows=%d, segments=%d)",
@@ -647,10 +870,12 @@ class PackedBatchScheduler(MicroBatchScheduler):
                 if not req.future.done():
                     req.future.set_exception(e)
                 self._on_complete(req, "error", fail_t, e, ctx)
-            return n_riders
-        dt = time.perf_counter() - t0
+            return
+        ctx.update(timings)
+        dt = time.perf_counter() - entry["t0"]
         run1 = self.clock()
         self._batch_h.observe(dt)
+        self._finalize_h.observe(time.perf_counter() - tf0)
         done_t = self.clock()
         for (req, _, _, _, span), out in zip(riders, outs):
             outcome, err = "ok", None
@@ -692,7 +917,6 @@ class PackedBatchScheduler(MicroBatchScheduler):
                        mode="ragged",
                        heads=ctx.get("heads"), **quant_fields,
                        **self._replica_fields)
-        return n_riders
 
     def fail_pending(self, exc: Exception) -> List[Request]:
         with self._pending_lock:

@@ -14,9 +14,19 @@ Request life cycle:
   ├─ cache lookup — hit returns a resolved future, nothing enqueues
   └─ queue.push (may evict the oldest    ──►  poll(): group by
      request with QueueFullError)             (kind, bucket), dispatch
-                                              at max_batch/max_wait,
-                                              finalize per row: cache
+                                              at max_batch/max_wait —
+                                              submit only; a completer
+                                              thread fetches results,
+                                              finalizes per row: cache
                                               put + future.set_result
+
+Pipelined dispatch (ISSUE 19): dispatch is split into submit (enqueue
+the jitted call — JAX dispatch is async, so this returns immediately)
+and finalize (blocking host fetch + per-request fan-out), joined by a
+bounded in-flight window (`pipeline_depth`, default 2). Batch N+1
+forms and submits while batch N computes; the completer thread drains
+the window in FIFO order. Depth 1 disables the completer and restores
+the serial path bit-for-bit (docs/serving.md "Pipelined dispatch").
 
 Shutdown is two-mode, per the resilience conventions of
 train/resilience.GracefulShutdown:
@@ -128,6 +138,7 @@ class Server:
         index=None,
         nprobe: int = 8,
         replica_id: Optional[str] = None,
+        pipeline_depth: Optional[int] = None,
     ):
         from proteinbert_tpu.obs import as_telemetry
 
@@ -152,6 +163,14 @@ class Server:
         if quant_parity_every is None:
             quant_parity_every = getattr(serve_cfg,
                                          "quant_parity_every", 0)
+        # Pipelined dispatch (ISSUE 19): bounded in-flight window for
+        # the scheduler. Depth 1 restores the serial pre-pipeline path
+        # (submit + finalize inline on the scheduler thread); depth >= 2
+        # starts a completer thread so batch N+1 forms while batch N
+        # computes. Same config-then-ctor precedence as quant.
+        if pipeline_depth is None:
+            pipeline_depth = getattr(serve_cfg, "pipeline_depth", 2)
+        self.pipeline_depth = max(1, int(pipeline_depth))
         self.quant = quant
         # Fleet identity (ISSUE 18): a stable name the fleet assigns at
         # spawn (`pbt serve --replica-id r0`). Stamped onto every
@@ -192,7 +211,8 @@ class Server:
                 telemetry=telemetry, replica_id=replica_id,
                 latency_observer=self._observe_latency,
                 expire_observer=self._count_expiry,
-                complete_observer=self._on_complete)
+                complete_observer=self._on_complete,
+                pipeline_depth=self.pipeline_depth)
         else:
             self.dispatcher = BucketDispatcher(
                 params, cfg, buckets=buckets, max_batch=max_batch,
@@ -205,7 +225,8 @@ class Server:
                 telemetry=telemetry, replica_id=replica_id,
                 latency_observer=self._observe_latency,
                 expire_observer=self._count_expiry,
-                complete_observer=self._on_complete)
+                complete_observer=self._on_complete,
+                pipeline_depth=self.pipeline_depth)
         # Multi-tenant heads (ISSUE 8): an optional registry to resolve
         # head ids from, plus the resident trunk's fingerprint computed
         # LAZILY (one device→host fetch of the whole trunk — only paid
@@ -303,8 +324,10 @@ class Server:
         # Local mirrors of the labeled counters: stats() must report
         # real numbers even under the NULL telemetry facade (whose
         # metric instruments are shared no-ops). Bumped from concurrent
-        # client/HTTP threads, so the read-modify-write needs a lock
-        # (completed_total is scheduler-thread-only and needs none).
+        # client/HTTP threads, so the read-modify-write needs a lock.
+        # (completed_total needs none: finalize has exactly one writer
+        # — the completer thread when pipeline_depth > 1, else the
+        # scheduler thread — never both; see scheduler._finalize_batch.)
         self._mirror_lock = threading.Lock()
         self.truncated_total = 0
         self.rejected_total = {r: 0 for r in self._rej_c}
@@ -404,6 +427,7 @@ class Server:
             "warmup": self.dispatcher.warmup_report,
             "quant": self.quant,
             "quant_report": self.dispatcher.quant_report or None,
+            "pipeline_depth": self.pipeline_depth,
             "neighbor_index": (self.index.digest
                                if self.index is not None else None),
             "nprobe": self.nprobe if self.index is not None else None,
@@ -762,7 +786,9 @@ class Server:
 
     def _finalize(self, req: Request, row) -> None:
         """Scheduler callback: one request's raw model row → its result
-        (+ cache insert). Runs on the scheduler thread."""
+        (+ cache insert). Runs on the finalize thread — the completer
+        when pipeline_depth > 1, else the scheduler thread; exactly one
+        of the two ever calls this (ISSUE 19)."""
         if req.kind == NEIGHBORS_KIND:
             # The embed leg already ran (dispatch served this request
             # as an embed row); the lookup leg probes the resident
@@ -941,6 +967,11 @@ class Server:
                            if qw.count else None),
                 "max_s": (round(qw.max, 6) if qw.count else None),
             },
+            # Pipelined dispatch (ISSUE 19): window depth, the deepest
+            # the window actually got (overlap observed ⇔ >= 2), and
+            # the share of finalize seconds that overlapped device
+            # compute of a later batch.
+            "pipeline": self.scheduler.pipeline_stats(),
         }
         # Neighbor-index arm (ISSUE 17): which index serves, its size,
         # and how many distinct lookup shapes have compiled — the

@@ -667,3 +667,65 @@ class TestEngine:
                       **MAP_KW)
         assert out["outcome"] == "completed"
         assert verify_store(str(tmp_path / "store"))["complete"]
+
+
+# ------------------------- pipelined block window (ISSUE 19 tentpole)
+
+
+class TestPipelinedMapper:
+    """The one-block-in-flight window: block N+1's device compute
+    overlaps block N's host fetch + commit.  The contract is that the
+    window moves WHEN work happens, never WHAT gets committed — so the
+    gates here are byte-identity against the serial path and the typed
+    crash taxonomy, not wall-clock."""
+
+    def test_on_vs_off_byte_identical_with_overlap(self, trunk, corpus,
+                                                   tmp_path):
+        params, cfg = trunk
+        ids, seqs = corpus
+        on, off = str(tmp_path / "on"), str(tmp_path / "off")
+        out_on = run_map(params, cfg, ids, seqs, on, **MAP_KW)
+        out_off = run_map(params, cfg, ids, seqs, off, pipeline=False,
+                          **MAP_KW)
+        assert out_on["outcome"] == "completed"
+        assert out_off["outcome"] == "completed"
+        assert out_on["pipeline"] is True
+        assert out_off["pipeline"] is False
+        # Every shard here has >= 2 blocks, so the window accrues
+        # overlapped commit seconds; the serial path never does.
+        assert out_on["overlap_ratio"] > 0.0
+        assert out_off["overlap_ratio"] == 0.0
+        assert store_digests(on) == store_digests(off)
+
+    def test_block_fetched_is_a_typed_crash_point(self):
+        from proteinbert_tpu.mapper.faults import (
+            CRASH_POINTS as ENGINE_CRASH_POINTS,
+        )
+
+        # The new window opens a new crash window: device result
+        # fetched, nothing committed.  It leads the taxonomy (it
+        # precedes the whole commit protocol).
+        assert ENGINE_CRASH_POINTS[0] == "block_fetched"
+        faults = MapFaults.parse("crash=0:1:block_fetched")
+        hook = faults.crash_hook(0, 1)
+        assert hook is not None
+        assert faults.crash_hook(1, 1) is None
+        with pytest.raises(ValueError, match="crash"):
+            MapFaults.parse("crash=0:1:mid_flight")
+
+    def test_preempt_with_block_in_flight_resumes_byte_identical(
+            self, trunk, corpus, tmp_path):
+        params, cfg = trunk
+        ids, seqs = corpus
+        control = str(tmp_path / "control")
+        run_map(params, cfg, ids, seqs, control, **MAP_KW)
+        store = str(tmp_path / "store")
+        out = run_map(params, cfg, ids, seqs, store,
+                      **dict(MAP_KW, max_blocks=1))
+        # SIGTERM contract with the window open: the in-flight block is
+        # committed before the shard parks, so the preempt is clean.
+        assert out["outcome"] == "preempted"
+        out = run_map(params, cfg, ids, seqs, store, **MAP_KW)
+        assert out["outcome"] == "completed"
+        assert verify_store(store)["complete"]
+        assert store_digests(store) == store_digests(control)

@@ -315,6 +315,156 @@ class TestSchedulerFormation:
         assert len(done) == 2
 
 
+# ------------------------------- pipelined dispatch window (ISSUE 19)
+
+class AsyncFakeDispatcher(FakeDispatcher):
+    """FakeDispatcher wearing the `run_timed_async` in-flight surface:
+    submit records the batch and returns a handle; the row-index echo
+    materializes only at finalize() — device completion decoupled from
+    the host fetch, like the real BucketDispatcher. An optional
+    `finalize_gate` Event holds every finalize until set, so threaded
+    tests can pin work in flight deterministically."""
+
+    def __init__(self, fail_kinds=(), finalize_gate=None):
+        super().__init__(fail_kinds)
+        self.finalized = []
+        self.finalize_gate = finalize_gate
+
+    def run_timed_async(self, kind, tokens, annotations=None,
+                        timed=False, **extra):
+        if kind in self.fail_kinds:
+            raise RuntimeError(f"injected dispatch failure for {kind}")
+        self.batches.append((kind, tokens.shape))
+        disp = self
+
+        class _Handle:
+            def finalize(self):
+                if disp.finalize_gate is not None:
+                    disp.finalize_gate.wait(10)
+                disp.finalized.append((kind, tokens.shape))
+                return (np.arange(tokens.shape[0], dtype=np.float32), {})
+
+        return _Handle()
+
+
+class TestPipelinedWindow:
+    def test_fake_clock_formation_deterministic_with_async_dispatch(self):
+        """Single-threaded poll() has no completer, so the async entry
+        sync-drains: formation, seal order, and results are
+        byte-for-byte what the blocking stub produced — the fake-clock
+        determinism contract survives the pipeline."""
+        results = []
+        for d in (FakeDispatcher(), AsyncFakeDispatcher()):
+            clock = FakeClock()
+            q = RequestQueue(max_depth=16)
+            s, done = _sched(q, d, clock, max_batch=4, max_wait_s=0.5)
+            for i in range(6):
+                q.push(_req(seq=f"s{i}", clock=clock))
+            assert s.poll() == 4       # full group, sealed before return
+            assert len(done) == 4
+            clock.advance(0.6)
+            assert s.poll() == 2       # remainder on the wait trigger
+            assert s.poll() == 0
+            results.append((
+                [r.seq for r, _ in done],
+                [b[1] for b in d.batches],
+                [float(r.future.result(timeout=0)) for r, _ in done]))
+        assert results[0] == results[1]
+
+    def test_sync_drain_never_accumulates_inflight(self):
+        q = RequestQueue()
+        s, _ = _sched(q, AsyncFakeDispatcher(), FakeClock(),
+                      max_batch=2, max_wait_s=10.0)
+        for i in range(4):
+            q.push(_req(seq=f"s{i}"))
+        assert s.poll() == 2 and s.poll() == 2
+        stats = s.pipeline_stats()
+        assert stats["inflight_max"] == 1   # submit → inline finalize
+        assert stats["finalize_seconds_total"] > 0.0
+
+    def test_submit_failure_rides_window_fails_batch_keeps_scheduler(self):
+        clock = FakeClock()
+        q = RequestQueue()
+        d = AsyncFakeDispatcher(fail_kinds={"embed"})
+        s, done = _sched(q, d, clock, max_batch=2, max_wait_s=10.0)
+        bad = [_req(kind="embed", clock=clock) for _ in range(2)]
+        for r in bad:
+            q.push(r)
+        assert s.poll() == 2
+        for r in bad:
+            with pytest.raises(RuntimeError, match="injected"):
+                r.future.result(timeout=0)
+        ok = [_req(kind="predict_go", clock=clock) for _ in range(2)]
+        for r in ok:
+            q.push(r)
+        assert s.poll() == 2           # still serving after the failure
+        assert len(done) == 2
+
+    def _run_threaded(self, n_requests, finish):
+        """Start a real scheduler+completer, pin the FIRST finalize
+        behind a gate until `n_requests/4` batches are submitted (work
+        genuinely in flight), then run `finish(s, q, reqs)` and join.
+        Returns (scheduler, dispatcher, reqs, done)."""
+        gate = threading.Event()
+        d = AsyncFakeDispatcher(finalize_gate=gate)
+        q = RequestQueue(max_depth=2 * n_requests)
+        done = []
+        s = MicroBatchScheduler(
+            q, d, lambda req, row: done.append(req)
+            or req.future.set_result(row),
+            max_batch=4, max_wait_s=0.005, pipeline_depth=2)
+        reqs = [_req(seq=f"s{i}") for i in range(n_requests)]
+        for r in reqs:
+            q.push(r)
+        s.start()
+        # Completer blocks on the gate; the scheduler keeps submitting
+        # until the depth-2 window is full — batches pile up in flight.
+        deadline = time.monotonic() + 5.0
+        while len(d.batches) < 3 and time.monotonic() < deadline:
+            time.sleep(0.002)
+        assert len(d.batches) >= 3, "scheduler never filled the window"
+        finish(s, q, reqs)
+        gate.set()
+        assert s.join(10), "scheduler thread failed to drain"
+        return s, d, reqs, done
+
+    def test_drain_with_batches_in_flight_seals_exactly_once(self):
+        s, d, reqs, done = self._run_threaded(
+            12, lambda s, q, reqs: q.close())
+        # Every future sealed exactly once, results correct, nothing
+        # finalized twice.
+        assert len(done) == len(reqs)
+        assert len({id(r) for r in done}) == len(reqs)
+        for r in reqs:
+            assert r.future.done() and r.future.exception() is None
+        assert len(d.finalized) == len(d.batches) == 3
+        assert s.stats_counts()[:2] == (3, 12)
+        # The window genuinely overlapped: gate held finalize #1 while
+        # later batches were submitted into the depth-2 window.
+        assert s.pipeline_stats()["inflight_max"] == 2
+
+    def test_abort_with_batch_in_flight_seals_exactly_once(self):
+        boom = ServerClosedError("aborted")
+
+        def finish(s, q, reqs):
+            s.stop()  # abort: loop exits, epilogue resolves the window
+
+        s, d, reqs, done = self._run_threaded(16, finish)
+        failed = s.fail_pending(boom)  # what Server.abort does next
+        # Disjoint exactly-once partition: every submitted batch's rows
+        # sealed ok by the drain epilogue, every undispatched row
+        # failed with the abort error — no request in both, none lost.
+        sealed = {id(r) for r in done}
+        aborted = {id(r) for r in failed}
+        assert not (sealed & aborted)
+        assert len(sealed) + len(aborted) == len(reqs)
+        assert len(done) == len(d.finalized) * 4
+        for r in reqs:
+            assert r.future.done()
+            exc = r.future.exception()
+            assert exc is None or exc is boom
+
+
 # --------------------------------------------------- dispatcher routing
 
 class TestDispatchRouting:

@@ -410,6 +410,7 @@ class TestServerTracing:
         srv = _server(trunk, tele, cache_size=0)
         def boom(*a, **kw):
             raise RuntimeError("injected device failure")
+        monkeypatch.setattr(srv.dispatcher, "run_timed_async", boom)
         monkeypatch.setattr(srv.dispatcher, "run_timed", boom)
         monkeypatch.setattr(srv.dispatcher, "run", boom)
         srv.start()

@@ -54,22 +54,37 @@ K_SIGMA = 3.0          # band half-width in robust sigmas
 REL_FLOOR = 0.10       # …never narrower than 10% of the baseline
 VERDICT_SCHEMA = 1
 
+# Per-series ABSOLUTE noise floors (keyed by base name, before the
+# /platform suffix), for DIFFERENCE series whose center sits near 0:
+# there REL_FLOOR * |median| collapses to ~nothing and the MAD of a
+# handful of sign-flipping points understates the true swing.
+# fleet_trace_overhead_pct is a matched-pair throughput delta in
+# percentage points measured on loaded CI hosts — it sign-flipped 3/7
+# rounds in the PR 18 captures (observed swing ±14pp), so anything
+# inside ±10pp is load noise, not a propagation-cost change.
+_ABS_FLOOR = {"fleet_trace_overhead_pct": 10.0}
 
-def fit_baseline(prior: List[float]) -> Tuple[float, float]:
+
+def fit_baseline(prior: List[float],
+                 abs_floor: float = 0.0) -> Tuple[float, float]:
     """(center, band) from the prior points: robust location (median)
     and a noise band from the scaled MAD, floored at REL_FLOOR of the
-    center so near-constant series still tolerate small wobble."""
+    center so near-constant series still tolerate small wobble, and at
+    `abs_floor` for near-zero-centered difference series."""
     center = median(prior)
     mad = median([abs(x - center) for x in prior])
     scale = 1.4826 * mad  # MAD → sigma under normality
-    band = max(K_SIGMA * scale, REL_FLOOR * abs(center))
+    band = max(K_SIGMA * scale, REL_FLOOR * abs(center), abs_floor)
     return center, band
 
 
 def judge_series(values: List[float],
-                 higher_is_better: bool = True) -> Dict[str, Any]:
+                 higher_is_better: bool = True,
+                 name: Optional[str] = None) -> Dict[str, Any]:
     """Verdict for one metric series (oldest → newest). The newest
-    point is judged against a baseline fit on everything before it."""
+    point is judged against a baseline fit on everything before it.
+    `name` (the series key) selects any per-series absolute noise
+    floor from _ABS_FLOOR."""
     out: Dict[str, Any] = {
         "values": [round(v, 6) for v in values],
         "n": len(values),
@@ -82,7 +97,8 @@ def judge_series(values: List[float],
                          "required for a baseline")
         return out
     newest = values[-1]
-    center, band = fit_baseline(prior)
+    abs_floor = _ABS_FLOOR.get((name or "").split("/")[0], 0.0)
+    center, band = fit_baseline(prior, abs_floor=abs_floor)
     out.update(baseline=round(center, 6), noise_band=round(band, 6),
                newest=round(newest, 6))
     delta = newest - center
@@ -217,6 +233,15 @@ _EVENT_METRICS = (
     # trace context onto every routed request got more expensive.
     ("fleet_trace_capture", "fleet_trace_overhead_pct",
      "fleet_trace_overhead_pct"),
+    # Pipelined dispatch (ISSUE 19): depth-2 vs depth-1 serve
+    # throughput ratio (bench --serve pipeline phase; parity- and
+    # seal-gated), and the mapper's overlapped-commit share from the
+    # map drill's control run. CPU points are honest plumbing numbers
+    # — host and device share cores — and stay separate from TPU
+    # points via the platform split like every other series.
+    ("serve_pipeline_capture", "serve_pipeline_speedup_x",
+     "serve_pipeline_speedup_x"),
+    ("map_capture", "map_overlap_ratio", "map_overlap_ratio"),
 )
 
 # Series (by base name, before the /platform suffix) where a LOWER
@@ -295,7 +320,8 @@ def build_verdict(bench_paths: List[str],
             series.setdefault("check_findings_total/static",
                               []).append(float(v))
     judged = {name: judge_series(values,
-                                 higher_is_better=series_direction(name))
+                                 higher_is_better=series_direction(name),
+                                 name=name)
               for name, values in sorted(series.items())}
     verdicts = [s["verdict"] for s in judged.values()]
     if errors:
@@ -314,7 +340,7 @@ def build_verdict(bench_paths: List[str],
                                    for p in bench_paths],
                    "events": events_path},
         "policy": {"min_history": MIN_HISTORY, "k_sigma": K_SIGMA,
-                   "rel_floor": REL_FLOOR},
+                   "rel_floor": REL_FLOOR, "abs_floors": dict(_ABS_FLOOR)},
         "series": judged,
         "errors": errors,
     }

@@ -12,12 +12,19 @@ through REAL `pbt map` subprocesses:
   recorded tail block object is truncated mid-file and shard 1's main
   cursor is torn — then run 2 resumes under an injected transient
   dispatch failure (2 retries) and must complete;
+- the WINDOW line (ISSUE 19): a run SIGKILLed at the NEW
+  `block_fetched` crash point — the pipelined dispatch window where a
+  block's device compute AND host fetch have completed but its commit
+  (object write + cursor advance) has not happened yet, while the
+  NEXT block is already in flight — then one plain resume;
 - the CONTROL line: one uninterrupted run over the same corpus into a
   fresh store.
 
 Gates (exit nonzero on violation — tier-1 runs this as a smoke stage):
   - the resumed chaos store is BYTE-IDENTICAL to the control store
-    (same (shard, block) → digest map, same object bytes);
+    (same (shard, block) → digest map, same object bytes), and so is
+    the resumed WINDOW store (a device-complete-but-uncommitted block
+    is re-worked, never half-committed);
   - both stores pass `verify_store` complete+ok, and `pbt map
     --verify` (the real CLI) exits 0 on the chaos store;
   - re-work is bounded: map_block events across both chaos runs exceed
@@ -203,10 +210,33 @@ def run_drill(args) -> dict:
     if rcc != 0:
         failures.append(f"control run exited {rcc}; see {log_path}")
 
+    # ---- window line (ISSUE 19): SIGKILL at the NEW block_fetched
+    # point of shard 0 block 1 — fired after that block's device
+    # compute and host fetch completed, before its object write and
+    # cursor advance, while the pipelined drive loop has the NEXT
+    # block already submitted. The cursor never moved, so a plain
+    # resume must re-work exactly the uncommitted tail.
+    window_store = os.path.join(outdir, "window_store")
+    evw1 = os.path.join(outdir, "window_run1.events.jsonl")
+    evw2 = os.path.join(outdir, "window_run2.events.jsonl")
+    rcw1 = _run(_map_cmd(rundir, window_store, corpus, evw1),
+                env_extra={FAULT_ENV: map_fault_spec(
+                    crash=(0, 1, "block_fetched"))},
+                log_path=log_path)
+    if rcw1 not in (-9, 137):
+        failures.append(f"window run 1 exited {rcw1}, expected a "
+                        "SIGKILL death at block_fetched (-9/137)")
+    rcw2 = _run(_map_cmd(rundir, window_store, corpus, evw2),
+                log_path=log_path)
+    if rcw2 != 0:
+        failures.append(f"window run 2 (resume) exited {rcw2}; see "
+                        f"{log_path}")
+
     # ------------------------------------------------------------ audit
     chaos_rep = control_rep = None
     retries = 0
     rework = None
+    window_rework = None
     if not failures:
         # Byte identity: same (shard, block) → digest map, same bytes.
         dg_chaos = store_digests(chaos_store)
@@ -261,6 +291,31 @@ def run_drill(args) -> dict:
         ends = [r for r in run2_recs if r["event"] == "map_end"]
         if not ends or ends[-1]["outcome"] != "completed":
             failures.append("chaos run 2 did not seal map_end/completed")
+
+        # Window line audit: byte-identity vs control, verification,
+        # and the same 1-block-per-shard re-work bound — the pipelined
+        # device-complete-but-uncommitted window adds no new loss mode.
+        dg_window = store_digests(window_store)
+        if dg_window != dg_control:
+            failures.append(
+                "window store differs from control after the "
+                "block_fetched kill + resume: "
+                f"{sorted(dg_window.items())} vs "
+                f"{sorted(dg_control.items())}")
+        wrep = verify_store(window_store)
+        if not (wrep["ok"] and wrep["complete"]):
+            failures.append(
+                f"window store failed verification: holes="
+                f"{wrep['holes']} corrupt={wrep['corrupt']} "
+                f"complete={wrep['complete']}")
+        w_blocks = [r for p in (evw1, evw2)
+                    for r in read_events(p, strict=True)
+                    if r["event"] == "map_block"]
+        w_unique = {(r["shard"], r["block"]) for r in w_blocks}
+        window_rework = len(w_blocks) - len(w_unique)
+        if window_rework > NUM_SHARDS:
+            failures.append(f"window re-work {window_rework} blocks > "
+                            f"bound of 1 per shard ({NUM_SHARDS})")
 
         # diagnose --map over the concatenated chaos streams agrees on
         # the re-work count (the operator-facing view of the drill).
@@ -327,6 +382,7 @@ def run_drill(args) -> dict:
         "embedded": (chaos_rep or {}).get("embedded"),
         "quarantined": (chaos_rep or {}).get("quarantined"),
         "rework_blocks": rework,
+        "window_rework_blocks": window_rework,
         "retries": retries,
         "torn_block": (torn_digest or "")[:16],
         "wall_s": round(time.monotonic() - t0, 1),
@@ -343,14 +399,22 @@ def run_drill(args) -> dict:
         ctrl_end = [r for r in read_events(evc, strict=True)
                     if r["event"] == "map_end"][-1]
         elog = EventLog(args.bench_events)
+        # overlap_ratio rides the control map_end stats (ISSUE 19):
+        # overlapped-commit seconds / total commit seconds for the
+        # pipelined drive loop — honestly near-meaningless on CPU
+        # wall-clock terms but the sentinel tracks it platform-split.
         elog.emit("note", source="map_drill", kind="map_capture",
                   platform="cpu",
                   map_seqs_per_s=ctrl_end["stats"]["seqs_per_s"],
+                  map_overlap_ratio=ctrl_end["stats"].get(
+                      "overlap_ratio", 0.0),
                   blocks=ctrl_end["stats"]["blocks"],
                   seqs=ctrl_end["stats"]["seqs"],
                   corpus=args.corpus)
         elog.close()
         summary["map_seqs_per_s"] = ctrl_end["stats"]["seqs_per_s"]
+        summary["map_overlap_ratio"] = ctrl_end["stats"].get(
+            "overlap_ratio", 0.0)
     return summary
 
 

@@ -1,0 +1,181 @@
+#!/usr/bin/env python
+"""Pipeline smoke (ISSUE 19, tier-1 stage): the pipelined-dispatch
+window exercised end to end on an in-process CPU server, and GATED on
+its three invariants rather than wall-clock:
+
+  - **overlap observed** — under a saturated single-thread burst the
+    depth-2 window actually fills: `pipeline_stats()['inflight_max']`
+    (the high-water mark behind the `serve_inflight_batches` gauge)
+    reaches >= 2, and the `serve_finalize_seconds` histogram saw every
+    finalize;
+  - **async-vs-sync bit-parity** — one full same-bucket micro-batch,
+    formed deterministically (max_wait 60s + exactly max_batch FIFO
+    submits) on a depth-1 and a depth-2 server, produces BIT-identical
+    per-request outputs: the submit/fetch split moves the host fetch,
+    never the math;
+  - **schema-valid events, exactly-once seals** — both arms' fully
+    traced event streams re-read with `read_events(strict=True)`, and
+    the depth-2 stream carries exactly one `serve_request` record per
+    submitted request with no duplicated ids (zero lost or duplicate
+    seals through the completer thread).
+
+Exit nonzero on any violation — this stage GATES (run_tier1.sh).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+SEQ_LEN = int(os.environ.get("PBT_PIPELINE_SMOKE_SEQ_LEN", 96))
+DIM = int(os.environ.get("PBT_PIPELINE_SMOKE_DIM", 32))
+N_REQUESTS = int(os.environ.get("PBT_PIPELINE_SMOKE_REQUESTS", 48))
+MAX_BATCH = int(os.environ.get("PBT_PIPELINE_SMOKE_MAX_BATCH", 4))
+
+
+def main() -> int:
+    import jax
+    import numpy as np
+
+    from proteinbert_tpu.configs import (
+        DataConfig, ModelConfig, OptimizerConfig, PretrainConfig,
+        TrainConfig,
+    )
+    from proteinbert_tpu.data.vocab import ALPHABET
+    from proteinbert_tpu.obs import Telemetry, read_events
+    from proteinbert_tpu.serve import Server
+    from proteinbert_tpu.train import create_train_state
+
+    buckets = (SEQ_LEN // 4, SEQ_LEN // 2, SEQ_LEN)
+    cfg = PretrainConfig(
+        model=ModelConfig(local_dim=DIM, global_dim=2 * DIM, key_dim=8,
+                          num_heads=2, num_blocks=1,
+                          num_annotations=128, dtype="float32"),
+        data=DataConfig(seq_len=SEQ_LEN, batch_size=MAX_BATCH,
+                        buckets=buckets),
+        optimizer=OptimizerConfig(warmup_steps=10),
+        train=TrainConfig(max_steps=1))
+    params = create_train_state(jax.random.PRNGKey(0), cfg).params
+
+    rng = np.random.default_rng(7)
+    alphabet = np.array(list(ALPHABET))
+    lengths = rng.integers(8, SEQ_LEN - 2, size=N_REQUESTS)
+    seqs = ["".join(rng.choice(alphabet, size=int(n))) for n in lengths]
+
+    failures = []
+    tdir = tempfile.mkdtemp(prefix="pbt_pipeline_smoke_")
+
+    # ---- deterministic full-batch bit-parity (depth 1 vs depth 2) ----
+    # Same-bucket group, FIFO submits, max_wait 60s: both depths form
+    # ONE identical (bucket_len, rows) batch over identical rows.
+    probe = Server(params, cfg, max_batch=MAX_BATCH, max_wait_s=60.0,
+                   cache_size=0, warm_kinds=())
+    by_bucket = {}
+    for s in seqs:
+        by_bucket.setdefault(probe.dispatcher.bucket_len(len(s)),
+                             []).append(s)
+    group = max(by_bucket.values(), key=len)
+    group = (group * MAX_BATCH)[:MAX_BATCH]
+    outs = {}
+    for depth in (1, 2):
+        psrv = Server(params, cfg, max_batch=len(group), max_wait_s=60.0,
+                      cache_size=0, warm_kinds=(), pipeline_depth=depth)
+        psrv.start()  # depth 2 runs the live completer thread
+        futs = [psrv.submit("embed", s) for s in group]
+        outs[depth] = [f.result(timeout=120) for f in futs]
+        psrv.drain(timeout=60)
+    bit = sum(
+        all(np.array_equal(a[k], b[k]) for k in ("global", "local_mean"))
+        for a, b in zip(outs[1], outs[2]))
+    if bit != len(group):
+        failures.append(
+            f"async-vs-sync parity: {len(group) - bit}/{len(group)} "
+            "outputs not bit-identical on an identical batch")
+
+    # ---- saturated burst through the window, fully traced ------------
+    arm_events = {}
+    inflight_max = 0
+    snap = {}
+    for name, depth in (("serial", 1), ("pipelined", 2)):
+        events = os.path.join(tdir, f"{name}.jsonl")
+        arm_events[name] = events
+        tele = Telemetry(events_path=events)
+        srv = Server(params, cfg, max_batch=MAX_BATCH, max_wait_s=0.005,
+                     queue_depth=4 * N_REQUESTS, cache_size=0,
+                     warm_kinds=("embed",), telemetry=tele,
+                     trace_sample_rate=1.0, pipeline_depth=depth)
+        srv.start()
+        burst = [srv.submit("embed", s) for s in seqs]
+        srv.drain(timeout=120)  # drain with work in flight
+        unresolved = sum(1 for f in burst if not f.done())
+        errored = sum(1 for f in burst if f.done() and f.exception())
+        if unresolved or errored:
+            failures.append(
+                f"{name}: {unresolved} unresolved / {errored} errored "
+                f"of {len(burst)} burst futures under drain")
+        pstats = srv.scheduler.pipeline_stats()
+        if name == "pipelined":
+            inflight_max = pstats["inflight_max"]
+            snap = tele.metrics.snapshot()
+            if inflight_max < 2:
+                failures.append(
+                    f"overlap not observed: inflight_max "
+                    f"{inflight_max} < 2 on the depth-2 burst")
+            if not any("serve_inflight_batches" in k
+                       for k in snap["gauges"]):
+                failures.append("serve_inflight_batches gauge never "
+                                "registered on the pipelined arm")
+            if not any("serve_finalize_seconds" in k
+                       for k in snap["histograms"]):
+                failures.append("serve_finalize_seconds histogram never "
+                                "observed on the pipelined arm")
+        elif pstats["depth"] != 1:
+            failures.append(f"serial arm reports depth "
+                            f"{pstats['depth']}, expected 1")
+        tele.close()
+
+    # ---- events: schema-valid, exactly one seal per request ----------
+    for name, events in arm_events.items():
+        try:
+            recs = read_events(events, strict=True)
+        except Exception as e:  # noqa: BLE001 — the gate itself
+            failures.append(f"{name}: event stream failed strict "
+                            f"re-read: {type(e).__name__}: {e}")
+            continue
+        ids = [r["request_id"] for r in recs
+               if r["event"] == "serve_request"]
+        if len(ids) != N_REQUESTS or len(set(ids)) != len(ids):
+            failures.append(
+                f"{name}: {len(ids)} serve_request records "
+                f"({len(ids) - len(set(ids))} duplicated ids) for "
+                f"{N_REQUESTS} submitted requests")
+
+    summary = {
+        "metric": "pipeline_smoke",
+        "platform": jax.devices()[0].platform,
+        "seq_len": SEQ_LEN, "max_batch": MAX_BATCH,
+        "n_requests": N_REQUESTS,
+        "parity": {"checked": len(group), "bit_identical": bit},
+        "inflight_max": inflight_max,
+        "failures": failures,
+    }
+    print(json.dumps(summary))
+    if failures:
+        for f in failures:
+            print(f"PIPELINE SMOKE FAILURE: {f}", file=sys.stderr)
+        return 1
+    print("pipeline smoke OK: window filled (inflight_max "
+          f"{inflight_max}), async==sync bit-identical, "
+          "exactly-once seals, events schema-valid", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

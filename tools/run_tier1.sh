@@ -16,6 +16,18 @@
 # ZeRO-1 zero-update.
 set -o pipefail
 
+# Per-stage wall-time accounting (ISSUE 19 satellite): each stage calls
+# mark_stage <name> when it finishes; the one-line summary printed at
+# exit makes "which stage ate the tier-1 budget" a grep, not a rerun
+# (the tier-1 timeout is host-bound — see ROADMAP).
+STAGE_SUMMARY=""
+stage_t0=$SECONDS
+mark_stage() {
+  local now=$SECONDS
+  STAGE_SUMMARY="$STAGE_SUMMARY $1=$((now - stage_t0))s"
+  stage_t0=$now
+}
+
 POD64=0
 PACKED_MD=0
 for arg in "$@"; do
@@ -30,6 +42,7 @@ rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+mark_stage pytest
 
 # Events-schema validator self-test (ISSUE 3 satellite): every telemetry
 # event type must round-trip the validator, and garbage must be
@@ -40,6 +53,7 @@ echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd
 echo "=== telemetry events-schema validator self-test + schema-sync ==="
 python "$(dirname "$0")/validate_events.py" --self-test --schema-sync
 rcv=$?
+mark_stage events_schema
 [ "$rc" -eq 0 ] && rc=$rcv
 
 # Project-invariant static analyzer (ISSUE 15 tentpole): six AST rules
@@ -56,6 +70,7 @@ timeout -k 10 120 python "$(dirname "$0")/pbt_check.py" \
   --json-artifact "$check_json"
 rcc=$?
 echo "check artifact: $check_json"
+mark_stage pbt_check
 [ "$rc" -eq 0 ] && rc=$rcc
 
 # Perf-regression sentinel (ISSUE 6 satellite): fit per-metric
@@ -69,6 +84,7 @@ python "$(dirname "$0")/bench_trajectory.py" --output "$verdict_json" \
   --check-json "$check_json"
 rct=$?
 echo "verdict artifact: $verdict_json"
+mark_stage sentinel
 [ "$rc" -eq 0 ] && rc=$rct
 
 # Serving smoke (ISSUE 5 satellite): in-process server on CPU under
@@ -84,6 +100,7 @@ timeout -k 10 420 env JAX_PLATFORMS=cpu \
   PBT_SERVE_BENCH_TRACE_ROUNDS=3 PBT_SERVE_BENCH_PHASES=core \
   python "$(dirname "$0")/../bench.py" --serve
 rcs=$?
+mark_stage serve_smoke
 [ "$rc" -eq 0 ] && rc=$rcs
 
 # Ragged serve smoke (ISSUE 9 satellite): bucketed vs ragged packed
@@ -100,7 +117,19 @@ timeout -k 10 420 env JAX_PLATFORMS=cpu \
   python "$(dirname "$0")/../bench.py" --serve \
   --serve-length-mix 'median=32,sigma=1.0,seed=7'
 rcr=$?
+mark_stage ragged_smoke
 [ "$rc" -eq 0 ] && rc=$rcr
+
+# Pipeline smoke (ISSUE 19 satellite): the pipelined-dispatch window on
+# an in-process depth-1 vs depth-2 server pair. GATED: overlap observed
+# (inflight_max >= 2, the serve_inflight_batches high-water mark),
+# async-vs-sync BIT-parity on a deterministically formed batch, and
+# exactly-once seals with schema-valid event streams on both arms.
+echo "=== pipeline smoke (pipelined dispatch window, CPU) ==="
+timeout -k 10 300 python "$(dirname "$0")/pipeline_smoke.py"
+rcpl=$?
+mark_stage pipeline_smoke
+[ "$rc" -eq 0 ] && rc=$rcpl
 
 # Packed fused fast-path smoke (ISSUE 10 satellite): a tiny packed
 # batch through the segment-aware Pallas kernel at a lane-aligned dim
@@ -120,6 +149,7 @@ timeout -k 10 420 env JAX_PLATFORMS=cpu \
   PBT_PACK_BENCH_FUSED_REPS=2 \
   python "$(dirname "$0")/../bench.py" --pack
 rcf=$?
+mark_stage pack_smoke
 [ "$rc" -eq 0 ] && rc=$rcf
 
 # Packed attention smoke (ISSUE 13): the ragged Pallas attention
@@ -133,6 +163,7 @@ rcf=$?
 echo "=== packed attention smoke (Pallas attention + tiled segment, CPU) ==="
 timeout -k 10 420 python "$(dirname "$0")/attn_smoke.py"
 rca=$?
+mark_stage attn_smoke
 [ "$rc" -eq 0 ] && rc=$rca
 
 # One-pass trunk smoke (ISSUE 16 tentpole): the whole block pass —
@@ -146,6 +177,7 @@ rca=$?
 echo "=== one-pass trunk smoke (fused block pass + int8 dequant, CPU) ==="
 timeout -k 10 420 python "$(dirname "$0")/onepass_smoke.py"
 rco=$?
+mark_stage onepass_smoke
 [ "$rc" -eq 0 ] && rc=$rco
 
 # Reshard smoke (ISSUE 11): save a tiny ZeRO-1 train state on a 4x2
@@ -157,6 +189,7 @@ rco=$?
 echo "=== reshard smoke (mesh-agnostic checkpoint resharding, CPU) ==="
 timeout -k 10 300 python "$(dirname "$0")/reshard_smoke.py"
 rcre=$?
+mark_stage reshard_smoke
 [ "$rc" -eq 0 ] && rc=$rcre
 
 # Fleet drill smoke (ISSUE 11): 3 in-process serve replicas behind the
@@ -169,6 +202,7 @@ echo "=== fleet drill smoke (kill one of three replicas under load) ==="
 timeout -k 10 420 python "$(dirname "$0")/fleet_drill.py" --json \
   --replicas 3 --requests 48 --clients 8
 rcfd=$?
+mark_stage fleet_drill
 [ "$rc" -eq 0 ] && rc=$rcfd
 
 # Map drill smoke (ISSUE 14): kill-anywhere offline inference through
@@ -182,6 +216,7 @@ rcfd=$?
 echo "=== map drill smoke (SIGKILL + torn artifacts, resume, verify) ==="
 timeout -k 10 480 python "$(dirname "$0")/map_drill.py" --json
 rcmd=$?
+mark_stage map_drill
 [ "$rc" -eq 0 ] && rc=$rcmd
 
 # Index drill smoke (ISSUE 17): kill-anywhere ANN index construction
@@ -197,6 +232,7 @@ rcmd=$?
 echo "=== index drill smoke (SIGKILL mid-build, resume, verify) ==="
 timeout -k 10 300 python "$(dirname "$0")/index_drill.py" --json
 rcid=$?
+mark_stage index_drill
 [ "$rc" -eq 0 ] && rc=$rcid
 
 # Quant smoke (ISSUE 12): tiny int8 ZeRO-1 steps on the 4x2 CPU-virtual
@@ -209,6 +245,7 @@ rcid=$?
 echo "=== quant smoke (int8 reduce-scatter + int8 serve arm, CPU) ==="
 timeout -k 10 420 python "$(dirname "$0")/quant_smoke.py"
 rcq=$?
+mark_stage quant_smoke
 [ "$rc" -eq 0 ] && rc=$rcq
 
 # Multi-tenant heads smoke (ISSUE 8 satellite): the platform loop end
@@ -224,6 +261,7 @@ timeout -k 10 420 env JAX_PLATFORMS=cpu \
   PBT_HEADS_BENCH_ROUNDS=2 \
   python "$(dirname "$0")/../bench.py" --heads
 rch=$?
+mark_stage heads_smoke
 [ "$rc" -eq 0 ] && rc=$rch
 
 if [ "$PACKED_MD" = "1" ]; then
@@ -232,6 +270,7 @@ if [ "$PACKED_MD" = "1" ]; then
     python -m pytest tests/test_packing.py -q -m 'slow' \
     -p no:cacheprovider -p no:xdist -p no:randomly
   rcp=$?
+  mark_stage packed_md
   [ "$rc" -eq 0 ] && rc=$rcp
 fi
 
@@ -241,7 +280,9 @@ if [ "$POD64" = "1" ]; then
     python -m pytest tests/test_parallel64.py -q -m 'tier64' \
     -p no:cacheprovider -p no:xdist -p no:randomly
   rc64=$?
+  mark_stage pod64
   [ "$rc" -eq 0 ] && rc=$rc64
 fi
 
+echo "STAGE_WALL_TIMES:${STAGE_SUMMARY} total=${SECONDS}s"
 exit $rc

@@ -305,6 +305,29 @@ NEGATIVE_CASES = [
          "source": "bench", "kind": "fleet_trace_capture",
          "fleet_trace_overhead_pct": 0.4,
          "fleet_rps_on": 0.0},  # throughput must be > 0 when present
+        {"v": 1, "event": "note", "seq": 0, "t": 0.0,
+         "source": "bench", "kind": "fleet_trace_capture",
+         "fleet_trace_overhead_pct": 0.4,
+         "rounds": 0},  # median round count must be >= 1 when present
+        # the serve_pipeline_capture note (bench --serve pipeline A/B,
+        # ISSUE 19): the pipelined-dispatch sentinel's input.
+        {"v": 1, "event": "note", "seq": 0, "t": 0.0,
+         "source": "bench", "kind": "serve_pipeline_capture"},  # no x
+        {"v": 1, "event": "note", "seq": 0, "t": 0.0,
+         "source": "bench", "kind": "serve_pipeline_capture",
+         "serve_pipeline_speedup_x": 0.0},  # speedup must be > 0
+        {"v": 1, "event": "note", "seq": 0, "t": 0.0,
+         "source": "bench", "kind": "serve_pipeline_capture",
+         "serve_pipeline_speedup_x": 1.2,
+         "serve_overlap_ratio": 1.5},  # a ratio: [0, 1]
+        {"v": 1, "event": "note", "seq": 0, "t": 0.0,
+         "source": "bench", "kind": "serve_pipeline_capture",
+         "serve_pipeline_speedup_x": 1.2,
+         "inflight_max": -1},  # window depth watermark is >= 0
+        {"v": 1, "event": "note", "seq": 0, "t": 0.0,
+         "source": "map_drill", "kind": "map_capture",
+         "map_seqs_per_s": 10.0,
+         "map_overlap_ratio": -0.1},  # a ratio: [0, 1]
 ]
 
 

@@ -1155,6 +1155,16 @@ def cmd_serve(args) -> int:
 
     params, cfg = _load_inference_trunk(args)
 
+    def _candidate_loader(source: str):
+        """Rollout candidate arm (ISSUE 20): load a second trunk from
+        another run directory under the SAME model config — the
+        blue-green flip swaps weights, never executable shapes."""
+        from proteinbert_tpu import inference
+
+        cand, step = inference.load_trunk(source, cfg)
+        log(f"rollout candidate trunk loaded from {source} (step {step})")
+        return cand
+
     # Resolve the effective quant arm (flag > run config) up front so
     # an impossible combination is a clean operator-facing exit, not a
     # construction traceback from deep inside the dispatcher.
@@ -1277,6 +1287,7 @@ def cmd_serve(args) -> int:
             index=index,
             nprobe=args.nprobe,
             replica_id=args.replica_id,
+            candidate_loader=_candidate_loader,
         )
     except TrunkMismatchError as e:
         # The index pins the trunk its embeddings came from; serving it
@@ -1819,6 +1830,91 @@ def cmd_fleet(args) -> int:
         f"{stats['sealed']} sealed, outcomes {stats['outcomes']}, "
         f"{stats['retries_spent']} retries")
     return 0 if stats["accepted"] == stats["sealed"] else 1
+
+
+def cmd_rollout(args) -> int:
+    """Blue-green rollout control plane (ISSUE 20): drive a running
+    fleet router's /rollout/* verbs — start shadowing a candidate
+    trunk, watch the gate windows, promote the flip, or abort."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    url = args.url.rstrip("/")
+
+    def _call(method, path, body=None):
+        data = None
+        headers = {}
+        if body is not None:
+            data = _json.dumps(body).encode("utf-8")
+            headers["Content-Type"] = "application/json"
+        req = urllib.request.Request(url + path, data=data,
+                                     headers=headers, method=method)
+        try:
+            with urllib.request.urlopen(req,
+                                        timeout=args.timeout_s) as resp:
+                return resp.getcode(), _json.loads(
+                    resp.read().decode("utf-8"))
+        except urllib.error.HTTPError as e:
+            raw = e.read()
+            try:
+                payload = _json.loads(raw.decode("utf-8"))
+            except (ValueError, UnicodeDecodeError):
+                payload = {"error": "unparseable_reply",
+                           "detail": raw[:200].decode("utf-8", "replace")}
+            return e.code, payload
+        except (urllib.error.URLError, OSError) as e:
+            raise SystemExit(f"router unreachable at {url}: {e}")
+
+    if args.verb == "start":
+        if not args.source:
+            raise SystemExit("rollout start requires --source "
+                             "(candidate trunk run directory)")
+        spec = {
+            "source": args.source,
+            "sample_every": args.sample_every,
+            "window_requests": args.window_requests,
+            "windows_required": args.windows,
+            "shadow_parity_max": args.parity_max,
+            "slo_burn_delta_max": args.burn_delta_max,
+            "auto_promote": not args.no_auto_promote,
+        }
+        if args.hbm_budget_bytes is not None:
+            spec["hbm_budget_bytes"] = args.hbm_budget_bytes
+        status, out = _call("POST", "/rollout/start", spec)
+    elif args.verb == "status":
+        status, out = _call("GET", "/rollout/status")
+    elif args.verb == "promote":
+        status, out = _call("POST", "/rollout/promote")
+    else:
+        status, out = _call("POST", "/rollout/abort")
+
+    if args.json:
+        print(_json.dumps(out, indent=2, sort_keys=True))
+    elif status != 200:
+        log(f"rollout {args.verb} failed (HTTP {status}): "
+            f"{out.get('error', '?')} — {out.get('detail', '')}")
+    elif args.verb == "status":
+        ro = out.get("rollout")
+        if ro is None:
+            log("no rollout attached; fleet is "
+                f"{out.get('fleet_state', '?')}")
+        else:
+            log(f"rollout [{ro['state']}] source={ro.get('source')} "
+                f"candidate={str(ro.get('candidate_fingerprint'))[:12]} "
+                f"windows {ro['windows_green']}/{ro['windows_required']} "
+                f"green, shadows {ro['shadow_ok']} ok / "
+                f"{ro['shadow_failed']} failed "
+                f"({ro['dropped']} dropped)")
+        log(f"fleet {out.get('fleet_state', '?')}: " + ", ".join(
+            f"{n}={str(fp)[:12]}"
+            for n, fp in sorted((out.get("fingerprints") or {}).items()))
+            or "no routable fingerprints yet")
+    else:
+        log(f"rollout {args.verb}: ok — "
+            + ", ".join(f"{k}={v}" for k, v in sorted(out.items())
+                        if k != "ok"))
+    return 0 if status == 200 else 1
 
 
 # ------------------------------------------------------------------ parser
@@ -2479,6 +2575,52 @@ def build_parser() -> argparse.ArgumentParser:
                     help="record current findings as suppressions for "
                          "human review")
     ck.set_defaults(fn=cmd_check)
+
+    ro = sub.add_parser(
+        "rollout",
+        help="blue-green trunk rollout against a running fleet router: "
+             "shadow a candidate trunk on live traffic, gate on "
+             "parity/SLO/heads-eval windows, promote atomically, "
+             "abort/roll back instantly (docs/serving.md)")
+    ro.add_argument("verb",
+                    choices=["start", "status", "promote", "abort"],
+                    help="start: load + shadow a candidate; status: "
+                         "gate windows + fleet fingerprint coherence; "
+                         "promote: atomic flip (requires the green "
+                         "streak); abort: unload, or roll a promoted "
+                         "flip back")
+    ro.add_argument("--url", default="http://127.0.0.1:8475",
+                    help="fleet router base URL")
+    ro.add_argument("--source",
+                    help="candidate trunk run directory, resolved by "
+                         "each replica's own loader (start only)")
+    ro.add_argument("--sample-every", type=int, default=2,
+                    help="mirror every Nth live request to the shadow "
+                         "arm (1 = all traffic)")
+    ro.add_argument("--window-requests", type=int, default=8,
+                    help="shadow responses per gate window")
+    ro.add_argument("--windows", type=int, default=2,
+                    help="consecutive green windows required before "
+                         "promotion")
+    ro.add_argument("--parity-max", type=float, default=1e-3,
+                    help="max |live − shadow| over shared numeric "
+                         "response leaves")
+    ro.add_argument("--burn-delta-max", type=float, default=0.5,
+                    help="max fleet SLO burn-rate rise vs the "
+                         "pre-rollout baseline")
+    ro.add_argument("--hbm-budget-bytes", type=int,
+                    help="per-replica HBM budget for the two-trunk "
+                         "residency check (default: replica-side "
+                         "detection)")
+    ro.add_argument("--no-auto-promote", action="store_true",
+                    help="stop at the green streak and wait for an "
+                         "explicit `pbt rollout promote`")
+    ro.add_argument("--timeout-s", type=float, default=120.0,
+                    help="HTTP timeout per control verb (start blocks "
+                         "on candidate load + warmup fleet-wide)")
+    ro.add_argument("--json", action="store_true",
+                    help="raw router reply on stdout")
+    ro.set_defaults(fn=cmd_rollout)
 
     return p
 

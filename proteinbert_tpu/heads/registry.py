@@ -80,6 +80,14 @@ class TrunkMismatchError(HeadRegistryError, ValueError):
     one; applying it would silently produce garbage."""
 
 
+class UnfrozenHeadError(HeadRegistryError, ValueError):
+    """`migrate_fingerprint` was asked to re-pin a head that was trained
+    with `freeze_trunk=False`: its weights co-adapted to the exact trunk
+    it trained with, so pinning them to a DIFFERENT trunk would be a
+    silent quality lie — the typed refusal of the rollout head-migration
+    contract (ISSUE 20). Re-finetune against the new trunk instead."""
+
+
 def _flatten(tree: Any, path: tuple = ()) -> Dict[str, np.ndarray]:
     """Pytree of arrays → {"out/kernel": np.ndarray, ...} (sorted keys,
     fp-preserving) — the export.py flat-NPZ idiom without the jax
@@ -286,6 +294,53 @@ class HeadRegistry:
         task = config_from_dict(meta["task"], TaskConfig)
         return LoadedHead(head_id=head_id, name=meta.get("name", head_id),
                           task=task, params=_unflatten(flat), meta=meta)
+
+    # ----------------------------------------------------------- migrate
+
+    def migrate_fingerprint(self, head_id: str, new_trunk_fp: str,
+                            note: Optional[str] = None) -> Dict[str, Any]:
+        """Re-pin one registered head to a new trunk fingerprint
+        (blue-green rollout promotion, ISSUE 20) with an audit trail.
+
+        Only FROZEN-trunk heads migrate: a head trained with
+        `freeze_trunk=True` is a function of the trunk's OUTPUT SPACE,
+        and the rollout gate (`heads_eval_score_min` delta through the
+        candidate trunk) has measured that space before any promotion;
+        an unfrozen head co-adapted to its exact trunk and gets the
+        typed `UnfrozenHeadError` instead. The rewrite is in-place and
+        atomic (tmp file + os.replace), keeps the head_id (the
+        directory name stays the content address of the ORIGINAL
+        registration — `_read_meta` checks identity against the
+        directory, and `load()` verifies weights by digest, so an
+        artifact can never silently point at different weights), and
+        appends one {from, to, at, note} record to `meta["migrations"]`.
+        Returns the updated meta. Idempotent when already pinned to
+        `new_trunk_fp`."""
+        meta = self._read_meta(head_id)
+        task = config_from_dict(meta["task"], TaskConfig)
+        if not task.freeze_trunk:
+            raise UnfrozenHeadError(
+                f"head {head_id} ({meta.get('name')}) was trained with "
+                "freeze_trunk=False — its weights co-adapted to trunk "
+                f"{meta['trunk_fingerprint'][:12]}… and cannot be "
+                "re-pinned to a different trunk; re-finetune it against "
+                "the new trunk instead")
+        old_fp = meta["trunk_fingerprint"]
+        if old_fp == str(new_trunk_fp):
+            return meta
+        meta["trunk_fingerprint"] = str(new_trunk_fp)
+        meta.setdefault("migrations", []).append({
+            "from": old_fp,
+            "to": str(new_trunk_fp),
+            "at": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+            "note": note or "",
+        })
+        path = os.path.join(self._dir(head_id), "meta.json")
+        tmp = path + f".tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(meta, f, indent=2, sort_keys=True)
+        os.replace(tmp, path)
+        return meta
 
     def verify(self, head_id: str) -> Dict[str, Any]:
         """Full integrity check (meta readable + digest matches);

@@ -165,6 +165,37 @@ EVENT_FIELDS: Dict[str, Dict[str, Any]] = {
     # lookup_s (non-negative finite seconds, the ANN leg),
     # outcome (SERVE_REQUEST_OUTCOMES).
     "neighbor_query": {"k": int, "nprobe": int},
+    # ---- blue-green trunk rollout (ISSUE 20) ----
+    # One rollout lifecycle transition (controller or replica):
+    # state in ROLLOUT_STATES. Typed optional fields: source,
+    # fingerprint, reason (strings), windows_green (non-negative int),
+    # flip_seconds (non-negative finite seconds).
+    "rollout_state": {"state": str},
+    # One closed shadow window: verdict in ROLLOUT_VERDICTS. Typed
+    # optional fields: parity_max (non-negative finite; absent when a
+    # structural mismatch made it unbounded), slo_burn_delta /
+    # heads_eval_delta (finite — deltas, negative = the candidate
+    # improved), shadow_ok / shadow_failed (non-negative ints).
+    "rollout_window": {"window": int, "verdict": str},
+    # One mirrored shadow attempt: the `shadow=true` sibling of a live
+    # fleet_request under the SAME trace_id — never retried, never
+    # user-visible, never cache-writing, and deliberately NOT a
+    # fleet_attempt (attempts == retries+1 stays exact). outcome in
+    # ROLLOUT_SHADOW_OUTCOMES; `shadow` is the literal-true flag
+    # downstream filters key on. Typed optional fields: status (HTTP
+    # code, or 0 for a transport failure), parity_max, path.
+    "rollout_shadow": {"trace_id": str, "replica": str, "outcome": str,
+                      "shadow": bool},
+    # One atomic arm swap on a replica: phase in ROLLOUT_FLIP_PHASES;
+    # `seconds` is the swap-lock flip (or re-replication rollback)
+    # latency. Typed optional fields: fingerprint (the NEW resident
+    # trunk), ok (bool).
+    "rollout_flip": {"replica": str, "phase": str,
+                     "seconds": (int, float)},
+    # Fleet trunk-coherence transition from the router's health sweep:
+    # state in ROLLOUT_FLEET_STATES; optional `fingerprints` counts the
+    # distinct resident fingerprints over routable replicas.
+    "rollout_fleet": {"state": str},
 }
 
 CKPT_PHASES = ("dispatch", "landed", "save")
@@ -216,6 +247,17 @@ INDEX_BUILD_STATES = ("start", "completed", "preempted", "error")
 # picked up — incl. torn-tail / prev-generation fallback), done,
 # preempted (stopped mid-shard, resumable).
 INDEX_SHARD_STATES = ("start", "resume", "done", "preempted")
+# Blue-green rollout lifecycle (rollout/controller.py + serve/server.py,
+# ISSUE 20): candidate_loaded/candidate_unloaded are replica-side arm
+# events; shadowing → (refused | promoting → promoted → rolled_back) and
+# aborted are controller transitions.
+ROLLOUT_STATES = ("candidate_loaded", "candidate_unloaded", "shadowing",
+                  "refused", "promoting", "promoted", "rolled_back",
+                  "aborted")
+ROLLOUT_VERDICTS = ("pass", "fail")
+ROLLOUT_SHADOW_OUTCOMES = ("ok", "failed")
+ROLLOUT_FLIP_PHASES = ("flip", "rollback")
+ROLLOUT_FLEET_STATES = ("coherent", "degraded")
 
 
 def sanitize(value: Any) -> Any:
@@ -542,6 +584,125 @@ def validate_record(rec: Any) -> None:
         if oc is not None and oc not in SERVE_REQUEST_OUTCOMES:
             raise ValueError(f"neighbor_query.outcome {oc!r} not in "
                              f"{SERVE_REQUEST_OUTCOMES}")
+    if event == "rollout_state":
+        if rec["state"] not in ROLLOUT_STATES:
+            raise ValueError(f"rollout_state.state {rec['state']!r} not "
+                             f"in {ROLLOUT_STATES}")
+        for name in ("source", "fingerprint", "reason"):
+            v = rec.get(name)
+            if v is not None and not isinstance(v, str):
+                raise ValueError(f"rollout_state.{name} must be a "
+                                 f"string, got {v!r}")
+        wg = rec.get("windows_green")
+        if wg is not None and (not isinstance(wg, int)
+                               or isinstance(wg, bool) or wg < 0):
+            raise ValueError(f"rollout_state.windows_green must be a "
+                             f"non-negative int, got {wg!r}")
+        fs = rec.get("flip_seconds")
+        if fs is not None and (isinstance(fs, bool)
+                               or not isinstance(fs, (int, float))
+                               or not math.isfinite(fs) or fs < 0):
+            raise ValueError(f"rollout_state.flip_seconds must be a "
+                             f"non-negative finite number, got {fs!r}")
+    if event == "rollout_window":
+        if rec["verdict"] not in ROLLOUT_VERDICTS:
+            raise ValueError(f"rollout_window.verdict "
+                             f"{rec['verdict']!r} not in "
+                             f"{ROLLOUT_VERDICTS}")
+        w = rec["window"]
+        if isinstance(w, bool) or w < 0:
+            raise ValueError(f"rollout_window.window must be a "
+                             f"non-negative int, got {w!r}")
+        pm = rec.get("parity_max")
+        if pm is not None and (isinstance(pm, bool)
+                               or not isinstance(pm, (int, float))
+                               or not math.isfinite(pm) or pm < 0):
+            raise ValueError(f"rollout_window.parity_max must be a "
+                             f"non-negative finite number, got {pm!r}")
+        for name in ("slo_burn_delta", "heads_eval_delta"):
+            v = rec.get(name)
+            if v is not None and (isinstance(v, bool)
+                                  or not isinstance(v, (int, float))
+                                  or not math.isfinite(v)):
+                raise ValueError(f"rollout_window.{name} must be a "
+                                 f"finite number, got {v!r}")
+        for name in ("shadow_ok", "shadow_failed"):
+            v = rec.get(name)
+            if v is not None and (not isinstance(v, int)
+                                  or isinstance(v, bool) or v < 0):
+                raise ValueError(f"rollout_window.{name} must be a "
+                                 f"non-negative int, got {v!r}")
+    if event == "rollout_shadow":
+        if rec["outcome"] not in ROLLOUT_SHADOW_OUTCOMES:
+            raise ValueError(f"rollout_shadow.outcome "
+                             f"{rec['outcome']!r} not in "
+                             f"{ROLLOUT_SHADOW_OUTCOMES}")
+        if rec["shadow"] is not True:
+            # The invisibility audit filters on shadow==true; a record
+            # claiming to be a shadow while flagging false would let
+            # shadow traffic masquerade as live (or vice versa).
+            raise ValueError(f"rollout_shadow.shadow must be literally "
+                             f"true, got {rec['shadow']!r}")
+        status = rec.get("status")
+        if status is not None and (not isinstance(status, int)
+                                   or isinstance(status, bool)
+                                   or not (status == 0
+                                           or 100 <= status <= 599)):
+            raise ValueError(f"rollout_shadow.status must be an HTTP "
+                             f"status code (or 0 for a transport "
+                             f"failure), got {status!r}")
+        pm = rec.get("parity_max")
+        if pm is not None and (isinstance(pm, bool)
+                               or not isinstance(pm, (int, float))
+                               or not math.isfinite(pm) or pm < 0):
+            raise ValueError(f"rollout_shadow.parity_max must be a "
+                             f"non-negative finite number, got {pm!r}")
+        path = rec.get("path")
+        if path is not None and not isinstance(path, str):
+            raise ValueError(f"rollout_shadow.path must be a string, "
+                             f"got {path!r}")
+    if event == "rollout_flip":
+        if rec["phase"] not in ROLLOUT_FLIP_PHASES:
+            raise ValueError(f"rollout_flip.phase {rec['phase']!r} not "
+                             f"in {ROLLOUT_FLIP_PHASES}")
+        s = rec["seconds"]
+        if isinstance(s, bool) or not math.isfinite(s) or s < 0:
+            raise ValueError(f"rollout_flip.seconds must be a "
+                             f"non-negative finite number, got {s!r}")
+        fp = rec.get("fingerprint")
+        if fp is not None and not isinstance(fp, str):
+            raise ValueError(f"rollout_flip.fingerprint must be a "
+                             f"string, got {fp!r}")
+        ok = rec.get("ok")
+        if ok is not None and not isinstance(ok, bool):
+            raise ValueError(f"rollout_flip.ok must be a bool, "
+                             f"got {ok!r}")
+    if event == "rollout_fleet":
+        if rec["state"] not in ROLLOUT_FLEET_STATES:
+            raise ValueError(f"rollout_fleet.state {rec['state']!r} not "
+                             f"in {ROLLOUT_FLEET_STATES}")
+        n = rec.get("fingerprints")
+        if n is not None and (not isinstance(n, int)
+                              or isinstance(n, bool) or n < 0):
+            raise ValueError(f"rollout_fleet.fingerprints must be a "
+                             f"non-negative int, got {n!r}")
+    if event == "note" and rec.get("kind") == "rollout_capture":
+        # The rollout drill capture (tools/rollout_drill.py): worst
+        # shadow parity through the good candidate + the atomic-flip
+        # latency are trajectory-sentinel inputs (both lower-is-
+        # better), so a writer bug must fail validation, not poison
+        # the series.
+        for name in ("rollout_shadow_parity_max", "rollout_flip_seconds"):
+            v = rec.get(name)
+            if v is None:
+                raise ValueError(
+                    f"note(kind=rollout_capture): missing required "
+                    f"field {name!r}")
+            if (isinstance(v, bool) or not isinstance(v, (int, float))
+                    or not math.isfinite(v) or v < 0):
+                raise ValueError(
+                    f"note(kind=rollout_capture).{name} must be a "
+                    f"non-negative finite number, got {v!r}")
     if event == "note" and rec.get("kind") == "map_capture":
         # The map-throughput capture (tools/map_drill.py --bench-events):
         # its rate field is a trajectory-sentinel input, so a writer bug
@@ -818,6 +979,20 @@ def make_example(event: str) -> Dict[str, Any]:
                         "size": 16},
         "neighbor_query": {"k": 10, "nprobe": 8, "candidates": 64,
                            "lookup_s": 0.001, "outcome": "ok"},
+        "rollout_state": {"state": "shadowing", "source": "good",
+                          "fingerprint": "f" * 64, "windows_green": 0},
+        "rollout_window": {"window": 0, "verdict": "pass",
+                           "parity_max": 0.0001, "slo_burn_delta": 0.0,
+                           "heads_eval_delta": 0.0, "shadow_ok": 8,
+                           "shadow_failed": 0},
+        "rollout_shadow": {"trace_id": "f1-1", "replica": "r0",
+                           "outcome": "ok", "shadow": True,
+                           "status": 200, "parity_max": 0.0,
+                           "path": "/v1/embed"},
+        "rollout_flip": {"replica": "r0", "phase": "flip",
+                         "seconds": 0.01, "fingerprint": "f" * 64,
+                         "ok": True},
+        "rollout_fleet": {"state": "coherent", "fingerprints": 1},
     }
     return make_record(event, seq=0, t=0.0, **payloads[event])
 

@@ -1,0 +1,26 @@
+"""Blue-green trunk rollout (ISSUE 20).
+
+A rollout drives a CANDIDATE trunk through shadow → gate → flip →
+(rollback) beside the resident one:
+
+  - every replica loads the candidate as a second executable arm
+    (`Server.load_candidate`, warm-booted through the compile cache,
+    HBM-priced with a typed refusal when two trunks don't fit);
+  - the fleet router mirrors a sampled fraction of live traffic to the
+    candidate as sealed shadow attempts (`rollout_shadow` events under
+    the live request's trace_id — never retried, never user-visible,
+    never cache-writing);
+  - the controller closes per-window gates (shadow parity, SLO-burn
+    delta, heads-eval score delta) and promotes only after N
+    consecutive green windows;
+  - promotion is an atomic per-replica flip (the old trunk parks on
+    host for instant rollback) with frozen heads re-pinned via
+    `HeadRegistry.migrate_fingerprint`.
+
+`tools/rollout_drill.py` proves the lifecycle end to end in tier-1.
+"""
+
+from proteinbert_tpu.rollout.controller import RolloutController
+from proteinbert_tpu.rollout.gates import HeadsEvalGate
+
+__all__ = ["RolloutController", "HeadsEvalGate"]

@@ -99,6 +99,17 @@ class EmbeddingCache:
                 if self._evict_c is not None:
                     self._evict_c.inc()
 
+    def clear(self) -> int:
+        """Drop every entry (hit/miss/eviction counters keep their
+        history); returns how many entries were dropped. The blue-green
+        flip calls this: results computed by the outgoing trunk must
+        not outlive it (a cached pre-flip embedding answering a
+        post-flip query would silently mix trunks — ISSUE 20)."""
+        with self._lock:
+            n = len(self._entries)
+            self._entries.clear()
+            return n
+
     @property
     def hit_rate(self) -> float:
         n = self.hits + self.misses

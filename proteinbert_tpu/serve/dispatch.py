@@ -44,8 +44,26 @@ from proteinbert_tpu.data.vocab import EOS_ID, PAD_ID, SOS_ID
 from proteinbert_tpu import inference
 from proteinbert_tpu.heads import apply as heads_apply
 from proteinbert_tpu.heads.registry import LoadedHead, UnknownHeadError
+from proteinbert_tpu.serve.errors import CandidateUnfitError, NoCandidateError
 
 KINDS = ("embed", "predict_go", "predict_residues")
+
+
+def _device_hbm_bytes() -> Optional[int]:
+    """The accelerator's per-device memory budget in bytes, when the
+    backend reports one (TPU/GPU memory_stats); None when it doesn't
+    (CPU) — candidate HBM pricing then only refuses against an
+    explicit budget."""
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:  # noqa: BLE001 — backend-optional API; absence
+        # of a budget must never break candidate loading.
+        return None
+    if isinstance(stats, dict):
+        limit = stats.get("bytes_limit")
+        if isinstance(limit, int) and limit > 0:
+            return limit
+    return None
 
 # The dynamic request kind (ISSUE 8): a predict_task request names a
 # REGISTERED HEAD instead of a pretraining output. All predict_task
@@ -262,6 +280,19 @@ class BucketDispatcher:
                 "fp32_resident": ("device" if self.quant_parity_every > 0
                                   else "host"),
             }
+        # Blue-green candidate arm (ISSUE 20): a SECOND trunk loaded
+        # beside the resident one. `cand_*` serve shadow traffic until
+        # flip() atomically swaps them in as the resident arm; the
+        # outgoing trunk parks on HOST (`parked_*`) for instant
+        # rollback. Every batch reads its arm through _arm_snapshot()
+        # under this lock, so a flip can never tear a batch across two
+        # trunks.
+        self._arm_lock = threading.Lock()
+        self.cand_params = None  # guarded-by: _arm_lock
+        self.cand_qparams = None  # guarded-by: _arm_lock
+        self.parked_params = None  # guarded-by: _arm_lock
+        self.parked_qparams = None  # guarded-by: _arm_lock
+        self.candidate_report: Dict = {}  # guarded-by: _arm_lock
         self._compile_hist = (metrics.histogram("serve_compile_seconds")
                               if metrics is not None else None)
         # Executable-zoo accounting (ISSUE 9 satellite): how many warm
@@ -463,6 +494,224 @@ class BucketDispatcher:
             return _q_trunk_batch
         return heads_apply.trunk_batch
 
+    # ------------------------------------------------ blue-green arms
+
+    def _replicate(self, tree):
+        """Device placement for a trunk-sized tree: replicated over the
+        mesh when one exists (the same committed-params hazard as
+        __init__), handed to jit as-is otherwise."""
+        if self.mesh is None:
+            return tree
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return jax.device_put(tree, NamedSharding(self.mesh,
+                                                  PartitionSpec()))
+
+    def _arm_snapshot(self, arm: str = "resident"):
+        """One atomic read of (serving params, fp32 reference params)
+        for an executable arm — THE flip-atomicity point (ISSUE 20).
+        Every batch takes both trees in a single lock hold, so a
+        concurrent flip() can never hand a batch the old serving arm
+        with the new parity reference (or vice versa); batches already
+        submitted keep the references they captured and finish on the
+        trunk they started on."""
+        with self._arm_lock:
+            if arm == "resident":
+                params, qp = self.params, self.qparams
+            elif arm == "candidate":
+                params, qp = self.cand_params, self.cand_qparams
+                if params is None:
+                    raise NoCandidateError(
+                        "no candidate trunk is loaded on this replica "
+                        "(load one with Server.load_candidate / "
+                        "POST /v1/rollout/load)")
+            else:
+                raise ValueError(f"unknown executable arm {arm!r}; "
+                                 "have ('resident', 'candidate')")
+        return (qp if self.quant != "fp32" else params), params
+
+    def load_candidate(self, params,
+                       hbm_budget_bytes: Optional[int] = None) -> Dict:
+        """Load a candidate trunk beside the resident one (ISSUE 20).
+
+        The candidate must be STRUCTURALLY IDENTICAL to the resident
+        trunk (same tree, shapes, dtypes) — that is what lets it ride
+        the resident arm's compiled executables, which are keyed on
+        shapes, not on which params they run. Under quant serving the
+        candidate is quantized exactly like the resident arm (and its
+        fp32 source parks on host when the resident fp32 does).
+
+        HBM pricing: the device-resident bytes of BOTH arms are summed
+        and checked against `hbm_budget_bytes` (explicit argument, else
+        the backend's reported per-device limit, else unenforced) —
+        `CandidateUnfitError` is the typed refusal when two trunks
+        don't fit; the int8 arm's ~0.27x resident bytes are the
+        headroom the second trunk rides in. Returns the candidate
+        report (also kept for candidate_status())."""
+        from proteinbert_tpu.parallel.quant import (
+            param_bytes, quantize_params,
+        )
+
+        res_leaves = jax.tree.leaves(self.params)
+        cand_leaves = jax.tree.leaves(params)
+        if (jax.tree.structure(params) != jax.tree.structure(self.params)
+                or any(a.shape != b.shape or a.dtype != b.dtype
+                       for a, b in zip(res_leaves, cand_leaves))):
+            raise ValueError(
+                "candidate trunk does not match the resident trunk's "
+                "parameter structure/shapes/dtypes — only a "
+                "structurally identical trunk can ride the warm "
+                "executables (shape-keyed compile cache)")
+        cand_q = None
+        if self.quant != "fp32":
+            cand_q = self._replicate(quantize_params(params))
+            if self.quant_parity_every <= 0:
+                # Mirror the resident arm: no parity shadow → the
+                # fp32 source parks on host, HBM holds int8 only.
+                cand_store = jax.tree.map(np.asarray, params)
+            else:
+                cand_store = self._replicate(params)
+            cand_dev = param_bytes(cand_q)
+            if self.quant_parity_every > 0:
+                cand_dev += param_bytes(cand_store)
+            res_dev = param_bytes(self.qparams)
+            if self.quant_parity_every > 0:
+                res_dev += param_bytes(self.params)
+        else:
+            cand_store = self._replicate(params)
+            cand_dev = param_bytes(cand_store)
+            res_dev = param_bytes(self.params)
+        budget = (hbm_budget_bytes if hbm_budget_bytes is not None
+                  else _device_hbm_bytes())
+        if budget is not None and res_dev + cand_dev > budget:
+            raise CandidateUnfitError(
+                f"candidate trunk needs {cand_dev} device bytes beside "
+                f"the resident arm's {res_dev} ({res_dev + cand_dev} "
+                f"total > HBM budget {budget}) — two fp32 trunks don't "
+                "fit; serve --quant int8 (~0.27x resident bytes) to "
+                "buy the headroom, or raise the budget")
+        report = {
+            "quant": self.quant,
+            "weight_bytes_resident": int(res_dev),
+            "weight_bytes_candidate": int(cand_dev),
+            "hbm_budget_bytes": budget,
+        }
+        with self._arm_lock:
+            self.cand_params = cand_store
+            self.cand_qparams = cand_q
+            self.candidate_report = dict(report)
+        return report
+
+    def warm_candidate(self) -> float:
+        """Pre-run the candidate arm over every already-warm trunk-level
+        shape. The executables are keyed on shapes/dtypes, not on the
+        params they run, so the candidate boots THROUGH the compile
+        cache — this pass proves that (zero new compiles; `_warm` and
+        the executable gauge stay flat) and faults in the candidate's
+        device placement before any shadow traffic arrives. Returns
+        wall seconds."""
+        with self._warm_lock:
+            keys = sorted(self._warm)
+        run_params, _ = self._arm_snapshot("candidate")
+        t0 = time.perf_counter()
+        self._warming = True
+        try:
+            for kind, L, cls in keys:
+                tokens, ann = self._dummy_batch(L, cls)
+                tb, ab = self._place(tokens, ann)
+                fn = (self._trunk_fn() if kind == "trunk"
+                      else self._fn(kind))
+                jax.block_until_ready(
+                    fn(run_params, tb, ab, self.cfg.model))
+        finally:
+            self._warming = False
+        return time.perf_counter() - t0
+
+    def flip(self) -> float:
+        """Atomic promotion: the candidate becomes the resident arm in
+        one lock hold — batches already submitted keep the params they
+        captured (zero dropped, zero torn), batches submitted after
+        this return see only the new trunk. The outgoing trunk parks on
+        HOST (so HBM never holds three trunks) for instant rollback().
+        Returns wall seconds (dominated by the device→host park
+        fetch, which runs before the swap, outside the lock)."""
+        t0 = time.perf_counter()
+        with self._arm_lock:
+            if self.cand_params is None:
+                raise NoCandidateError(
+                    "flip asked with no candidate trunk loaded")
+            old_p, old_q = self.params, self.qparams
+        # Park the outgoing arm on host BEFORE taking the swap lock:
+        # in-flight batches read it concurrently (read-only), and the
+        # swap itself stays O(pointer).
+        parked = jax.tree.map(np.asarray, old_p)
+        parked_q = (jax.tree.map(np.asarray, old_q)
+                    if old_q is not None else None)
+        with self._arm_lock:
+            if self.cand_params is None:
+                raise NoCandidateError(
+                    "candidate trunk vanished mid-flip (concurrent "
+                    "flip/unload)")
+            self.params = self.cand_params
+            self.qparams = self.cand_qparams
+            self.cand_params = None
+            self.cand_qparams = None
+            self.parked_params = parked
+            self.parked_qparams = parked_q
+        return time.perf_counter() - t0
+
+    def rollback(self) -> float:
+        """Instant rollback: the parked trunk returns as the resident
+        arm — bit-identical numerics, because the parked arrays are
+        exact host copies of the pre-flip weights feeding the exact
+        same executables — and the demoted trunk moves back to the
+        candidate slot (still warm, so a fixed re-promotion does not
+        reload). Raises NoCandidateError when nothing is parked."""
+        t0 = time.perf_counter()
+        with self._arm_lock:
+            if self.parked_params is None:
+                raise NoCandidateError(
+                    "rollback asked with no parked trunk")
+            demoted_p, demoted_q = self.params, self.qparams
+            self.params = self._replicate(self.parked_params)
+            self.qparams = (self._replicate(self.parked_qparams)
+                            if self.parked_qparams is not None else None)
+            self.cand_params = demoted_p
+            self.cand_qparams = demoted_q
+            self.parked_params = None
+            self.parked_qparams = None
+        return time.perf_counter() - t0
+
+    def unload_candidate(self) -> bool:
+        """Drop the candidate arm (rollout abort / gate refusal); the
+        resident arm is untouched. Returns whether one was loaded."""
+        with self._arm_lock:
+            had = self.cand_params is not None
+            self.cand_params = None
+            self.cand_qparams = None
+            self.candidate_report = {}
+        return had
+
+    def candidate_status(self) -> Dict:
+        """Arm occupancy + the candidate report, one atomic read."""
+        with self._arm_lock:
+            return {"loaded": self.cand_params is not None,
+                    "parked": self.parked_params is not None,
+                    **self.candidate_report}
+
+    def run_candidate(self, kind: str, tokens: np.ndarray,
+                      annotations: Optional[np.ndarray] = None,
+                      heads: Optional[Sequence[LoadedHead]] = None):
+        """Run one micro-batch on the CANDIDATE arm, synchronously —
+        the shadow-mirror entry (ISSUE 20). Identical prep/padding to
+        `run` on the same warm executables (shape-keyed, so the
+        candidate rides the resident arm's compiles), but nothing here
+        touches the quant parity cadence or any live-path accounting."""
+        result, _ = self.run_timed_async(
+            kind, tokens, annotations, timed=False, heads=heads,
+            arm="candidate").finalize()
+        return result
+
     @staticmethod
     def _parity_max(a, b) -> float:
         """Max abs elementwise deviation between two same-structure
@@ -552,13 +801,16 @@ class BucketDispatcher:
     def run_timed_async(self, kind: str, tokens: np.ndarray,
                         annotations: Optional[np.ndarray] = None,
                         timed: bool = True,
-                        heads: Optional[Sequence[LoadedHead]] = None
-                        ) -> InFlightBatch:
+                        heads: Optional[Sequence[LoadedHead]] = None,
+                        arm: str = "resident") -> InFlightBatch:
         """Submit one micro-batch and return an `InFlightBatch` as soon
         as the jitted call is enqueued (ISSUE 19). Validation, padding,
         device placement and the model call happen here on the calling
         (scheduler) thread; the blocking host fetch, head tails and the
-        parity shadow run in the handle's `finalize()`."""
+        parity shadow run in the handle's `finalize()`. `arm` selects
+        the trunk (ISSUE 20): "resident" is the live arm, "candidate"
+        the blue-green shadow arm — both trees are read atomically via
+        `_arm_snapshot`, so a concurrent flip never tears a batch."""
         if kind == NEIGHBORS_KIND:
             kind = "embed"  # identical device work, shared executable
         rows, L = tokens.shape
@@ -584,7 +836,9 @@ class BucketDispatcher:
         t1 = time.perf_counter()
         if timed:
             timings["prep_s"] = round(t1 - t0, 9)
-        parity_due = self._quant_batch_tick(timings)
+        run_params, ref_params = self._arm_snapshot(arm)
+        parity_due = (arm == "resident"
+                      and self._quant_batch_tick(timings))
         if heads is not None:
             # Multi-tenant path: ONE shared trunk executable for the
             # whole (possibly mixed-head) batch, then each distinct
@@ -592,7 +846,7 @@ class BucketDispatcher:
             # its own head's output (heads/apply.py). The tails ride
             # in the fetch closure: they are tiny, and the trunk — the
             # device work worth overlapping — is already in flight.
-            trunk_out = self._trunk_fn()(self._run_params(), tb, ab,
+            trunk_out = self._trunk_fn()(run_params, tb, ab,
                                          self.cfg.model)
             self._note_warm(("trunk", L, cls))
 
@@ -602,14 +856,14 @@ class BucketDispatcher:
                     self._shadow_parity(
                         out,
                         lambda: heads_apply.apply_heads(
-                            heads_apply.trunk_batch(self.params, tb, ab,
+                            heads_apply.trunk_batch(ref_params, tb, ab,
                                                     self.cfg.model),
                             heads),
                         timings)
                 return out
         else:
             fn = self._fn(kind)
-            res = fn(self._run_params(), tb, ab, self.cfg.model)
+            res = fn(run_params, tb, ab, self.cfg.model)
             self._note_warm((kind, L, cls))
 
             def fetch():
@@ -620,7 +874,7 @@ class BucketDispatcher:
                         lambda: jax.tree.map(
                             lambda a: np.asarray(a)[:rows],
                             self._fn(kind, quantized=False)(
-                                self.params, tb, ab, self.cfg.model)),
+                                ref_params, tb, ab, self.cfg.model)),
                         timings)
                 return out
 
@@ -913,8 +1167,8 @@ class RaggedDispatcher(BucketDispatcher):
                                segment_ids: np.ndarray,
                                annotations: np.ndarray,
                                riders: Sequence[Tuple[int, int, int, int]],
-                               heads=None, timed: bool = True
-                               ) -> InFlightBatch:
+                               heads=None, timed: bool = True,
+                               arm: str = "resident") -> InFlightBatch:
         """Submit one packed batch through the kind's single warm
         executable; the returned `InFlightBatch.finalize()` fans
         per-segment outputs back out after the host fetch (ISSUE 19).
@@ -953,7 +1207,9 @@ class RaggedDispatcher(BucketDispatcher):
         t1 = time.perf_counter()
         if timed:
             timings["prep_s"] = round(t1 - t0, 9)
-        parity_due = self._quant_batch_tick(timings)
+        run_params, ref_params = self._arm_snapshot(arm)
+        parity_due = (arm == "resident"
+                      and self._quant_batch_tick(timings))
 
         def fan_out(host):
             fanned = []
@@ -971,7 +1227,7 @@ class RaggedDispatcher(BucketDispatcher):
 
         if heads is not None:
             trunk_out = self._packed_trunk_fn()(
-                self._run_params(), tb, sb, ab, self.cfg.model)
+                run_params, tb, sb, ab, self.cfg.model)
             self._note_warm(("trunk", L, R))
 
             def fetch():
@@ -983,13 +1239,13 @@ class RaggedDispatcher(BucketDispatcher):
                         outs,
                         lambda: heads_apply.apply_heads_packed(
                             heads_apply.packed_trunk_batch(
-                                self.params, tb, sb, ab, self.cfg.model),
+                                ref_params, tb, sb, ab, self.cfg.model),
                             [(h,) + tuple(r)
                              for h, r in zip(heads, riders)]),
                         timings)
                 return outs
         else:
-            res = self._packed_fn(kind)(self._run_params(), tb, sb, ab,
+            res = self._packed_fn(kind)(run_params, tb, sb, ab,
                                         self.cfg.model)
             self._note_warm((kind, L, R))
 
@@ -1001,7 +1257,7 @@ class RaggedDispatcher(BucketDispatcher):
                         lambda: fan_out(jax.tree.map(
                             np.asarray,
                             self._packed_fn(kind, quantized=False)(
-                                self.params, tb, sb, ab,
+                                ref_params, tb, sb, ab,
                                 self.cfg.model))),
                         timings)
                 return outs
@@ -1033,6 +1289,45 @@ class RaggedDispatcher(BucketDispatcher):
                         self.cfg.model.num_annotations), np.float32)
         riders = [(r, 0, 0, span) for r in range(R)]
         return tokens, seg, ann, riders
+
+    def warm_candidate(self) -> float:
+        """Pre-run the candidate arm over the warm PACKED executables —
+        same zero-new-compiles contract as the bucketed override (the
+        packed fns are shape-keyed too). Returns wall seconds."""
+        with self._warm_lock:
+            keys = sorted(self._warm)
+        run_params, _ = self._arm_snapshot("candidate")
+        tokens, seg, ann, _riders = self._dummy_packed()
+        tb, sb, ab = self._place_packed(tokens, seg, ann)
+        t0 = time.perf_counter()
+        self._warming = True
+        try:
+            for kind, _L, _R in keys:
+                fn = (self._packed_trunk_fn() if kind == "trunk"
+                      else self._packed_fn(kind))
+                jax.block_until_ready(
+                    fn(run_params, tb, sb, ab, self.cfg.model))
+        finally:
+            self._warming = False
+        return time.perf_counter() - t0
+
+    def run_candidate(self, *args, **kwargs):
+        raise NotImplementedError(
+            "RaggedDispatcher consumes packed batches only — use "
+            "run_packed_candidate() (serve/server.shadow_submit builds "
+            "the single-rider packed batch)")
+
+    def run_packed_candidate(self, kind: str, tokens: np.ndarray,
+                             segment_ids: np.ndarray,
+                             annotations: np.ndarray,
+                             riders: Sequence[Tuple[int, int, int, int]],
+                             heads=None) -> List:
+        """`run_packed` on the CANDIDATE arm — the ragged shadow-mirror
+        entry (see the bucketed `run_candidate`)."""
+        outs, _ = self.run_packed_timed_async(
+            kind, tokens, segment_ids, annotations, riders, heads=heads,
+            timed=False, arm="candidate").finalize()
+        return outs
 
     def warmup(self, kinds: Sequence[str] = ("embed",)) -> int:
         """Pre-compile the ONE packed executable per kind (plus the

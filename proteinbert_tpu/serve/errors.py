@@ -43,3 +43,17 @@ class DeadlineExceededError(ServeError):
 class ServerClosedError(ServeError):
     """The server is draining or closed; no new work is accepted (and
     on abort, pending work fails with this)."""
+
+
+class CandidateUnfitError(ServeError):
+    """A candidate trunk (`Server.load_candidate`, ISSUE 20) does not
+    fit beside the resident one within the device's HBM budget — the
+    typed refusal of the blue-green rollout contract (two fp32 trunks
+    usually don't fit; the int8 arm's ~0.27x resident bytes are the
+    headroom a second trunk rides in). Mapped to HTTP 409."""
+
+
+class NoCandidateError(ServeError):
+    """A rollout verb (flip / rollback / shadow) was asked of a replica
+    that holds no candidate (or no parked) trunk in that slot — a
+    state error, not a capacity one. Mapped to HTTP 409."""

@@ -177,6 +177,13 @@ class Replica:
         self.requests_total = 0
         self.failures_total = 0
         self.last_health: Optional[Dict[str, Any]] = None
+        # Trunk arm identity (ISSUE 20), learned from /healthz: the
+        # resident trunk's fingerprint + quant mode, and the candidate
+        # fingerprint while a rollout is shadowing. None until the
+        # first successful health check.
+        self.trunk_fp: Optional[str] = None
+        self.quant: Optional[str] = None
+        self.candidate_fp: Optional[str] = None
 
     def routable(self) -> bool:
         return self.state in ("up", "degraded")
@@ -187,7 +194,10 @@ class Replica:
                 "consecutive_failures": self.consecutive_failures,
                 "burn_rate": round(self.burn_rate, 4),
                 "requests_total": self.requests_total,
-                "failures_total": self.failures_total}
+                "failures_total": self.failures_total,
+                "trunk_fingerprint": self.trunk_fp,
+                "quant": self.quant,
+                "candidate_fingerprint": self.candidate_fp}
 
 
 class FleetRouter:
@@ -288,6 +298,11 @@ class FleetRouter:
                                             replica=r.name)
                       for r in self.replicas}
         self._admitting_g = metrics.gauge("fleet_replicas_admitting")
+        # 1.0 while routable replicas disagree on the resident trunk
+        # fingerprint (mid-flip, or a flip that half-landed) — the
+        # health sweep flags that fleet as degraded (ISSUE 20).
+        self._fp_mixed_g = metrics.gauge("fleet_fingerprint_mixed")
+        self._fleet_state = "coherent"    # guarded-by: _lock
         # Health-loop scrape latency per replica (the previously
         # unmeasured half of the health plane): one slow replica shows
         # up HERE, and the drill asserts the loop still visits every
@@ -303,12 +318,22 @@ class FleetRouter:
         # Optional FleetCollector (attach_collector): the merged-stream
         # funnel the CLI/drill drain into one fleet JSONL.
         self.collector = None
+        # Optional RolloutController (attach_rollout): owns shadow
+        # mirroring + gated promotion; the router only calls its
+        # mirror() hook from the sealed 200 path (ISSUE 20).
+        self.rollout = None
 
     def attach_collector(self, collector: "FleetCollector") -> None:
         """Wire the event funnel: the router itself never tails files
         mid-flight (the merge is post-hoc), it just owns the handle so
         drain-time callers find router + replicas in one place."""
         self.collector = collector
+
+    def attach_rollout(self, controller) -> None:
+        """Wire a rollout controller's shadow mirror into the routed
+        path. The hook fires AFTER the live response is sealed, so a
+        slow/broken candidate can never hold a user request hostage."""
+        self.rollout = controller
 
     # ----------------------------------------------------------- lifecycle
 
@@ -372,6 +397,34 @@ class FleetRouter:
             self._scrape_h[rep.name].observe(max(0.0, self.clock() - t0))
             self._apply_health(rep, payload)
         self._gauge_admitting()
+        self._sweep_fingerprints()
+
+    def _sweep_fingerprints(self) -> None:
+        """Flag a mixed-fingerprint fleet (ISSUE 20): routable replicas
+        disagreeing on the resident trunk means a flip half-landed (or
+        is mid-flight). Emits `rollout_fleet` on every state change and
+        keeps the fleet_fingerprint_mixed gauge current."""
+        with self._lock:
+            fps = {r.trunk_fp for r in self.replicas
+                   if r.routable() and r.trunk_fp}
+            state = "degraded" if len(fps) > 1 else "coherent"
+            changed = state != self._fleet_state
+            self._fleet_state = state
+        self._fp_mixed_g.set(1.0 if state == "degraded" else 0.0)
+        if changed:
+            self.tele.emit("rollout_fleet", state=state,
+                           fingerprints=len(fps))
+
+    def fingerprint_status(self) -> Dict[str, Any]:
+        """Per-replica trunk identity + the fleet coherence verdict."""
+        with self._lock:
+            return {
+                "fleet_state": self._fleet_state,
+                "fingerprints": {r.name: r.trunk_fp for r in self.replicas},
+                "candidates": {r.name: r.candidate_fp
+                               for r in self.replicas
+                               if r.candidate_fp},
+            }
 
     def _fetch_health(self, rep: Replica) -> Optional[Dict[str, Any]]:
         if self.injector is not None:
@@ -409,6 +462,22 @@ class FleetRouter:
             rep.last_health = payload
             rep.consecutive_failures = 0
             rep.consecutive_successes += 1
+            # Trunk arm identity (ISSUE 20): /healthz carries the
+            # resident fingerprint + quant at top level and the
+            # candidate fingerprint under stats.rollout. Same defensive
+            # posture as the burn parse — absent fields leave the
+            # previous value (an old-version replica is not "mixed",
+            # it is unknown).
+            fp = payload.get("trunk_fingerprint")
+            if isinstance(fp, str) and fp:
+                rep.trunk_fp = fp
+            quant = payload.get("quant")
+            if isinstance(quant, str) and quant:
+                rep.quant = quant
+            rollout = (payload.get("stats") or {}).get("rollout")
+            if isinstance(rollout, dict):
+                cand = rollout.get("candidate_fingerprint")
+                rep.candidate_fp = cand if isinstance(cand, str) else None
             # Defensive parse: a replica of a different version (or a
             # garbled body that still parsed) must degrade to "no burn
             # signal", never crash the health pass.
@@ -563,6 +632,58 @@ class FleetRouter:
             return e.code, e.read()
         # urllib.error.URLError / OSError / timeout propagate: transport
         # failure, the retry path's business.
+
+    def shadow_forward(self, name: str, path: str, raw_body: bytes,
+                       trace_id: str) -> Tuple[int, bytes]:
+        """Mirror one request to `name`'s CANDIDATE arm (ISSUE 20).
+
+        Deliberately outside every live-path ledger: no inflight or
+        health bookkeeping, no cache read/write, no retry, no seal —
+        a shadow is an observation, not a request. The X-PBT-Shadow
+        header routes it through Server.shadow_submit on the replica;
+        the trace_id ties the shadow record to its live sibling.
+        Transport failures return (0, b"") rather than raising: the
+        controller scores them as shadow failures."""
+        rep = self._by_name(name)
+        if self.injector is not None:
+            if self.injector.is_dead(rep.name):
+                return 0, b""
+        headers = {"Content-Type": "application/json",
+                   "X-PBT-Shadow": "1",
+                   "X-PBT-Trace": trace_id}
+        req = urllib.request.Request(
+            rep.url + path, data=raw_body, headers=headers, method="POST")
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=self.request_timeout_s) as resp:
+                return resp.status, resp.read()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read()
+        except (urllib.error.URLError, OSError):
+            return 0, b""
+
+    def control_forward(self, name: str, path: str,
+                        body: Optional[Dict[str, Any]] = None
+                        ) -> Tuple[int, bytes]:
+        """POST one rollout control verb (/v1/rollout/*) to a replica.
+        Control traffic never retries and never touches the request
+        ledgers — a failed flip must surface, not be papered over.
+        Transport failure returns (0, b"")."""
+        rep = self._by_name(name)
+        if self.injector is not None and self.injector.is_dead(rep.name):
+            return 0, b""
+        raw = json.dumps(body or {}).encode()
+        req = urllib.request.Request(
+            rep.url + path, data=raw,
+            headers={"Content-Type": "application/json"}, method="POST")
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=self.request_timeout_s * 2) as resp:
+                return resp.status, resp.read()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read()
+        except (urllib.error.URLError, OSError):
+            return 0, b""
 
     def _cache_key(self, kind: str, body: Any) -> Optional[str]:
         """Content address of one inference request (None = uncacheable
@@ -754,6 +875,17 @@ class FleetRouter:
                 attempt(rep.name, "ok", status=status)
                 seal("retried_ok" if retries else "ok", status,
                      rep.name, retries)
+                # Shadow mirror (ISSUE 20): AFTER the live request is
+                # sealed — mirroring can never delay or fail a user
+                # response. The controller samples/enqueues; a full
+                # queue drops the mirror, never blocks here.
+                ctl = self.rollout
+                if ctl is not None:
+                    try:
+                        ctl.mirror(path, raw_body, rid, resp, rep.name)
+                    except Exception:  # noqa: BLE001 — shadow plane
+                        # must never break the live path.
+                        logger.exception("rollout mirror hook failed")
                 return status, resp, headers
             # Replica answered with a non-retryable error (400/404/500):
             # pass it through, sealed as failed.
@@ -770,10 +902,48 @@ class FleetRouter:
                 "sealed": self.sealed_total,
                 "outcomes": dict(self.outcomes),
                 "retries_spent": self.retries_spent,
+                "fleet_state": self._fleet_state,
                 "replicas": [r.status() for r in self.replicas],
             }
         out["cache"] = self.cache.stats()
+        ctl = self.rollout
+        if ctl is not None:
+            out["rollout"] = ctl.status()
         return out
+
+    # ------------------------------------------------------ rollout verbs
+
+    def start_rollout(self, spec: Dict[str, Any]) -> Dict[str, Any]:
+        """Build a RolloutController from an operator spec and start it
+        (shadow phase). One rollout at a time: a live controller in a
+        non-terminal state refuses a second start."""
+        from proteinbert_tpu.rollout import RolloutController
+        ctl = self.rollout
+        if ctl is not None and not ctl.terminal():
+            raise RuntimeError(
+                f"a rollout is already {ctl.state}; abort it first "
+                "(pbt rollout abort)")
+        ctl = RolloutController(self, telemetry=self.tele, **spec)
+        self.attach_rollout(ctl)
+        return ctl.start()
+
+    def rollout_status(self) -> Dict[str, Any]:
+        ctl = self.rollout
+        out = {"rollout": None if ctl is None else ctl.status()}
+        out.update(self.fingerprint_status())
+        return out
+
+    def promote_rollout(self) -> Dict[str, Any]:
+        ctl = self.rollout
+        if ctl is None:
+            raise RuntimeError("no rollout in progress")
+        return ctl.promote()
+
+    def abort_rollout(self) -> Dict[str, Any]:
+        ctl = self.rollout
+        if ctl is None:
+            raise RuntimeError("no rollout in progress")
+        return ctl.abort()
 
     # -------------------------------------------------- aggregation plane
 
@@ -970,10 +1140,14 @@ def make_fleet_handler(router: FleetRouter):
                 ok = any(r["state"] in ("up", "degraded") for r in reps)
                 self._reply(200 if ok else 503,
                             {"ok": ok, "role": "fleet-router",
+                             "fleet_state": router.fingerprint_status()[
+                                 "fleet_state"],
                              "replicas": reps})
             elif self.path == "/fleet/status":
                 self._reply(200, {"replicas": router.replica_status(),
                                   "stats": router.stats()})
+            elif self.path == "/rollout/status":
+                self._reply(200, router.rollout_status())
             elif self.path == "/fleet/metrics":
                 # The fleet-wide merged registry view (counters summed,
                 # gauges per-replica, windows percentile-merged) — the
@@ -1017,6 +1191,36 @@ def make_fleet_handler(router: FleetRouter):
                 self._reply(200, {"ok": True,
                                   "replicas": router.replica_status()})
 
+        def _rollout_control(self, verb: str, raw: bytes) -> None:
+            """POST /rollout/start|promote|abort (ISSUE 20). Typed
+            errors: a spec problem is a 400, an illegal phase (double
+            start, promote with no rollout) a 409, anything else 500."""
+            try:
+                if verb == "start":
+                    spec = json.loads(raw) if raw else {}
+                    if not isinstance(spec, dict):
+                        raise ValueError("rollout spec must be an object")
+                    out = router.start_rollout(spec)
+                elif verb == "promote":
+                    out = router.promote_rollout()
+                elif verb == "abort":
+                    out = router.abort_rollout()
+                else:
+                    self._reply(404, {"error": f"no such rollout verb "
+                                               f"{verb!r}"})
+                    return
+            except (TypeError, ValueError, KeyError) as e:
+                self._reply(400, {"error": str(e), "type": "bad_request"})
+            except RuntimeError as e:
+                self._reply(409, {"error": str(e),
+                                  "type": "rollout_conflict"})
+            except Exception as e:  # noqa: BLE001 — typed 500 beats a
+                # torn keep-alive connection.
+                self._reply(500, {"error": f"{type(e).__name__}: {e}",
+                                  "type": "internal"})
+            else:
+                self._reply(200, {"ok": True, **out})
+
         def do_POST(self):
             # Read the body BEFORE any reply: this handler speaks
             # HTTP/1.1 keep-alive, and answering an unknown route or a
@@ -1035,6 +1239,9 @@ def make_fleet_handler(router: FleetRouter):
                 return
             if self.path == "/fleet/admit":
                 self._control(raw, drain=False)
+                return
+            if self.path.startswith("/rollout/"):
+                self._rollout_control(self.path[len("/rollout/"):], raw)
                 return
             if self.path not in ROUTE_KINDS:
                 self._reply(404, {"error": f"no such route {self.path}"})

@@ -30,17 +30,42 @@ Routes (POST bodies and responses are JSON):
                              → 400 {"type": "trunk_mismatch"})
   POST /v1/heads/remove      {"head_id"} → hot-remove (drain: queued
                              requests for it still complete)
+  POST /v1/rollout/load      {"source", "hbm_budget_bytes"?} → load +
+                             warm-boot a candidate trunk beside the
+                             resident one (blue-green rollout,
+                             ISSUE 20); doesn't fit → 409
+                             {"type": "candidate_unfit"}
+  POST /v1/rollout/flip      {} → atomic promotion (candidate becomes
+                             resident, old trunk parked on host,
+                             result cache flushed); no candidate →
+                             409 {"type": "no_candidate"}
+  POST /v1/rollout/rollback  {} → instant rollback to the parked
+                             trunk (bit-identical numerics); nothing
+                             parked → 409 {"type": "no_candidate"}
+  POST /v1/rollout/unload    {} → drop the candidate arm (abort)
   GET  /healthz              → {"ok": true, "mode": "bucketed"|"ragged",
                                "quant": "fp32"|"int8"|"int8_act",
+                               "trunk_fingerprint": "...",
                                "stats": {...}} — `mode` is the serving
                                dispatch mode (`pbt serve --serve-mode`,
                                ISSUE 9), `quant` the executable arm
-                               (`pbt serve --quant`, ISSUE 12); stats
+                               (`pbt serve --quant`, ISSUE 12),
+                               `trunk_fingerprint` the RESIDENT trunk's
+                               identity (the field the fleet health
+                               sweep joins on to flag a mixed-
+                               fingerprint fleet, ISSUE 20; per-arm
+                               detail under stats["rollout"]); stats
                                carries the executable-zoo accounting
                                (executables, warmup_seconds, fused_path
                                coverage) and, on a quantized arm, the
                                weight-bytes footprint + sampled parity
                                under "quant"
+
+Shadow traffic (ISSUE 20): an inference POST carrying the header
+`X-PBT-Shadow: 1` runs through the CANDIDATE trunk synchronously —
+same response shape, but it never enqueues, never caches, never feeds
+the SLO evaluator or any live counter. The fleet router mirrors
+sampled live requests this way; no candidate loaded → 409.
   GET  /metrics              → Prometheus textfile (the registry's
                                exposition; empty when telemetry is off)
   GET  /metrics.json         → {"replica_id", "snapshot", "windows"} —
@@ -67,8 +92,9 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from proteinbert_tpu.serve.errors import (
-    DeadlineExceededError, QueueFullError, SequenceTooLongError,
-    ServerClosedError, TrunkMismatchError, UnknownHeadError,
+    CandidateUnfitError, DeadlineExceededError, NoCandidateError,
+    QueueFullError, SequenceTooLongError, ServerClosedError,
+    TrunkMismatchError, UnknownHeadError,
 )
 from proteinbert_tpu.serve.server import Server
 
@@ -118,6 +144,7 @@ def make_handler(server: Server):
             if self.path in ("/healthz", "/stats"):
                 self._reply(200, {"ok": True, "mode": server.serve_mode,
                                   "quant": server.quant,
+                                  "trunk_fingerprint": server.trunk_fp(),
                                   "stats": server.stats()})
             elif self.path == "/v1/heads":
                 self._reply(200, {"heads": server.list_heads()})
@@ -183,12 +210,68 @@ def make_handler(server: Server):
                 self._reply(200, {"ok": True, "head_id": head_id,
                                   "heads": server.list_heads()})
 
+        def _rollout_control(self, verb: str) -> None:
+            """POST /v1/rollout/{load,flip,rollback,unload}: the
+            blue-green control plane (ISSUE 20). Typed 409s:
+            candidate_unfit (HBM refusal) and no_candidate (flip or
+            rollback with an empty slot)."""
+            try:
+                if verb != "load":
+                    # Drain any (ignored) body so keep-alive framing
+                    # stays in sync.
+                    length = int(self.headers.get("Content-Length", 0)
+                                 or 0)
+                    if length > 0:
+                        self.rfile.read(min(length, _MAX_BODY))
+                if verb == "load":
+                    body = self._read_body()
+                    source = body["source"]
+                    if not isinstance(source, str):
+                        raise ValueError("'source' must be a string")
+                    budget = body.get("hbm_budget_bytes")
+                    if budget is not None and (
+                            isinstance(budget, bool)
+                            or not isinstance(budget, int)):
+                        raise ValueError(
+                            "'hbm_budget_bytes' must be an integer")
+                    out = server.load_candidate(source=source,
+                                                hbm_budget_bytes=budget)
+                elif verb == "flip":
+                    out = server.flip()
+                elif verb == "rollback":
+                    out = server.rollback_trunk()
+                else:  # unload
+                    out = {"unloaded": server.unload_candidate()}
+            except CandidateUnfitError as e:
+                self._reply(409, {"error": str(e),
+                                  "type": "candidate_unfit"})
+            except NoCandidateError as e:
+                self._reply(409, {"error": str(e),
+                                  "type": "no_candidate"})
+            except (KeyError, ValueError, json.JSONDecodeError) as e:
+                self._reply(400, {"error": f"bad request: {e}",
+                                  "type": "bad_request"})
+            except Exception as e:  # noqa: BLE001 — a loader/placement
+                # failure must answer, not drop the connection.
+                self._reply(500, {"error": f"internal error: {e}",
+                                  "type": "internal"})
+            else:
+                self._reply(200, {"ok": True, **out})
+
         def do_POST(self):
             if self.path == "/v1/heads/add":
                 self._head_lifecycle(add=True)
                 return
             if self.path == "/v1/heads/remove":
                 self._head_lifecycle(add=False)
+                return
+            if self.path.startswith("/v1/rollout/"):
+                verb = self.path[len("/v1/rollout/"):]
+                if verb not in ("load", "flip", "rollback", "unload"):
+                    self._reply(404,
+                                {"error": f"no such route {self.path}"})
+                    return
+                self._rollout_control(verb)
                 return
             route = {"/v1/embed": "embed",
                      "/v1/predict_go": "predict_go",
@@ -225,18 +308,29 @@ def make_handler(server: Server):
                     head_id = body["head_id"]
                     if not isinstance(head_id, str):
                         raise ValueError("'head_id' must be a string")
-                # Fleet-scope causal context (ISSUE 18): a router
-                # injects its minted trace id here; the trace joins it
-                # and X-PBT-Request-Id answers with the FLEET id, so
-                # one id names the request end-to-end across processes.
-                trace_id = self.headers.get("X-PBT-Trace")
-                future = server.submit(
-                    kind, seq, annotations=body.get("annotations"),
-                    deadline_s=(deadline_ms / 1000.0
-                                if deadline_ms is not None else None),
-                    top_k=top_k, head_id=head_id, trace_id=trace_id)
-                request_id = getattr(future, "pbt_request_id", None)
-                value = future.result()
+                # Shadow traffic (ISSUE 20): the router's mirrored
+                # copy of a live request runs through the CANDIDATE
+                # arm synchronously — never enqueued, never cached,
+                # never counted on the live path.
+                if self.headers.get("X-PBT-Shadow") == "1":
+                    value = server.shadow_submit(
+                        kind, seq, annotations=body.get("annotations"),
+                        head_id=head_id, top_k=top_k)
+                else:
+                    # Fleet-scope causal context (ISSUE 18): a router
+                    # injects its minted trace id here; the trace
+                    # joins it and X-PBT-Request-Id answers with the
+                    # FLEET id, so one id names the request end-to-end
+                    # across processes.
+                    trace_id = self.headers.get("X-PBT-Trace")
+                    future = server.submit(
+                        kind, seq, annotations=body.get("annotations"),
+                        deadline_s=(deadline_ms / 1000.0
+                                    if deadline_ms is not None
+                                    else None),
+                        top_k=top_k, head_id=head_id, trace_id=trace_id)
+                    request_id = getattr(future, "pbt_request_id", None)
+                    value = future.result()
             except UnknownHeadError as e:
                 # The typed 404 of the multi-tenant contract: this head
                 # does not exist on this server (never added, or hot-
@@ -257,6 +351,12 @@ def make_handler(server: Server):
             except SequenceTooLongError as e:
                 self._reply(400, {"error": str(e), "type": "too_long"},
                             getattr(e, "pbt_request_id", request_id))
+            except NoCandidateError as e:
+                # Shadow asked of a replica with an empty candidate
+                # slot (a race with unload/flip): typed 409 so the
+                # mirror records it without touching live accounting.
+                self._reply(409, {"error": str(e),
+                                  "type": "no_candidate"}, request_id)
             except (KeyError, ValueError, json.JSONDecodeError) as e:
                 self._reply(400, {"error": f"bad request: {e}",
                                   "type": "bad_request"}, request_id)

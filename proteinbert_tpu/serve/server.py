@@ -139,6 +139,7 @@ class Server:
         nprobe: int = 8,
         replica_id: Optional[str] = None,
         pipeline_depth: Optional[int] = None,
+        candidate_loader=None,
     ):
         from proteinbert_tpu.obs import as_telemetry
 
@@ -259,6 +260,16 @@ class Server:
                     f"{index.model_fingerprint[:12]}…, but this server "
                     f"holds trunk {fp[:12]}… — rebuild it with "
                     "`pbt index` over this model's embedding store")
+        # Blue-green rollout (ISSUE 20): the candidate/parked arm
+        # identities this facade tracks beside the dispatcher's trees,
+        # and the loader that resolves a rollout `source` string to a
+        # trunk params tree (cli/main.py wires run-dir loading here;
+        # drills pass a closure). shadow_total mirrors how many shadow
+        # requests ran — the ONLY live counter shadow traffic touches.
+        self.candidate_loader = candidate_loader
+        self._candidate_fp: Optional[str] = None
+        self._parked_fp: Optional[str] = None
+        self.shadow_total = 0
         # The p50/p99 ring lives in the obs registry (QuantileWindow):
         # /metrics scrapes, stats(), and serve_request events all read
         # the same ring. A disabled registry (NULL telemetry) returns a
@@ -483,6 +494,173 @@ class Server:
         """[{head_id, name, kind, num_outputs}] of the currently
         servable heads."""
         return self.dispatcher.list_heads()
+
+    # ------------------------------------------------ blue-green rollout
+
+    def load_candidate(self, params=None, source: Optional[str] = None,
+                       hbm_budget_bytes: Optional[int] = None
+                       ) -> Dict[str, Any]:
+        """Load a candidate trunk beside the resident one and warm-boot
+        it through the compile cache (ISSUE 20). Pass the params tree
+        directly or a `source` string for the server's
+        `candidate_loader` to resolve. HBM-priced with the typed
+        `CandidateUnfitError` refusal when both arms don't fit (see
+        dispatch.load_candidate). Returns the candidate report
+        {fingerprint, warm_seconds, weight bytes...}."""
+        if (params is None) == (source is None):
+            raise ValueError("pass exactly one of params= / source=")
+        if params is None:
+            if self.candidate_loader is None:
+                raise ValueError(
+                    "this server has no candidate_loader — pass the "
+                    "params tree directly, or construct the server "
+                    "with candidate_loader=")
+            params = self.candidate_loader(source)
+        # Fingerprint BEFORE the dispatcher takes ownership (it may
+        # host-park or re-place the tree under quant/mesh serving).
+        fp = trunk_fingerprint(params)
+        report = self.dispatcher.load_candidate(
+            params, hbm_budget_bytes=hbm_budget_bytes)
+        warm_s = self.dispatcher.warm_candidate()
+        self._candidate_fp = fp
+        report = dict(report, fingerprint=fp,
+                      warm_seconds=round(warm_s, 6))
+        self.tele.emit("rollout_state", state="candidate_loaded",
+                       fingerprint=fp, source=source or "params")
+        return report
+
+    def unload_candidate(self) -> bool:
+        """Drop the candidate arm (abort / gate refusal); returns
+        whether one was loaded. The resident arm is untouched."""
+        had = self.dispatcher.unload_candidate()
+        if had:
+            fp = self._candidate_fp
+            self._candidate_fp = None
+            self.tele.emit("rollout_state", state="candidate_unloaded",
+                           fingerprint=fp or "")
+        return had
+
+    def flip(self) -> Dict[str, Any]:
+        """Atomic promotion: the candidate becomes the resident trunk
+        (dispatch.flip — zero dropped or torn in-flight requests), the
+        outgoing trunk parks on host for instant rollback, and the
+        result cache FLUSHES: results the old trunk computed must not
+        outlive it, or a cached pre-flip embedding would answer a
+        post-flip query with the wrong model."""
+        old_fp = self.trunk_fp()
+        seconds = self.dispatcher.flip()
+        self._parked_fp = old_fp
+        self._trunk_fp = self._candidate_fp
+        self._candidate_fp = None
+        dropped = self.cache.clear()
+        self.tele.emit("rollout_flip",
+                       replica=self.replica_id or "local", phase="flip",
+                       seconds=round(seconds, 6),
+                       fingerprint=self._trunk_fp or "", ok=True)
+        return {"seconds": round(seconds, 6),
+                "fingerprint": self._trunk_fp,
+                "parked_fingerprint": self._parked_fp,
+                "cache_dropped": dropped}
+
+    def rollback_trunk(self) -> Dict[str, Any]:
+        """Instant rollback to the parked trunk — bit-identical
+        resident numerics (dispatch.rollback); the demoted trunk moves
+        to the candidate slot. Flushes the cache for the same reason
+        flip() does."""
+        demoted_fp = self.trunk_fp()
+        seconds = self.dispatcher.rollback()
+        self._trunk_fp = self._parked_fp
+        self._candidate_fp = demoted_fp
+        self._parked_fp = None
+        dropped = self.cache.clear()
+        self.tele.emit("rollout_flip",
+                       replica=self.replica_id or "local",
+                       phase="rollback", seconds=round(seconds, 6),
+                       fingerprint=self._trunk_fp or "", ok=True)
+        return {"seconds": round(seconds, 6),
+                "fingerprint": self._trunk_fp,
+                "cache_dropped": dropped}
+
+    def shadow_submit(self, kind: str, seq: str, annotations=None,
+                      head_id: Optional[str] = None,
+                      top_k: Optional[int] = None):
+        """Run ONE request through the CANDIDATE arm, synchronously and
+        invisibly (ISSUE 20): same tokenization/bucketing/result
+        shaping as the live path, but it never touches the queue, the
+        result cache, the SLO evaluator, or any live counter — the only
+        bookkeeping is the `shadow_total` mirror. Raises
+        NoCandidateError when no candidate is loaded. `neighbors` is
+        refused: the ANN index pins the RESIDENT trunk's embedding
+        space, so a candidate-arm probe would score garbage."""
+        if kind == NEIGHBORS_KIND:
+            raise ValueError(
+                "neighbors cannot shadow: the ANN index pins the "
+                "resident trunk's embedding space")
+        if kind not in KINDS and kind != TASK_KIND:
+            raise ValueError(f"unknown request kind {kind!r}; have "
+                             f"{KINDS + (TASK_KIND,)}")
+        if (kind == TASK_KIND) != (head_id is not None):
+            raise ValueError(
+                f"head_id is required for kind {TASK_KIND!r} and "
+                "invalid for every other kind")
+        if not seq:
+            raise ValueError("empty sequence")
+        head = (self.dispatcher.get_head(head_id)
+                if kind == TASK_KIND else None)
+        if annotations is not None:
+            annotations = inference.check_annotations(
+                np.asarray(annotations, np.float32)[None], 1, self.cfg)[0]
+        bucket_len = self.dispatcher.bucket_len(len(seq))
+        tokens = inference._tokenize_masked(
+            [seq], self.cfg.data.seq_len, on_overflow="count")[0]
+        if self.serve_mode == "ragged":
+            # One real rider in row 0 of an otherwise-dummy packed
+            # grid; the other rows compute but fan out to nobody.
+            from proteinbert_tpu.data.vocab import PAD_ID
+
+            tok, seg, ann, _ = self.dispatcher._dummy_packed()
+            tok[0, :] = PAD_ID
+            tok[0, :bucket_len] = tokens[:bucket_len]
+            seg[0, :] = 0
+            seg[0, :bucket_len] = 1
+            if annotations is not None:
+                ann[0, 0] = annotations
+            row = self.dispatcher.run_packed_candidate(
+                kind, tok, seg, ann, [(0, 0, 0, bucket_len)],
+                heads=[head] if head is not None else None)[0]
+        else:
+            out = self.dispatcher.run_candidate(
+                kind, tokens[None, :bucket_len],
+                annotations[None] if annotations is not None else None,
+                heads=[head] if head is not None else None)
+            if kind == "embed":
+                row = {k: v[0] for k, v in out.items()}
+            else:
+                row = out[0]
+        if kind == "embed":
+            value = {"global": np.asarray(row["global"]),
+                     "local_mean": np.asarray(row["local_mean"])}
+        elif kind in ("predict_go", TASK_KIND):
+            value = np.asarray(row)
+        else:  # predict_residues
+            probs = np.asarray(row)
+            value = (inference.fill_masked_residues(
+                seq, probs, self.cfg.data.seq_len - 2), probs)
+        self._bump("shadow_total")
+        return self._present(kind, value, top_k)
+
+    def rollout_status(self) -> Dict[str, Any]:
+        """The replica's rollout arm state — surfaced on /healthz (via
+        stats) so the fleet health sweep sees fingerprints per arm."""
+        with self._mirror_lock:
+            shadow = self.shadow_total
+        return {
+            "resident_fingerprint": self.trunk_fp(),
+            "candidate_fingerprint": self._candidate_fp,
+            "parked_fingerprint": self._parked_fp,
+            "shadow_requests": shadow,
+            "candidate": self.dispatcher.candidate_status(),
+        }
 
     def __enter__(self) -> "Server":
         return self.start()
@@ -972,6 +1150,10 @@ class Server:
             # the share of finalize seconds that overlapped device
             # compute of a later batch.
             "pipeline": self.scheduler.pipeline_stats(),
+            # Blue-green rollout arms (ISSUE 20): per-arm fingerprints
+            # + shadow-request count — the fields the fleet health
+            # sweep joins on to flag a mixed-fingerprint fleet.
+            "rollout": self.rollout_status(),
         }
         # Neighbor-index arm (ISSUE 17): which index serves, its size,
         # and how many distinct lookup shapes have compiled — the

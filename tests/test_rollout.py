@@ -1,0 +1,308 @@
+"""Blue-green trunk rollout (ISSUE 20): parity scoring, candidate-arm
+refusals, flip-under-load atomicity + bit-identical rollback, shadow
+invisibility, registry fingerprint migration with the unfrozen-head
+refusal, the fleet fingerprint-coherence sweep, and the rollout event
+schema round-trips."""
+
+import math
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from proteinbert_tpu.configs import (
+    DataConfig, ModelConfig, PretrainConfig, TaskConfig,
+)
+from proteinbert_tpu.heads import HeadRegistry, trunk_fingerprint
+from proteinbert_tpu.heads.registry import (
+    UnfrozenHeadError, UnknownHeadError,
+)
+from proteinbert_tpu.models import finetune as ft_model
+from proteinbert_tpu.models import proteinbert
+from proteinbert_tpu.obs import Telemetry, read_events
+from proteinbert_tpu.obs.events import make_example, validate_record
+from proteinbert_tpu.rollout import RolloutController
+from proteinbert_tpu.rollout.controller import parity_delta
+from proteinbert_tpu.serve import Server
+from proteinbert_tpu.serve.errors import (
+    CandidateUnfitError, NoCandidateError,
+)
+from proteinbert_tpu.serve.fleet import FleetRouter
+
+MODEL = ModelConfig(local_dim=16, global_dim=32, key_dim=8, num_heads=2,
+                    num_blocks=2, num_annotations=32, dtype="float32")
+BUCKETS = (24, 48)
+CFG = PretrainConfig(model=MODEL,
+                     data=DataConfig(seq_len=48, batch_size=4,
+                                     buckets=BUCKETS))
+PROBE = "MKTAYIAKQRQISFVKSH"
+
+
+@pytest.fixture(scope="module")
+def params():
+    return proteinbert.init(jax.random.PRNGKey(0), MODEL)
+
+
+@pytest.fixture(scope="module")
+def cand_params(params):
+    """A structurally identical trunk with slightly different weights —
+    a realistic re-pretrain candidate."""
+    leaves, treedef = jax.tree.flatten(params)
+    rng = np.random.default_rng(3)
+    out = []
+    for leaf in leaves:
+        a = np.asarray(leaf)
+        out.append(a + (1e-3 * rng.standard_normal(a.shape))
+                   .astype(a.dtype))
+    return jax.tree.unflatten(treedef, out)
+
+
+# ------------------------------------------------------- parity scoring
+
+class TestParityDelta:
+    def test_numeric_leaves(self):
+        assert parity_delta({"a": 1.0}, {"a": 1.5}) == 0.5
+        assert parity_delta([1, 2], [1, 2.25]) == 0.25
+        assert parity_delta({"a": {"b": [0.0]}},
+                            {"a": {"b": [0.0]}}) == 0.0
+
+    def test_non_numeric_leaves_differ_freely(self):
+        # Request ids and names are EXPECTED to differ live-vs-shadow.
+        assert parity_delta({"id": "req-1", "x": 2.0},
+                            {"id": "req-9", "x": 2.0}) == 0.0
+
+    def test_structural_mismatch_is_inf(self):
+        assert math.isinf(parity_delta([1.0, 2.0], [1.0]))
+        assert math.isinf(parity_delta({"a": 1.0}, {}))
+        assert math.isinf(parity_delta(1.0, "one"))
+
+    def test_bools_compare_by_equality(self):
+        assert parity_delta({"ok": True}, {"ok": True}) == 0.0
+        assert math.isinf(parity_delta({"ok": True}, {"ok": False}))
+        # Bools use Python equality (so True == 1 passes, True != 2.0).
+        assert math.isinf(parity_delta(True, 2.0))
+
+    def test_missing_non_numeric_key_tolerated(self):
+        assert parity_delta({"x": 1.0, "note": "hi"}, {"x": 1.0}) == 0.0
+
+
+# -------------------------------------------- registry pin migration
+
+def _save_head(reg, model_params, task, name, seed=1):
+    hp = ft_model.head_init(jax.random.PRNGKey(seed), MODEL, task)
+    return reg.save(jax.tree.map(np.asarray, hp), task,
+                    trunk_fingerprint(model_params), name=name)
+
+
+class TestMigrateFingerprint:
+    def test_roundtrip_with_audit(self, tmp_path, params, cand_params):
+        reg = HeadRegistry(str(tmp_path))
+        task = TaskConfig(kind="sequence_classification", num_outputs=3,
+                          freeze_trunk=True)
+        hid = _save_head(reg, params, task, "frozen")
+        old_fp = trunk_fingerprint(params)
+        new_fp = trunk_fingerprint(cand_params)
+
+        meta = reg.migrate_fingerprint(hid, new_fp, note="promo")
+        assert meta["trunk_fingerprint"] == new_fp
+        assert [m["note"] for m in meta["migrations"]] == ["promo"]
+        # The artifact still loads and verifies under the new pin.
+        assert reg.load(hid, trunk_fp=new_fp).head_id == hid
+        # Idempotent re-pin: no second audit record.
+        again = reg.migrate_fingerprint(hid, new_fp)
+        assert len(again["migrations"]) == 1
+        # Rollback re-pin appends a second record.
+        back = reg.migrate_fingerprint(hid, old_fp, note="rollback")
+        assert back["trunk_fingerprint"] == old_fp
+        assert len(back["migrations"]) == 2
+
+    def test_unfrozen_head_typed_refusal(self, tmp_path, params,
+                                         cand_params):
+        reg = HeadRegistry(str(tmp_path))
+        task = TaskConfig(kind="sequence_regression", num_outputs=1,
+                          freeze_trunk=False)
+        hid = _save_head(reg, params, task, "unfrozen")
+        with pytest.raises(UnfrozenHeadError):
+            reg.migrate_fingerprint(hid, trunk_fingerprint(cand_params))
+        # The refusal left the pin untouched.
+        assert reg._read_meta(hid)["trunk_fingerprint"] \
+            == trunk_fingerprint(params)
+
+    def test_unknown_head(self, tmp_path):
+        with pytest.raises(UnknownHeadError):
+            HeadRegistry(str(tmp_path)).migrate_fingerprint("nope", "f")
+
+
+# ----------------------------------------------- candidate arm refusals
+
+class TestCandidateArm:
+    def test_refusals_are_typed(self, params, cand_params):
+        srv = Server(params, CFG, buckets=BUCKETS, max_batch=4,
+                     max_wait_s=0.005, cache_size=8, warm_kinds=())
+        with srv:
+            with pytest.raises(NoCandidateError):
+                srv.flip()
+            with pytest.raises(NoCandidateError):
+                srv.rollback_trunk()
+            with pytest.raises(NoCandidateError):
+                srv.shadow_submit("embed", PROBE)
+            with pytest.raises(CandidateUnfitError):
+                srv.load_candidate(params=cand_params,
+                                   hbm_budget_bytes=1)
+            # The refusal left no residue on the arm.
+            assert srv.rollout_status()["candidate_fingerprint"] is None
+            with pytest.raises(ValueError):
+                srv.load_candidate()  # neither params nor source
+            with pytest.raises(ValueError):
+                srv.load_candidate(source="x")  # no candidate_loader
+
+    def test_shadow_invisibility(self, params, cand_params):
+        srv = Server(params, CFG, buckets=BUCKETS, max_batch=4,
+                     max_wait_s=0.005, cache_size=8, warm_kinds=())
+        with srv:
+            live = srv.embed(PROBE, timeout=60)
+            srv.load_candidate(params=cand_params)
+            before = srv.stats()
+            shadow = srv.shadow_submit("embed", PROBE)
+            after = srv.stats()
+            # Same result SHAPE as the live path (the parity scorer
+            # depends on structural agreement), different weights...
+            jsonable = lambda out: {k: np.asarray(v).tolist()
+                                    for k, v in out.items()}
+            delta = parity_delta(jsonable(live), jsonable(shadow))
+            assert 0.0 < delta < math.inf
+            # ...but NO live-path bookkeeping moved: not a completion,
+            # not a cache entry, not a rejection.
+            assert after["completed"] == before["completed"]
+            assert after["cache"] == before["cache"]
+            assert after["rejected"] == before["rejected"]
+            assert after["rollout"]["shadow_requests"] \
+                == before["rollout"]["shadow_requests"] + 1
+            assert srv.unload_candidate()
+
+    def test_flip_under_load_and_bitwise_rollback(self, params,
+                                                  cand_params):
+        """Concurrent submits across a flip each see EXACTLY one trunk
+        (resident xor candidate, never a torn mix), and rollback
+        restores bit-identical resident numerics."""
+        # max_batch=1 pins every request to the SAME (1, L) executable
+        # (row padding to a larger batch class would change the compiled
+        # shape and void bitwise comparison); references come from the
+        # server's own arms — shadow_submit shares the live path's
+        # prep/padding, so it is the exact candidate-arm reference.
+        srv = Server(params, CFG, buckets=BUCKETS, max_batch=1,
+                     max_wait_s=0.002, cache_size=0, warm_kinds=())
+        with srv:
+            res_ref = srv.embed(PROBE, timeout=60)
+            srv.load_candidate(params=cand_params)
+            cand_ref = srv.shadow_submit("embed", PROBE)
+            assert not np.array_equal(res_ref["global"],
+                                      cand_ref["global"])
+            results = [None] * 24
+            start = threading.Barrier(4)
+
+            def client(w):
+                start.wait()
+                for i in range(w, len(results), 3):
+                    results[i] = srv.embed(PROBE, timeout=60)
+
+            threads = [threading.Thread(target=client, args=(w,))
+                       for w in range(3)]
+            for t in threads:
+                t.start()
+            start.wait()
+            flip_report = srv.flip()
+            for t in threads:
+                t.join(timeout=120)
+            assert flip_report["fingerprint"] \
+                == trunk_fingerprint(cand_params)
+            for out in results:
+                is_res = np.array_equal(out["global"], res_ref["global"])
+                is_cand = np.array_equal(out["global"],
+                                         cand_ref["global"])
+                assert is_res != is_cand, \
+                    "a request saw a torn trunk mix across the flip"
+            # Post-flip requests see only the candidate.
+            assert np.array_equal(srv.embed(PROBE, timeout=60)["global"],
+                                  cand_ref["global"])
+            # Instant rollback: bit-identical resident numerics.
+            srv.rollback_trunk()
+            back = srv.embed(PROBE, timeout=60)
+            assert np.array_equal(back["global"], res_ref["global"])
+            assert np.array_equal(back["local_mean"],
+                                  res_ref["local_mean"])
+            assert srv.trunk_fp() == trunk_fingerprint(params)
+
+
+# ------------------------------------------- fleet coherence + schema
+
+class TestFleetFingerprintSweep:
+    def test_mixed_fleet_degrades(self, tmp_path):
+        events = str(tmp_path / "router.jsonl")
+        tele = Telemetry(events_path=events)
+        router = FleetRouter([("a", "http://127.0.0.1:1"),
+                              ("b", "http://127.0.0.1:2")],
+                             telemetry=tele)
+
+        def health(rep, fp, cand=None):
+            payload = {"ok": True, "trunk_fingerprint": fp,
+                       "quant": "fp32", "stats": {}}
+            if cand is not None:
+                payload["stats"]["rollout"] = {
+                    "candidate_fingerprint": cand}
+            router._apply_health(rep, payload)
+
+        a, b = router.replicas
+        health(a, "f" * 64)
+        health(b, "f" * 64)
+        router._sweep_fingerprints()
+        assert router.fingerprint_status()["fleet_state"] == "coherent"
+
+        health(b, "e" * 64, cand="c" * 64)
+        router._sweep_fingerprints()
+        st = router.fingerprint_status()
+        assert st["fleet_state"] == "degraded"
+        assert st["fingerprints"] == {"a": "f" * 64, "b": "e" * 64}
+        assert st["candidates"] == {"b": "c" * 64}
+        assert router.stats()["fleet_state"] == "degraded"
+
+        # A dead replica is not "mixed": the sweep only counts
+        # routable arms, so the fleet converges when b dies.
+        with router._lock:
+            router._transition(b, "dead", reason="test")
+        router._sweep_fingerprints()
+        assert router.fingerprint_status()["fleet_state"] == "coherent"
+
+        tele.close()
+        fleet_evs = [r for r in read_events(events, strict=True)
+                     if r["event"] == "rollout_fleet"]
+        assert [r["state"] for r in fleet_evs] == ["degraded",
+                                                   "coherent"]
+
+    def test_controller_spec_validation(self):
+        for bad in (dict(source=""), dict(source="x", sample_every=0),
+                    dict(source="x", window_requests=0),
+                    dict(source="x", windows_required=0)):
+            with pytest.raises((ValueError, TypeError)):
+                RolloutController(object(), **bad)
+        ctl = RolloutController(object(), source="x")
+        assert ctl.state == "idle" and ctl.terminal()
+        with pytest.raises(RuntimeError):
+            ctl.promote()  # no green streak, not even shadowing
+        with pytest.raises(RuntimeError):
+            ctl.breach()
+
+
+class TestRolloutEventSchema:
+    @pytest.mark.parametrize("event", ["rollout_state", "rollout_window",
+                                       "rollout_shadow", "rollout_flip",
+                                       "rollout_fleet"])
+    def test_examples_roundtrip(self, event):
+        validate_record(make_example(event))
+
+    def test_shadow_must_be_literally_true(self):
+        rec = make_example("rollout_shadow")
+        rec["shadow"] = False
+        with pytest.raises(ValueError):
+            validate_record(rec)

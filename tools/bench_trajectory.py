@@ -242,12 +242,22 @@ _EVENT_METRICS = (
     ("serve_pipeline_capture", "serve_pipeline_speedup_x",
      "serve_pipeline_speedup_x"),
     ("map_capture", "map_overlap_ratio", "map_overlap_ratio"),
+    # Blue-green rollout (ISSUE 20, tools/rollout_drill.py): worst
+    # shadow parity through the GOOD candidate (creep = the mirrored
+    # arm drifting from the resident numerics) and the atomic-flip
+    # latency (creep = the swap-lock hold growing — the zero-dropped-
+    # requests promotion depends on it staying O(pointer)). Both
+    # LOWER-is-better.
+    ("rollout_capture", "rollout_shadow_parity_max",
+     "rollout_shadow_parity_max"),
+    ("rollout_capture", "rollout_flip_seconds", "rollout_flip_seconds"),
 )
 
 # Series (by base name, before the /platform suffix) where a LOWER
 # value is the good direction — ratios and error bounds.
 _LOWER_IS_BETTER = {"comm_bytes_int8_ratio", "serve_quant_parity_max",
-                    "check_findings_total", "fleet_trace_overhead_pct"}
+                    "check_findings_total", "fleet_trace_overhead_pct",
+                    "rollout_shadow_parity_max", "rollout_flip_seconds"}
 
 
 def series_direction(name: str) -> bool:

@@ -205,6 +205,21 @@ rcfd=$?
 mark_stage fleet_drill
 [ "$rc" -eq 0 ] && rc=$rcfd
 
+# Rollout drill smoke (ISSUE 20): the blue-green trunk lifecycle on 3
+# in-process replicas behind the FleetRouter — a deliberately-degraded
+# candidate (the parity gate must refuse it, shadow traffic invisible),
+# then a good one (gates green → atomic flip with one replica KILLED
+# immediately before its flip verb — fleet must converge with zero
+# lost requests and exactly-once sealing), then a forced breach
+# (rollback bit-identical to the pre-rollout baseline, head pins
+# restored). GATED: all of the above + schema-valid rollout_* events
+# + the note(kind=rollout_capture) sentinel sample on the stream.
+echo "=== rollout drill smoke (shadow → gate → flip → rollback, CPU) ==="
+timeout -k 10 420 python "$(dirname "$0")/rollout_drill.py" --json
+rcro=$?
+mark_stage rollout_drill
+[ "$rc" -eq 0 ] && rc=$rcro
+
 # Map drill smoke (ISSUE 14): kill-anywhere offline inference through
 # real `pbt map` subprocesses — SIGKILL between a block's object write
 # and its cursor advance, a torn cursor, a torn block object, one

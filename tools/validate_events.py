@@ -328,6 +328,59 @@ NEGATIVE_CASES = [
          "source": "map_drill", "kind": "map_capture",
          "map_seqs_per_s": 10.0,
          "map_overlap_ratio": -0.1},  # a ratio: [0, 1]
+        # blue-green trunk rollout (ISSUE 20): lifecycle, window
+        # verdicts, shadow siblings, flips, and fleet coherence are
+        # typed — the rollout drill audits the merged stream with this
+        # validator, so a controller bug must fail here.
+        {"v": 1, "event": "rollout_state", "seq": 0, "t": 0.0,
+         "state": "sideways"},  # unknown rollout state
+        {"v": 1, "event": "rollout_state", "seq": 0, "t": 0.0,
+         "state": "promoted", "windows_green": -1},  # streak >= 0
+        {"v": 1, "event": "rollout_state", "seq": 0, "t": 0.0,
+         "state": "promoted",
+         "flip_seconds": float("inf")},  # finite when present
+        {"v": 1, "event": "rollout_window", "seq": 0, "t": 0.0,
+         "window": 0, "verdict": "maybe"},  # verdict is pass|fail
+        {"v": 1, "event": "rollout_window", "seq": 0, "t": 0.0,
+         "window": -1, "verdict": "pass"},  # window index >= 0
+        {"v": 1, "event": "rollout_window", "seq": 0, "t": 0.0,
+         "window": 0, "verdict": "pass",
+         "parity_max": -0.5},  # parity must be >= 0
+        {"v": 1, "event": "rollout_window", "seq": 0, "t": 0.0,
+         "window": 0, "verdict": "fail",
+         "slo_burn_delta": float("nan")},  # finite when present
+        {"v": 1, "event": "rollout_shadow", "seq": 0, "t": 0.0,
+         "trace_id": "f1-1", "replica": "r0", "outcome": "ok",
+         "shadow": False},  # a shadow record MUST flag shadow=true
+        {"v": 1, "event": "rollout_shadow", "seq": 0, "t": 0.0,
+         "trace_id": "f1-1", "replica": "r0", "outcome": "mirrored",
+         "shadow": True},  # outcome is ok|failed
+        {"v": 1, "event": "rollout_shadow", "seq": 0, "t": 0.0,
+         "trace_id": "f1-1", "replica": "r0", "outcome": "failed",
+         "shadow": True, "status": 42},  # HTTP status or 0
+        {"v": 1, "event": "rollout_flip", "seq": 0, "t": 0.0,
+         "replica": "r0", "phase": "sideways",
+         "seconds": 0.01},  # phase is flip|rollback
+        {"v": 1, "event": "rollout_flip", "seq": 0, "t": 0.0,
+         "replica": "r0", "phase": "flip",
+         "seconds": -0.5},  # swap latency must be >= 0
+        {"v": 1, "event": "rollout_fleet", "seq": 0, "t": 0.0,
+         "state": "mixed"},  # state is coherent|degraded
+        {"v": 1, "event": "rollout_fleet", "seq": 0, "t": 0.0,
+         "state": "degraded", "fingerprints": -2},  # count >= 0
+        # the rollout_capture note (tools/rollout_drill.py): shadow
+        # parity + flip latency feed trajectory-sentinel series,
+        # typed + required.
+        {"v": 1, "event": "note", "seq": 0, "t": 0.0,
+         "source": "rollout_drill", "kind": "rollout_capture"},  # none
+        {"v": 1, "event": "note", "seq": 0, "t": 0.0,
+         "source": "rollout_drill", "kind": "rollout_capture",
+         "rollout_shadow_parity_max": -1e-6,
+         "rollout_flip_seconds": 0.2},  # parity must be >= 0
+        {"v": 1, "event": "note", "seq": 0, "t": 0.0,
+         "source": "rollout_drill", "kind": "rollout_capture",
+         "rollout_shadow_parity_max": 1e-6,
+         "rollout_flip_seconds": float("inf")},  # finite
 ]
 
 
